@@ -1,0 +1,30 @@
+"""predictionio_tpu — a TPU-native ML-server framework.
+
+Reproduces the capability surface of PredictionIO (reference:
+chien146/PredictionIO, a fork of Apache PredictionIO — see SURVEY.md): the
+DASE engine abstraction, an event server, engine.json-parameterized engine
+templates, the `pio` CLI lifecycle, metadata/model/event storage, and an
+HTTP prediction server — re-designed TPU-first on JAX/XLA/pjit/Pallas
+instead of Scala/Spark.
+
+Layering (bottom → top), mirroring SURVEY.md §1:
+
+    predictionio_tpu.data       event model (Event, DataMap, PropertyMap, BiMap)
+    predictionio_tpu.storage    storage registry + SQLite/memory/localfs backends
+    predictionio_tpu.ops        jitted XLA/Pallas compute kernels (ALS, logreg, ...)
+    predictionio_tpu.parallel   mesh / sharding / collectives / multi-host init
+    predictionio_tpu.models     model pytrees + checkpoint helpers
+    predictionio_tpu.controller DASE public API (Engine, DataSource, Algorithm, ...)
+    predictionio_tpu.workflow   train/eval/serve runtimes (CoreWorkflow, CreateServer)
+    predictionio_tpu.templates  built-in engine templates (recommendation, ...)
+    predictionio_tpu.tools      `pio-tpu` CLI console, import/export, dashboard
+
+Heavy deps (jax) are imported lazily by the modules that need them, so the
+storage/event layers remain usable in processes that never touch a device.
+"""
+
+__version__ = "0.1.0"
+
+from predictionio_tpu.data.events import Event  # noqa: F401
+from predictionio_tpu.data.datamap import DataMap, PropertyMap  # noqa: F401
+from predictionio_tpu.data.bimap import BiMap  # noqa: F401

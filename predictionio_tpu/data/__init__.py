@@ -1,0 +1,20 @@
+"""Event data model: canonical event schema, property bags, id mappings.
+
+Mirrors the reference's `data/src/main/scala/.../data/storage/{Event,DataMap,
+PropertyMap,BiMap,EventValidation}.scala` (SURVEY.md §2.2, paths unverified —
+reference mount was empty at survey time).
+"""
+
+from predictionio_tpu.data.events import Event, EventValidationError, validate_event
+from predictionio_tpu.data.datamap import DataMap, PropertyMap, aggregate_properties
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = [
+    "Event",
+    "EventValidationError",
+    "validate_event",
+    "DataMap",
+    "PropertyMap",
+    "aggregate_properties",
+    "BiMap",
+]

@@ -1,0 +1,29 @@
+"""Storage abstraction: env-driven registry + pluggable backends.
+
+Mirrors the reference's «data/.../data/storage/Storage.scala :: Storage»
+registry and its repositories (Apps, AccessKeys, Channels, EngineInstances,
+EvaluationInstances, Models, LEvents/PEvents) — SURVEY.md §2.2 [U].
+"""
+
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    StorageBackend,
+)
+from predictionio_tpu.storage.registry import Storage, StorageConfig
+
+__all__ = [
+    "App",
+    "AccessKey",
+    "Channel",
+    "EngineInstance",
+    "EvaluationInstance",
+    "Model",
+    "StorageBackend",
+    "Storage",
+    "StorageConfig",
+]

@@ -1,0 +1,154 @@
+"""Env-driven storage registry.
+
+Parity with «data/.../data/storage/Storage.scala :: Storage» (SURVEY.md §2.2
+[U]): the reference parses ``PIO_STORAGE_REPOSITORIES_{METADATA,MODELDATA,
+EVENTDATA}_{NAME,SOURCE}`` and ``PIO_STORAGE_SOURCES_<SRC>_{TYPE,...}`` from
+`pio-env.sh` and reflectively loads backend clients. We keep the same env
+contract with backend types ``sqlite`` (PATH) and ``memory``; the repository
+split lets metadata/events/models live in different sources, exactly like the
+reference's HBase-events + ES-metadata + localfs-models deployments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+_REPOSITORIES = ("METADATA", "MODELDATA", "EVENTDATA")
+
+
+@dataclasses.dataclass
+class SourceConfig:
+    name: str
+    type: str  # "sqlite" | "memory"
+    path: str = ""  # sqlite file path
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    """Resolved repository → source wiring."""
+
+    metadata: SourceConfig
+    modeldata: SourceConfig
+    eventdata: SourceConfig
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "StorageConfig":
+        env = dict(os.environ if env is None else env)
+        default_path = env.get(
+            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_tpu")
+        )
+
+        def source_for(repo: str) -> SourceConfig:
+            src = env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "PIO_DEFAULT")
+            stype = env.get(f"PIO_STORAGE_SOURCES_{src}_TYPE", "sqlite")
+            spath = env.get(
+                f"PIO_STORAGE_SOURCES_{src}_PATH", os.path.join(default_path, "pio.db")
+            )
+            if stype not in ("sqlite", "memory"):
+                raise ValueError(
+                    f"Unsupported storage source type {stype!r} for {src} "
+                    "(supported: sqlite, memory)"
+                )
+            return SourceConfig(name=src, type=stype, path=spath)
+
+        return cls(
+            metadata=source_for("METADATA"),
+            modeldata=source_for("MODELDATA"),
+            eventdata=source_for("EVENTDATA"),
+        )
+
+
+class Storage:
+    """Process-wide storage access, one backend instance per distinct source.
+
+    Mirrors the reference `Storage` object's accessors: `getMetaDataApps`,
+    `getLEvents`, `getModelDataModels`, `verifyAllDataObjects`, ... [U].
+    """
+
+    _lock = threading.RLock()
+    _instance: Optional["Storage"] = None
+
+    def __init__(self, config: Optional[StorageConfig] = None):
+        self.config = config or StorageConfig.from_env()
+        self._backends: dict[tuple[str, str], base.StorageBackend] = {}
+
+    # -- singleton wiring (CLI / servers); tests construct directly --------
+    @classmethod
+    def get(cls) -> "Storage":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Storage()
+            return cls._instance
+
+    @classmethod
+    def reset(cls, storage: Optional["Storage"] = None) -> None:
+        with cls._lock:
+            cls._instance = storage
+
+    def _backend(self, source: SourceConfig) -> base.StorageBackend:
+        key = (source.type, source.path if source.type == "sqlite" else source.name)
+        with self._lock:
+            backend = self._backends.get(key)
+            if backend is None:
+                if source.type == "memory":
+                    backend = SQLiteBackend(":memory:")
+                else:
+                    os.makedirs(os.path.dirname(source.path) or ".", exist_ok=True)
+                    backend = SQLiteBackend(source.path)
+                self._backends[key] = backend
+            return backend
+
+    # -- metadata ----------------------------------------------------------
+    def meta_apps(self) -> base.Apps:
+        return self._backend(self.config.metadata).apps()
+
+    def meta_access_keys(self) -> base.AccessKeys:
+        return self._backend(self.config.metadata).access_keys()
+
+    def meta_channels(self) -> base.Channels:
+        return self._backend(self.config.metadata).channels()
+
+    def meta_engine_instances(self) -> base.EngineInstances:
+        return self._backend(self.config.metadata).engine_instances()
+
+    def meta_evaluation_instances(self) -> base.EvaluationInstances:
+        return self._backend(self.config.metadata).evaluation_instances()
+
+    # -- model / event data ------------------------------------------------
+    def model_data_models(self) -> base.Models:
+        return self._backend(self.config.modeldata).models()
+
+    def l_events(self) -> base.LEvents:
+        return self._backend(self.config.eventdata).events()
+
+    # -- health ------------------------------------------------------------
+    def verify_all_data_objects(self) -> dict[str, bool]:
+        """`pio status`-style storage connectivity check."""
+        results = {}
+        for name, fn in (
+            ("metadata.apps", self.meta_apps),
+            ("metadata.access_keys", self.meta_access_keys),
+            ("metadata.channels", self.meta_channels),
+            ("metadata.engine_instances", self.meta_engine_instances),
+            ("metadata.evaluation_instances", self.meta_evaluation_instances),
+            ("modeldata.models", self.model_data_models),
+            ("eventdata.events", self.l_events),
+        ):
+            try:
+                fn()
+                results[name] = True
+            except Exception:
+                results[name] = False
+        return results
+
+    def close(self) -> None:
+        with self._lock:
+            for backend in self._backends.values():
+                backend.close()
+            self._backends.clear()

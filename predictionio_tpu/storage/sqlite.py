@@ -1,0 +1,656 @@
+"""SQLite storage backend — the rebuild's analogue of the reference's JDBC
+backend («storage/jdbc/src/... :: JDBCUtils, JDBCLEvents, ...», SURVEY.md §2.2
+[U]), which is upstream's default quickstart path.
+
+One file (or ``:memory:``) holds metadata + events + model blobs. Connections
+are per-thread (the event server is multi-threaded); WAL mode keeps readers
+and the single writer from blocking each other.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from datetime import datetime, timezone
+from typing import Iterable, Optional
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event, format_time, parse_time
+from predictionio_tpu.storage import base
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS apps (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    description TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS access_keys (
+    key TEXT PRIMARY KEY,
+    app_id INTEGER NOT NULL,
+    events TEXT NOT NULL DEFAULT '[]'
+);
+CREATE TABLE IF NOT EXISTS channels (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    app_id INTEGER NOT NULL,
+    UNIQUE(app_id, name)
+);
+CREATE TABLE IF NOT EXISTS engine_instances (
+    id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    start_time TEXT NOT NULL,
+    end_time TEXT NOT NULL,
+    engine_id TEXT NOT NULL,
+    engine_version TEXT NOT NULL,
+    engine_variant TEXT NOT NULL,
+    engine_factory TEXT NOT NULL,
+    batch TEXT NOT NULL DEFAULT '',
+    env TEXT NOT NULL DEFAULT '{}',
+    data_source_params TEXT NOT NULL DEFAULT '{}',
+    preparator_params TEXT NOT NULL DEFAULT '{}',
+    algorithms_params TEXT NOT NULL DEFAULT '[]',
+    serving_params TEXT NOT NULL DEFAULT '{}'
+);
+CREATE TABLE IF NOT EXISTS evaluation_instances (
+    id TEXT PRIMARY KEY,
+    status TEXT NOT NULL,
+    start_time TEXT NOT NULL,
+    end_time TEXT NOT NULL,
+    evaluation_class TEXT NOT NULL,
+    engine_params_generator_class TEXT NOT NULL,
+    batch TEXT NOT NULL DEFAULT '',
+    env TEXT NOT NULL DEFAULT '{}',
+    evaluator_results TEXT NOT NULL DEFAULT '',
+    evaluator_results_html TEXT NOT NULL DEFAULT '',
+    evaluator_results_json TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS models (
+    id TEXT PRIMARY KEY,
+    models BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS events (
+    id TEXT PRIMARY KEY,
+    app_id INTEGER NOT NULL,
+    channel_id INTEGER,
+    event TEXT NOT NULL,
+    entity_type TEXT NOT NULL,
+    entity_id TEXT NOT NULL,
+    target_entity_type TEXT,
+    target_entity_id TEXT,
+    properties TEXT NOT NULL DEFAULT '{}',
+    event_time TEXT NOT NULL,
+    tags TEXT NOT NULL DEFAULT '[]',
+    pr_id TEXT,
+    creation_time TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_events_scan
+    ON events (app_id, channel_id, event_time);
+CREATE INDEX IF NOT EXISTS idx_events_entity
+    ON events (app_id, channel_id, entity_type, entity_id);
+"""
+
+
+class SQLiteBackend(base.StorageBackend):
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._local = threading.local()
+        # :memory: must share one connection across threads (each connection
+        # would otherwise get its own private database), serialized by a lock.
+        # File databases get one connection per thread; WAL handles them.
+        self._shared: Optional[sqlite3.Connection] = None
+        self._shared_lock = threading.RLock()
+        self._all_conns: list[sqlite3.Connection] = []
+        self._conns_lock = threading.Lock()
+        if path == ":memory:":
+            self._shared = self._connect()
+        with self._cursor() as cur:
+            cur.executescript(_SCHEMA)
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, check_same_thread=False, timeout=30.0)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        with self._conns_lock:
+            self._all_conns.append(conn)
+        return conn
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._shared is not None:
+            return self._shared
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._connect()
+            self._local.conn = conn
+        return conn
+
+    class _Cursor:
+        def __init__(self, backend: "SQLiteBackend"):
+            self._b = backend
+            # Only the shared :memory: connection needs cross-thread
+            # serialization; file DBs use per-thread connections + WAL.
+            self._locked = backend._shared is not None
+
+        def __enter__(self) -> sqlite3.Cursor:
+            if self._locked:
+                self._b._shared_lock.acquire()
+            self._cur = self._b._conn().cursor()
+            return self._cur
+
+        def __exit__(self, exc_type, exc, tb):
+            try:
+                if exc_type is None:
+                    self._cur.connection.commit()
+                else:
+                    self._cur.connection.rollback()
+                self._cur.close()
+            finally:
+                if self._locked:
+                    self._b._shared_lock.release()
+
+    def _cursor(self) -> "_Cursor":
+        return SQLiteBackend._Cursor(self)
+
+    # repository accessors
+    def apps(self) -> "SQLiteApps":
+        return SQLiteApps(self)
+
+    def access_keys(self) -> "SQLiteAccessKeys":
+        return SQLiteAccessKeys(self)
+
+    def channels(self) -> "SQLiteChannels":
+        return SQLiteChannels(self)
+
+    def engine_instances(self) -> "SQLiteEngineInstances":
+        return SQLiteEngineInstances(self)
+
+    def evaluation_instances(self) -> "SQLiteEvaluationInstances":
+        return SQLiteEvaluationInstances(self)
+
+    def models(self) -> "SQLiteModels":
+        return SQLiteModels(self)
+
+    def events(self) -> "SQLiteLEvents":
+        return SQLiteLEvents(self)
+
+    def close(self) -> None:
+        with self._conns_lock:
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.Error:
+                    pass
+            self._all_conns.clear()
+        self._shared = None
+        self._local = threading.local()
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, app: App) -> Optional[int]:
+        try:
+            with self._b._cursor() as cur:
+                cur.execute(
+                    "INSERT INTO apps (name, description) VALUES (?, ?)",
+                    (app.name, app.description),
+                )
+                return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, app_id: int) -> Optional[App]:
+        with self._b._cursor() as cur:
+            row = cur.execute("SELECT * FROM apps WHERE id=?", (app_id,)).fetchone()
+        return App(row["id"], row["name"], row["description"]) if row else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        with self._b._cursor() as cur:
+            row = cur.execute("SELECT * FROM apps WHERE name=?", (name,)).fetchone()
+        return App(row["id"], row["name"], row["description"]) if row else None
+
+    def get_all(self) -> list[App]:
+        with self._b._cursor() as cur:
+            rows = cur.execute("SELECT * FROM apps ORDER BY id").fetchall()
+        return [App(r["id"], r["name"], r["description"]) for r in rows]
+
+    def update(self, app: App) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute(
+                "UPDATE apps SET name=?, description=? WHERE id=?",
+                (app.name, app.description, app.id),
+            )
+            return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM apps WHERE id=?", (app_id,))
+            return cur.rowcount > 0
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        try:
+            with self._b._cursor() as cur:
+                cur.execute(
+                    "INSERT INTO access_keys (key, app_id, events) VALUES (?, ?, ?)",
+                    (access_key.key, access_key.app_id, json.dumps(access_key.events)),
+                )
+            return access_key.key
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        with self._b._cursor() as cur:
+            row = cur.execute("SELECT * FROM access_keys WHERE key=?", (key,)).fetchone()
+        if row is None:
+            return None
+        return AccessKey(row["key"], row["app_id"], json.loads(row["events"]))
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        with self._b._cursor() as cur:
+            rows = cur.execute("SELECT * FROM access_keys WHERE app_id=?", (app_id,)).fetchall()
+        return [AccessKey(r["key"], r["app_id"], json.loads(r["events"])) for r in rows]
+
+    def delete(self, key: str) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM access_keys WHERE key=?", (key,))
+            return cur.rowcount > 0
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        try:
+            with self._b._cursor() as cur:
+                cur.execute(
+                    "INSERT INTO channels (name, app_id) VALUES (?, ?)",
+                    (channel.name, channel.app_id),
+                )
+                return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        with self._b._cursor() as cur:
+            row = cur.execute("SELECT * FROM channels WHERE id=?", (channel_id,)).fetchone()
+        return Channel(row["id"], row["name"], row["app_id"]) if row else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        with self._b._cursor() as cur:
+            rows = cur.execute(
+                "SELECT * FROM channels WHERE app_id=? ORDER BY id", (app_id,)
+            ).fetchall()
+        return [Channel(r["id"], r["name"], r["app_id"]) for r in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+            return cur.rowcount > 0
+
+
+def _ei_from_row(row: sqlite3.Row) -> EngineInstance:
+    return EngineInstance(
+        id=row["id"],
+        status=row["status"],
+        start_time=parse_time(row["start_time"]),
+        end_time=parse_time(row["end_time"]),
+        engine_id=row["engine_id"],
+        engine_version=row["engine_version"],
+        engine_variant=row["engine_variant"],
+        engine_factory=row["engine_factory"],
+        batch=row["batch"],
+        env=json.loads(row["env"]),
+        data_source_params=row["data_source_params"],
+        preparator_params=row["preparator_params"],
+        algorithms_params=row["algorithms_params"],
+        serving_params=row["serving_params"],
+    )
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, instance: EngineInstance) -> str:
+        iid = instance.id or uuid.uuid4().hex
+        instance.id = iid
+        with self._b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO engine_instances VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    iid,
+                    instance.status,
+                    format_time(instance.start_time),
+                    format_time(instance.end_time),
+                    instance.engine_id,
+                    instance.engine_version,
+                    instance.engine_variant,
+                    instance.engine_factory,
+                    instance.batch,
+                    json.dumps(instance.env),
+                    instance.data_source_params,
+                    instance.preparator_params,
+                    instance.algorithms_params,
+                    instance.serving_params,
+                ),
+            )
+        return iid
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        with self._b._cursor() as cur:
+            row = cur.execute(
+                "SELECT * FROM engine_instances WHERE id=?", (instance_id,)
+            ).fetchone()
+        return _ei_from_row(row) if row else None
+
+    def get_all(self) -> list[EngineInstance]:
+        with self._b._cursor() as cur:
+            rows = cur.execute(
+                "SELECT * FROM engine_instances ORDER BY start_time DESC"
+            ).fetchall()
+        return [_ei_from_row(r) for r in rows]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        with self._b._cursor() as cur:
+            row = cur.execute(
+                "SELECT * FROM engine_instances WHERE status='COMPLETED' "
+                "AND engine_id=? AND engine_version=? AND engine_variant=? "
+                "ORDER BY start_time DESC LIMIT 1",
+                (engine_id, engine_version, engine_variant),
+            ).fetchone()
+        return _ei_from_row(row) if row else None
+
+    def update(self, instance: EngineInstance) -> None:
+        with self._b._cursor() as cur:
+            cur.execute(
+                "UPDATE engine_instances SET status=?, start_time=?, end_time=?, "
+                "engine_id=?, engine_version=?, engine_variant=?, engine_factory=?, "
+                "batch=?, env=?, data_source_params=?, preparator_params=?, "
+                "algorithms_params=?, serving_params=? WHERE id=?",
+                (
+                    instance.status,
+                    format_time(instance.start_time),
+                    format_time(instance.end_time),
+                    instance.engine_id,
+                    instance.engine_version,
+                    instance.engine_variant,
+                    instance.engine_factory,
+                    instance.batch,
+                    json.dumps(instance.env),
+                    instance.data_source_params,
+                    instance.preparator_params,
+                    instance.algorithms_params,
+                    instance.serving_params,
+                    instance.id,
+                ),
+            )
+
+    def delete(self, instance_id: str) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM engine_instances WHERE id=?", (instance_id,))
+            return cur.rowcount > 0
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        iid = instance.id or uuid.uuid4().hex
+        instance.id = iid
+        with self._b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO evaluation_instances VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    iid,
+                    instance.status,
+                    format_time(instance.start_time),
+                    format_time(instance.end_time),
+                    instance.evaluation_class,
+                    instance.engine_params_generator_class,
+                    instance.batch,
+                    json.dumps(instance.env),
+                    instance.evaluator_results,
+                    instance.evaluator_results_html,
+                    instance.evaluator_results_json,
+                ),
+            )
+        return iid
+
+    def _from_row(self, row: sqlite3.Row) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=row["id"],
+            status=row["status"],
+            start_time=parse_time(row["start_time"]),
+            end_time=parse_time(row["end_time"]),
+            evaluation_class=row["evaluation_class"],
+            engine_params_generator_class=row["engine_params_generator_class"],
+            batch=row["batch"],
+            env=json.loads(row["env"]),
+            evaluator_results=row["evaluator_results"],
+            evaluator_results_html=row["evaluator_results_html"],
+            evaluator_results_json=row["evaluator_results_json"],
+        )
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        with self._b._cursor() as cur:
+            row = cur.execute(
+                "SELECT * FROM evaluation_instances WHERE id=?", (instance_id,)
+            ).fetchone()
+        return self._from_row(row) if row else None
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        with self._b._cursor() as cur:
+            rows = cur.execute(
+                "SELECT * FROM evaluation_instances WHERE status='EVALCOMPLETED' "
+                "ORDER BY start_time DESC"
+            ).fetchall()
+        return [self._from_row(r) for r in rows]
+
+    def update(self, instance: EvaluationInstance) -> None:
+        with self._b._cursor() as cur:
+            cur.execute(
+                "UPDATE evaluation_instances SET status=?, start_time=?, end_time=?, "
+                "evaluation_class=?, engine_params_generator_class=?, batch=?, env=?, "
+                "evaluator_results=?, evaluator_results_html=?, evaluator_results_json=? "
+                "WHERE id=?",
+                (
+                    instance.status,
+                    format_time(instance.start_time),
+                    format_time(instance.end_time),
+                    instance.evaluation_class,
+                    instance.engine_params_generator_class,
+                    instance.batch,
+                    json.dumps(instance.env),
+                    instance.evaluator_results,
+                    instance.evaluator_results_html,
+                    instance.evaluator_results_json,
+                    instance.id,
+                ),
+            )
+
+    def delete(self, instance_id: str) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM evaluation_instances WHERE id=?", (instance_id,))
+            return cur.rowcount > 0
+
+
+class SQLiteModels(base.Models):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def insert(self, model: Model) -> None:
+        with self._b._cursor() as cur:
+            cur.execute(
+                "INSERT OR REPLACE INTO models (id, models) VALUES (?, ?)",
+                (model.id, model.models),
+            )
+
+    def get(self, model_id: str) -> Optional[Model]:
+        with self._b._cursor() as cur:
+            row = cur.execute("SELECT * FROM models WHERE id=?", (model_id,)).fetchone()
+        return Model(row["id"], row["models"]) if row else None
+
+    def delete(self, model_id: str) -> bool:
+        with self._b._cursor() as cur:
+            cur.execute("DELETE FROM models WHERE id=?", (model_id,))
+            return cur.rowcount > 0
+
+
+class SQLiteLEvents(base.LEvents):
+    def __init__(self, backend: SQLiteBackend):
+        self._b = backend
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return True  # single events table; nothing to create per app
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._b._cursor() as cur:
+            if channel_id is None:
+                cur.execute("DELETE FROM events WHERE app_id=? AND channel_id IS NULL", (app_id,))
+            else:
+                cur.execute(
+                    "DELETE FROM events WHERE app_id=? AND channel_id=?", (app_id, channel_id)
+                )
+        return True
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        eid = event.event_id or uuid.uuid4().hex
+        event.event_id = eid
+        with self._b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    eid,
+                    app_id,
+                    channel_id,
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    event.properties.to_json(),
+                    format_time(event.event_time),
+                    json.dumps(event.tags),
+                    event.pr_id,
+                    format_time(event.creation_time),
+                ),
+            )
+        return eid
+
+    @staticmethod
+    def _event_from_row(row: sqlite3.Row) -> Event:
+        return Event(
+            event=row["event"],
+            entity_type=row["entity_type"],
+            entity_id=row["entity_id"],
+            target_entity_type=row["target_entity_type"],
+            target_entity_id=row["target_entity_id"],
+            properties=DataMap.from_json(row["properties"]),
+            event_time=parse_time(row["event_time"]),
+            tags=json.loads(row["tags"]),
+            pr_id=row["pr_id"],
+            creation_time=parse_time(row["creation_time"]),
+            event_id=row["id"],
+        )
+
+    @staticmethod
+    def _channel_clause(channel_id: Optional[int]) -> tuple[str, list]:
+        if channel_id is None:
+            return "channel_id IS NULL", []
+        return "channel_id=?", [channel_id]
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        ch_sql, ch_params = self._channel_clause(channel_id)
+        with self._b._cursor() as cur:
+            row = cur.execute(
+                f"SELECT * FROM events WHERE id=? AND app_id=? AND {ch_sql}",
+                [event_id, app_id, *ch_params],
+            ).fetchone()
+        return self._event_from_row(row) if row else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        ch_sql, ch_params = self._channel_clause(channel_id)
+        with self._b._cursor() as cur:
+            cur.execute(
+                f"DELETE FROM events WHERE id=? AND app_id=? AND {ch_sql}",
+                [event_id, app_id, *ch_params],
+            )
+            return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[list[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterable[Event]:
+        clauses = ["app_id=?"]
+        params: list = [app_id]
+        if channel_id is None:
+            clauses.append("channel_id IS NULL")
+        else:
+            clauses.append("channel_id=?")
+            params.append(channel_id)
+        if start_time is not None:
+            clauses.append("event_time>=?")
+            params.append(format_time(start_time))
+        if until_time is not None:
+            clauses.append("event_time<?")
+            params.append(format_time(until_time))
+        if entity_type is not None:
+            clauses.append("entity_type=?")
+            params.append(entity_type)
+        if entity_id is not None:
+            clauses.append("entity_id=?")
+            params.append(entity_id)
+        if target_entity_type is not None:
+            clauses.append("target_entity_type=?")
+            params.append(target_entity_type)
+        if target_entity_id is not None:
+            clauses.append("target_entity_id=?")
+            params.append(target_entity_id)
+        if event_names:
+            clauses.append(f"event IN ({','.join('?' * len(event_names))})")
+            params.extend(event_names)
+        order = "DESC" if reversed else "ASC"
+        sql = (
+            f"SELECT * FROM events WHERE {' AND '.join(clauses)} "
+            f"ORDER BY event_time {order}, creation_time {order}"
+        )
+        if limit is not None and limit >= 0:
+            sql += " LIMIT ?"
+            params.append(limit)
+        with self._b._cursor() as cur:
+            rows = cur.execute(sql, params).fetchall()
+        return [self._event_from_row(r) for r in rows]

@@ -1,0 +1,4 @@
+"""CLI console + ops tooling (`pio-tpu` verbs, import/export, dashboard).
+
+Mirrors the reference's `tools/` module (SURVEY.md §2.3 [U]).
+"""

@@ -1,0 +1,194 @@
+"""`pio-tpu` console — the CLI lifecycle entry point.
+
+Parity target: «tools/.../tools/console/Console.scala :: Console.main»
+(SURVEY.md §2.3 [U]), verb-for-verb: app, accesskey, eventserver, build,
+train, deploy, eval, import, export, batchpredict, status, version,
+dashboard. Verbs are registered here and wired to their subsystems as the
+layers land; unwired verbs exit with a clear message rather than a stack
+trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import predictionio_tpu
+
+
+def cmd_version(args) -> int:
+    print(predictionio_tpu.__version__)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """Storage connectivity health check (`pio status` [U])."""
+    from predictionio_tpu.storage import Storage
+
+    results = Storage.get().verify_all_data_objects()
+    for name, ok in results.items():
+        print(f"  {name}: {'OK' if ok else 'FAILED'}")
+    ok = all(results.values())
+    print("Storage status: " + ("all OK" if ok else "FAILURES detected"))
+    return 0 if ok else 1
+
+
+def cmd_app(args) -> int:
+    from predictionio_tpu.storage import AccessKey, App, Channel, Storage
+
+    storage = Storage.get()
+    apps = storage.meta_apps()
+    keys = storage.meta_access_keys()
+    if args.app_command == "new":
+        app_id = apps.insert(App(id=0, name=args.name, description=args.description or ""))
+        if app_id is None:
+            print(f"App {args.name!r} already exists.", file=sys.stderr)
+            return 1
+        key = AccessKey.generate(app_id)
+        keys.insert(key)
+        print(f"Created a new app:")
+        print(f"      Name: {args.name}")
+        print(f"        ID: {app_id}")
+        print(f"Access Key: {key.key}")
+        return 0
+    if args.app_command == "list":
+        for app in apps.get_all():
+            ks = keys.get_by_app_id(app.id)
+            key_str = ks[0].key if ks else "(none)"
+            print(f"  {app.id} {app.name} key={key_str}")
+        return 0
+    if args.app_command == "delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"App {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        for k in keys.get_by_app_id(app.id):
+            keys.delete(k.key)
+        storage.l_events().remove(app.id)
+        apps.delete(app.id)
+        print(f"Deleted app {args.name}.")
+        return 0
+    if args.app_command == "data-delete":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"App {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        storage.l_events().remove(app.id)
+        print(f"Deleted all events of app {args.name}.")
+        return 0
+    if args.app_command == "channel-new":
+        app = apps.get_by_name(args.name)
+        if app is None:
+            print(f"App {args.name!r} does not exist.", file=sys.stderr)
+            return 1
+        cid = storage.meta_channels().insert(Channel(id=0, name=args.channel, app_id=app.id))
+        if cid is None:
+            print(f"Invalid or duplicate channel name {args.channel!r}.", file=sys.stderr)
+            return 1
+        print(f"Created channel {args.channel} (id={cid}) for app {args.name}.")
+        return 0
+    print(f"Unknown app command {args.app_command!r}", file=sys.stderr)
+    return 1
+
+
+def cmd_accesskey(args) -> int:
+    from predictionio_tpu.storage import AccessKey, Storage
+
+    storage = Storage.get()
+    keys = storage.meta_access_keys()
+    if args.ak_command == "new":
+        app = storage.meta_apps().get_by_name(args.app_name)
+        if app is None:
+            print(f"App {args.app_name!r} does not exist.", file=sys.stderr)
+            return 1
+        key = AccessKey.generate(app.id, events=args.event or [])
+        keys.insert(key)
+        print(f"Created new access key: {key.key}")
+        return 0
+    if args.ak_command == "list":
+        app = storage.meta_apps().get_by_name(args.app_name)
+        if app is None:
+            print(f"App {args.app_name!r} does not exist.", file=sys.stderr)
+            return 1
+        for k in keys.get_by_app_id(app.id):
+            print(f"  {k.key} events={k.events or 'all'}")
+        return 0
+    if args.ak_command == "delete":
+        ok = keys.delete(args.key)
+        print("Deleted." if ok else "No such key.")
+        return 0 if ok else 1
+    return 1
+
+
+def _not_wired(verb: str):
+    def handler(args) -> int:
+        print(
+            f"`pio-tpu {verb}` is not wired up yet in this build; "
+            "see SURVEY.md §7.2 for the construction order.",
+            file=sys.stderr,
+        )
+        return 2
+
+    return handler
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pio-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(func=cmd_version)
+    sub.add_parser("status").set_defaults(func=cmd_status)
+
+    app = sub.add_parser("app")
+    app_sub = app.add_subparsers(dest="app_command", required=True)
+    app_new = app_sub.add_parser("new")
+    app_new.add_argument("name")
+    app_new.add_argument("--description", default="")
+    app_sub.add_parser("list")
+    app_del = app_sub.add_parser("delete")
+    app_del.add_argument("name")
+    app_dd = app_sub.add_parser("data-delete")
+    app_dd.add_argument("name")
+    app_ch = app_sub.add_parser("channel-new")
+    app_ch.add_argument("name")
+    app_ch.add_argument("channel")
+    app.set_defaults(func=cmd_app)
+
+    ak = sub.add_parser("accesskey")
+    ak_sub = ak.add_subparsers(dest="ak_command", required=True)
+    ak_new = ak_sub.add_parser("new")
+    ak_new.add_argument("app_name")
+    ak_new.add_argument("--event", action="append")
+    ak_list = ak_sub.add_parser("list")
+    ak_list.add_argument("app_name")
+    ak_del = ak_sub.add_parser("delete")
+    ak_del.add_argument("key")
+    ak.set_defaults(func=cmd_accesskey)
+
+    for verb in (
+        "eventserver",
+        "build",
+        "train",
+        "deploy",
+        "eval",
+        "import",
+        "export",
+        "batchpredict",
+        "dashboard",
+        "adminserver",
+    ):
+        sp = sub.add_parser(verb)
+        sp.set_defaults(func=_not_wired(verb))
+        sp.add_argument("rest", nargs=argparse.REMAINDER)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

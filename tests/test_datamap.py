@@ -1,0 +1,119 @@
+"""DataMap / PropertyMap semantics — mirrors the reference's DataMapSpec
+coverage (SURVEY.md §4.1)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.data.datamap import (
+    DataMap,
+    DataMapError,
+    aggregate_properties,
+)
+from predictionio_tpu.data.events import Event
+
+
+def ts(h):
+    return datetime(2026, 1, 1, h, 0, 0, tzinfo=timezone.utc)
+
+
+class TestDataMap:
+    def test_typed_accessors(self):
+        d = DataMap({"a": 1, "b": "x", "c": [1.0, 2.5], "d": ["u", "v"], "e": None})
+        assert d.require("a", int) == 1
+        assert d.require("b", str) == "x"
+        assert d.require("a", float) == 1.0  # int→float promotion
+        assert d.get_double_list("c") == [1.0, 2.5]
+        assert d.get_string_list("d") == ["u", "v"]
+        assert d.get_opt("e") is None
+        assert d.get_opt("missing") is None
+        assert d.get_or_else("missing", 7) == 7
+
+    def test_require_missing_raises(self):
+        with pytest.raises(DataMapError):
+            DataMap({}).require("nope")
+
+    def test_require_wrong_type_raises(self):
+        with pytest.raises(DataMapError):
+            DataMap({"a": "str"}).require("a", int)
+
+    def test_merge_right_biased(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.merge(b).to_dict() == {"x": 1, "y": 3, "z": 4}
+
+    def test_drop(self):
+        assert DataMap({"x": 1, "y": 2}).drop(["x"]).to_dict() == {"y": 2}
+
+    def test_json_roundtrip(self):
+        d = DataMap({"a": 1, "b": [1, 2], "c": {"n": True}})
+        assert DataMap.from_json(d.to_json()) == d
+
+
+def set_ev(eid, props, t):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=t)
+
+
+def unset_ev(eid, keys, t):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=t)
+
+
+def delete_ev(eid, t):
+    return Event(event="$delete", entity_type="user", entity_id=eid, event_time=t)
+
+
+class TestAggregateProperties:
+    def test_set_merge_in_time_order(self):
+        events = [
+            set_ev("u1", {"a": 1, "b": 2}, ts(1)),
+            set_ev("u1", {"b": 9, "c": 3}, ts(2)),
+        ]
+        props = aggregate_properties(events)
+        assert props["u1"].to_dict() == {"a": 1, "b": 9, "c": 3}
+        assert props["u1"].first_updated == ts(1)
+        assert props["u1"].last_updated == ts(2)
+
+    def test_out_of_order_input_sorted_by_event_time(self):
+        events = [
+            set_ev("u1", {"b": 9}, ts(2)),
+            set_ev("u1", {"a": 1, "b": 2}, ts(1)),
+        ]
+        assert aggregate_properties(events)["u1"].to_dict() == {"a": 1, "b": 9}
+
+    def test_unset_removes_keys(self):
+        events = [
+            set_ev("u1", {"a": 1, "b": 2}, ts(1)),
+            unset_ev("u1", ["a"], ts(2)),
+        ]
+        props = aggregate_properties(events)
+        assert props["u1"].to_dict() == {"b": 2}
+        assert props["u1"].last_updated == ts(2)
+
+    def test_delete_removes_entity(self):
+        events = [
+            set_ev("u1", {"a": 1}, ts(1)),
+            delete_ev("u1", ts(2)),
+        ]
+        assert aggregate_properties(events) == {}
+
+    def test_set_after_delete_recreates_with_fresh_first_updated(self):
+        events = [
+            set_ev("u1", {"a": 1}, ts(1)),
+            delete_ev("u1", ts(2)),
+            set_ev("u1", {"z": 9}, ts(3)),
+        ]
+        props = aggregate_properties(events)
+        assert props["u1"].to_dict() == {"z": 9}
+        assert props["u1"].first_updated == ts(3)
+
+    def test_non_special_events_ignored(self):
+        events = [
+            set_ev("u1", {"a": 1}, ts(1)),
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  properties=DataMap({"rating": 5}), event_time=ts(2)),
+        ]
+        props = aggregate_properties(events)
+        assert props["u1"].to_dict() == {"a": 1}
+        assert props["u1"].last_updated == ts(1)

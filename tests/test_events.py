@@ -1,0 +1,106 @@
+"""Event schema + validation — mirrors the reference's EventValidation
+coverage (SURVEY.md §4.1)."""
+
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import (
+    Event,
+    EventValidationError,
+    parse_time,
+    validate_event,
+)
+
+
+class TestEventSerde:
+    def test_roundtrip(self):
+        e = Event(
+            event="buy",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"qty": 3}),
+            tags=["t1"],
+            pr_id="p1",
+        )
+        e2 = Event.from_dict(e.to_dict())
+        assert e2.event == "buy"
+        assert e2.target_entity_id == "i1"
+        assert e2.properties.to_dict() == {"qty": 3}
+        assert e2.tags == ["t1"]
+        assert e2.event_time == e.event_time
+
+    def test_missing_required_field(self):
+        with pytest.raises(EventValidationError):
+            Event.from_dict({"event": "buy", "entityType": "user"})
+
+    def test_iso_z_time(self):
+        e = Event.from_dict({
+            "event": "rate", "entityType": "user", "entityId": "1",
+            "eventTime": "2026-01-02T03:04:05.000Z",
+        })
+        assert e.event_time == parse_time("2026-01-02T03:04:05+00:00")
+
+    def test_numeric_entity_id_coerced(self):
+        e = Event.from_dict({"event": "rate", "entityType": "user", "entityId": 42})
+        assert e.entity_id == "42"
+
+
+class TestValidation:
+    def mk(self, **kw):
+        defaults = dict(event="rate", entity_type="user", entity_id="u1")
+        defaults.update(kw)
+        return Event(**defaults)
+
+    def test_plain_event_ok(self):
+        validate_event(self.mk())
+
+    def test_set_ok(self):
+        validate_event(self.mk(event="$set", properties=DataMap({"a": 1})))
+
+    def test_unknown_dollar_event_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(event="$frobnicate"))
+
+    def test_special_event_with_target_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(event="$set", target_entity_type="item",
+                                   target_entity_id="i1"))
+
+    def test_unset_empty_properties_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(event="$unset"))
+
+    def test_delete_with_properties_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(event="$delete", properties=DataMap({"a": 1})))
+
+    def test_pio_prefix_reserved(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(event="pio_thing"))
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(entity_type="pio_user"))
+        with pytest.raises(EventValidationError):
+            validate_event(self.mk(properties=DataMap({"pio_x": 1})))
+
+
+class TestBiMap:
+    def test_dense_indices_in_first_appearance_order(self):
+        bm = BiMap.string_int(["b", "a", "b", "c"])
+        assert bm.to_dict() == {"b": 0, "a": 1, "c": 2}
+
+    def test_inverse(self):
+        bm = BiMap.string_int(["x", "y"])
+        assert bm.inverse()[1] == "y"
+
+    def test_vectorized(self):
+        bm = BiMap.string_int(["x", "y", "z"])
+        idx = bm.to_index(["z", "x"])
+        assert idx.tolist() == [2, 0]
+        assert bm.from_index(idx) == ["z", "x"]
+
+    def test_duplicate_values_rejected(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})

@@ -1,0 +1,227 @@
+"""Storage backend + registry + event-store façade tests — mirrors the
+reference's LEventsSpec / metadata repo specs (SURVEY.md §4.1)."""
+
+from datetime import datetime, timezone
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+
+
+def ts(h, m=0):
+    return datetime(2026, 1, 1, h, m, 0, tzinfo=timezone.utc)
+
+
+def ev(name, eid="u1", t=None, **kw):
+    return Event(event=name, entity_type="user", entity_id=eid,
+                 event_time=t or ts(0), **kw)
+
+
+class TestApps:
+    def test_crud(self, memory_storage):
+        apps = memory_storage.meta_apps()
+        app_id = apps.insert(App(id=0, name="MyApp", description="d"))
+        assert app_id is not None
+        assert apps.get(app_id).name == "MyApp"
+        assert apps.get_by_name("MyApp").id == app_id
+        assert apps.insert(App(id=0, name="MyApp")) is None  # duplicate name
+        assert apps.update(App(id=app_id, name="Renamed"))
+        assert apps.get_by_name("Renamed") is not None
+        assert [a.name for a in apps.get_all()] == ["Renamed"]
+        assert apps.delete(app_id)
+        assert apps.get(app_id) is None
+
+
+class TestAccessKeysAndChannels:
+    def test_access_keys(self, memory_storage):
+        keys = memory_storage.meta_access_keys()
+        k = AccessKey.generate(app_id=1, events=["rate"])
+        keys.insert(k)
+        got = keys.get(k.key)
+        assert got.app_id == 1 and got.events == ["rate"]
+        assert len(keys.get_by_app_id(1)) == 1
+        assert keys.delete(k.key)
+        assert keys.get(k.key) is None
+
+    def test_channels(self, memory_storage):
+        channels = memory_storage.meta_channels()
+        cid = channels.insert(Channel(id=0, name="ch1", app_id=1))
+        assert cid is not None
+        assert channels.get(cid).name == "ch1"
+        # duplicate per app rejected
+        assert channels.insert(Channel(id=0, name="ch1", app_id=1)) is None
+        # invalid name rejected (too long)
+        assert channels.insert(Channel(id=0, name="x" * 20, app_id=1)) is None
+        assert [c.name for c in channels.get_by_app_id(1)] == ["ch1"]
+
+
+class TestEngineInstances:
+    def mk(self, status="RUNNING", t=None):
+        t = t or ts(1)
+        return EngineInstance(
+            id="", status=status, start_time=t, end_time=t,
+            engine_id="eng", engine_version="1", engine_variant="engine.json",
+            engine_factory="mod.Factory",
+        )
+
+    def test_insert_get_update(self, memory_storage):
+        eis = memory_storage.meta_engine_instances()
+        iid = eis.insert(self.mk())
+        inst = eis.get(iid)
+        assert inst.status == "RUNNING"
+        inst.status = "COMPLETED"
+        eis.update(inst)
+        assert eis.get(iid).status == "COMPLETED"
+
+    def test_latest_completed(self, memory_storage):
+        eis = memory_storage.meta_engine_instances()
+        eis.insert(self.mk("COMPLETED", ts(1)))
+        latest = self.mk("COMPLETED", ts(2))
+        eis.insert(latest)
+        eis.insert(self.mk("RUNNING", ts(3)))
+        got = eis.get_latest_completed("eng", "1", "engine.json")
+        assert got.id == latest.id
+        assert eis.get_latest_completed("other", "1", "engine.json") is None
+
+
+class TestEvaluationInstancesAndModels:
+    def test_eval_instances(self, memory_storage):
+        evs = memory_storage.meta_evaluation_instances()
+        inst = EvaluationInstance(
+            id="", status="EVALRUNNING", start_time=ts(1), end_time=ts(1),
+            evaluation_class="ev.Cls", engine_params_generator_class="gen.Cls",
+        )
+        iid = evs.insert(inst)
+        inst.status = "EVALCOMPLETED"
+        inst.evaluator_results = "MAP@10: 0.1"
+        evs.update(inst)
+        completed = evs.get_completed()
+        assert [i.id for i in completed] == [iid]
+        assert completed[0].evaluator_results == "MAP@10: 0.1"
+
+    def test_models_blob(self, memory_storage):
+        models = memory_storage.model_data_models()
+        models.insert(Model(id="i1", models=b"\x00\x01bytes"))
+        assert models.get("i1").models == b"\x00\x01bytes"
+        models.insert(Model(id="i1", models=b"replaced"))
+        assert models.get("i1").models == b"replaced"
+        assert models.delete("i1")
+        assert models.get("i1") is None
+
+
+class TestLEvents:
+    def test_insert_get_delete(self, memory_storage):
+        le = memory_storage.l_events()
+        e = ev("rate", properties=DataMap({"rating": 4.0}))
+        eid = le.insert(e, app_id=1)
+        got = le.get(eid, app_id=1)
+        assert got.properties.to_dict() == {"rating": 4.0}
+        assert le.get(eid, app_id=2) is None  # app isolation
+        assert le.delete(eid, app_id=1)
+        assert le.get(eid, app_id=1) is None
+
+    def test_find_filters(self, memory_storage):
+        le = memory_storage.l_events()
+        le.insert(ev("rate", "u1", ts(1)), app_id=1)
+        le.insert(ev("buy", "u1", ts(2)), app_id=1)
+        le.insert(ev("rate", "u2", ts(3)), app_id=1)
+        le.insert(ev("rate", "u9", ts(1)), app_id=2)
+
+        assert len(le.find(app_id=1)) == 3
+        assert len(le.find(app_id=1, event_names=["rate"])) == 2
+        assert len(le.find(app_id=1, entity_id="u1")) == 2
+        assert len(le.find(app_id=1, start_time=ts(2))) == 2
+        assert len(le.find(app_id=1, until_time=ts(2))) == 1
+        # time-ordered + reversed + limit
+        times = [e.event_time for e in le.find(app_id=1)]
+        assert times == sorted(times)
+        rev = le.find(app_id=1, reversed=True, limit=1)
+        assert rev[0].event_time == ts(3)
+
+    def test_channel_isolation(self, memory_storage):
+        le = memory_storage.l_events()
+        le.insert(ev("rate", "u1", ts(1)), app_id=1, channel_id=None)
+        le.insert(ev("rate", "u2", ts(2)), app_id=1, channel_id=7)
+        assert [e.entity_id for e in le.find(app_id=1)] == ["u1"]
+        assert [e.entity_id for e in le.find(app_id=1, channel_id=7)] == ["u2"]
+
+
+class TestEventStoreFacade:
+    def setup_app(self, storage, name="App1"):
+        app_id = storage.meta_apps().insert(App(id=0, name=name))
+        return app_id
+
+    def test_find_by_app_name(self, memory_storage):
+        app_id = self.setup_app(memory_storage)
+        memory_storage.l_events().insert(ev("rate"), app_id=app_id)
+        store = EventStore(memory_storage)
+        assert len(store.find("App1")) == 1
+        import pytest
+        with pytest.raises(ValueError):
+            store.find("NoSuchApp")
+
+    def test_aggregate_properties(self, memory_storage):
+        app_id = self.setup_app(memory_storage)
+        le = memory_storage.l_events()
+        le.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                        properties=DataMap({"a": 1}), event_time=ts(1)), app_id=app_id)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                        properties=DataMap({"b": 2}), event_time=ts(2)), app_id=app_id)
+        le.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                        properties=DataMap({"c": 3}), event_time=ts(1)), app_id=app_id)
+        store = EventStore(memory_storage)
+        props = store.aggregate_properties("App1", "user")
+        assert props["u1"].to_dict() == {"a": 1, "b": 2}
+        assert "i1" not in props
+        # required-keys filter
+        assert store.aggregate_properties("App1", "user", required=["missing"]) == {}
+
+    def test_sqlite_file_backend(self, tmp_path):
+        from predictionio_tpu.storage.registry import SourceConfig, Storage, StorageConfig
+        src = SourceConfig(name="F", type="sqlite", path=str(tmp_path / "pio.db"))
+        storage = Storage(StorageConfig(metadata=src, modeldata=src, eventdata=src))
+        app_id = storage.meta_apps().insert(App(id=0, name="FileApp"))
+        storage.l_events().insert(ev("rate"), app_id=app_id)
+        assert len(list(storage.l_events().find(app_id=app_id))) == 1
+        assert all(storage.verify_all_data_objects().values())
+        storage.close()
+
+
+class TestReviewRegressions:
+    """Regressions from the first code review."""
+
+    def test_subsecond_event_time_ordering(self, memory_storage):
+        from datetime import timedelta
+        le = memory_storage.l_events()
+        base = ts(1)
+        # event at +0.5s stored between whole-second events
+        le.insert(ev("a", "u1", base), app_id=1)
+        le.insert(ev("b", "u1", base + timedelta(microseconds=500000)), app_id=1)
+        le.insert(ev("c", "u1", base + timedelta(seconds=1)), app_id=1)
+        names = [e.event for e in le.find(app_id=1)]
+        assert names == ["a", "b", "c"]
+        # range filter at whole-second boundary must include the .5s event
+        got = le.find(app_id=1, start_time=base, until_time=base + timedelta(seconds=1))
+        assert [e.event for e in got] == ["a", "b"]
+
+    def test_get_delete_channel_scoped(self, memory_storage):
+        le = memory_storage.l_events()
+        eid = le.insert(ev("rate", "u1", ts(1)), app_id=1, channel_id=7)
+        assert le.get(eid, app_id=1) is None  # default channel must not see it
+        assert not le.delete(eid, app_id=1)
+        assert le.get(eid, app_id=1, channel_id=7) is not None
+        assert le.delete(eid, app_id=1, channel_id=7)
+
+    def test_access_key_duplicate_insert_returns_none(self, memory_storage):
+        keys = memory_storage.meta_access_keys()
+        k = AccessKey(key="fixed", app_id=1)
+        assert keys.insert(k) == "fixed"
+        assert keys.insert(AccessKey(key="fixed", app_id=2)) is None
