@@ -1,0 +1,317 @@
+"""Event server — REST ingest service.
+
+Parity with «data/.../data/api/EventServer.scala :: EventServer,
+EventServiceActor» (SURVEY.md §2.2/§3.3 [U]). Routes:
+
+    GET    /                              → {"status": "alive"}
+    POST   /events.json?accessKey=K[&channel=C]      → 201 {"eventId": ...}
+    GET    /events.json?accessKey=K&...filters...    → 200 [events]
+    GET    /events/<id>.json?accessKey=K             → 200 event | 404
+    DELETE /events/<id>.json?accessKey=K             → 200 | 404
+    POST   /batch/events.json?accessKey=K            → 200 [per-event results]
+    GET    /stats.json?accessKey=K                   → 200 (when --stats)
+    POST   /webhooks/<connector>.json?accessKey=K    → 201 (connector-mapped)
+
+Auth is by access key (query param or `Authorization` header), scoped to the
+key's app and optional event-name whitelist, exactly like the reference.
+The reference runs this on Akka + spray-can; a threaded stdlib HTTP server
+is the idiomatic zero-dependency Python equivalent — the TPU is never on
+this path, so throughput is bounded by SQLite writes, not the server.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.data.events import (
+    Event,
+    EventValidationError,
+    parse_time,
+    validate_event,
+)
+from predictionio_tpu.data.webhooks import get_connector
+from predictionio_tpu.storage.registry import Storage
+
+BATCH_LIMIT = 50  # reference rejects >50 events per batch POST [U]
+DEFAULT_FIND_LIMIT = 20
+
+
+class Stats:
+    """Per-app event counters (the reference's `Stats`/`StatsActor` [U]),
+    exposed at GET /stats.json. Counts (appId, event, status) since start."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Counter = Counter()
+        self.start_time = time.time()
+
+    def update(self, app_id: int, event_name: str, status: int) -> None:
+        with self._lock:
+            self._counts[(app_id, event_name, status)] += 1
+
+    def snapshot(self, app_id: int) -> dict:
+        with self._lock:
+            items = [
+                {"event": ev, "status": status, "count": n}
+                for (aid, ev, status), n in sorted(self._counts.items())
+                if aid == app_id
+            ]
+        return {"uptime_s": round(time.time() - self.start_time, 1), "counts": items}
+
+
+class EventServerConfig:
+    def __init__(self, ip: str = "0.0.0.0", port: int = 7070, stats: bool = False):
+        self.ip = ip
+        self.port = port
+        self.stats = stats
+
+
+class _EventHandler(BaseHTTPRequestHandler):
+    server_version = "pio-tpu-eventserver/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # injected by create_event_server
+    storage: Storage
+    stats: Optional[Stats]
+
+    def log_message(self, fmt, *args):  # silence default stderr chatter
+        pass
+
+    # -- helpers -----------------------------------------------------------
+    def _send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _query(self) -> dict[str, str]:
+        qs = parse_qs(urlparse(self.path).query)
+        return {k: v[0] for k, v in qs.items()}
+
+    def _auth(self, q: dict[str, str]):
+        """Resolve access key → (AccessKey, app_id, channel_id) or None."""
+        key = q.get("accessKey")
+        if key is None:
+            auth = self.headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                import base64
+
+                try:
+                    key = base64.b64decode(auth[6:]).decode().split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            return None
+        access_key = self.storage.meta_access_keys().get(key)
+        if access_key is None:
+            return None
+        channel_id = None
+        channel_name = q.get("channel")
+        if channel_name:
+            channels = {
+                c.name: c
+                for c in self.storage.meta_channels().get_by_app_id(access_key.app_id)
+            }
+            if channel_name not in channels:
+                return None
+            channel_id = channels[channel_name].id
+        return access_key, access_key.app_id, channel_id
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
+        event = Event.from_dict(d)
+        validate_event(event)
+        if access_key.events and event.event not in access_key.events:
+            raise EventValidationError(
+                f"event {event.event!r} is not allowed by this access key"
+            )
+        try:
+            eid = self.storage.l_events().insert(event, app_id, channel_id)
+        except sqlite3.IntegrityError as e:
+            raise EventValidationError(
+                f"duplicate eventId {event.event_id!r}"
+            ) from e
+        if self.stats:
+            self.stats.update(app_id, event.event, 201)
+        return eid
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        path = urlparse(self.path).path
+        q = self._query()
+        if path == "/":
+            return self._send_json(200, {"status": "alive"})
+        auth = self._auth(q)
+        if auth is None:
+            return self._send_json(401, {"message": "Invalid accessKey."})
+        access_key, app_id, channel_id = auth
+
+        if path == "/events.json":
+            try:
+                events = self.storage.l_events().find(
+                    app_id=app_id,
+                    channel_id=channel_id,
+                    start_time=parse_time(q["startTime"]) if "startTime" in q else None,
+                    until_time=parse_time(q["untilTime"]) if "untilTime" in q else None,
+                    entity_type=q.get("entityType"),
+                    entity_id=q.get("entityId"),
+                    event_names=[q["event"]] if "event" in q else None,
+                    target_entity_type=q.get("targetEntityType"),
+                    target_entity_id=q.get("targetEntityId"),
+                    limit=int(q.get("limit", DEFAULT_FIND_LIMIT)),
+                    reversed=q.get("reversed", "false").lower() == "true",
+                )
+            except (ValueError, EventValidationError) as e:
+                return self._send_json(400, {"message": str(e)})
+            return self._send_json(200, [e.to_dict() for e in events])
+
+        if path.startswith("/events/") and path.endswith(".json"):
+            eid = path[len("/events/") : -len(".json")]
+            event = self.storage.l_events().get(eid, app_id, channel_id)
+            if event is None:
+                return self._send_json(404, {"message": "Not Found"})
+            return self._send_json(200, event.to_dict())
+
+        if path == "/stats.json":
+            if self.stats is None:
+                return self._send_json(
+                    404, {"message": "To see stats, launch Event Server with --stats."}
+                )
+            return self._send_json(200, self.stats.snapshot(app_id))
+
+        return self._send_json(404, {"message": "Not Found"})
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        q = self._query()
+        # Drain the body before any early reply: with HTTP/1.1 keep-alive,
+        # unread body bytes would be parsed as the next request line.
+        body = self._read_body()
+        auth = self._auth(q)
+        if auth is None:
+            return self._send_json(401, {"message": "Invalid accessKey."})
+        access_key, app_id, channel_id = auth
+
+        if path == "/events.json":
+            try:
+                d = json.loads(body or b"{}")
+                eid = self._insert_event(d, access_key, app_id, channel_id)
+            except (EventValidationError, json.JSONDecodeError, ValueError) as e:
+                if self.stats:
+                    self.stats.update(app_id, "<invalid>", 400)
+                return self._send_json(400, {"message": str(e)})
+            return self._send_json(201, {"eventId": eid})
+
+        if path == "/batch/events.json":
+            try:
+                items = json.loads(body or b"[]")
+                if not isinstance(items, list):
+                    raise ValueError("batch body must be a JSON array")
+            except (json.JSONDecodeError, ValueError) as e:
+                return self._send_json(400, {"message": str(e)})
+            if len(items) > BATCH_LIMIT:
+                return self._send_json(
+                    400,
+                    {"message": f"Batch request must have less than or equal to "
+                                f"{BATCH_LIMIT} events"},
+                )
+            results = []
+            for d in items:
+                try:
+                    eid = self._insert_event(d, access_key, app_id, channel_id)
+                    results.append({"status": 201, "eventId": eid})
+                except (EventValidationError, ValueError) as e:
+                    results.append({"status": 400, "message": str(e)})
+            return self._send_json(200, results)
+
+        if path.startswith("/webhooks/") and path.endswith(".json"):
+            form = self.headers.get("Content-Type", "").startswith(
+                "application/x-www-form-urlencoded"
+            )
+            name = path[len("/webhooks/") : -len(".json")]
+            connector = get_connector(name, form=form)
+            if connector is None:
+                return self._send_json(404, {"message": f"Unknown connector {name!r}"})
+            try:
+                if form:
+                    payload = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+                else:
+                    payload = json.loads(body or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("webhook payload must be a JSON object")
+                event_dict = connector.to_event_dict(payload)
+                eid = self._insert_event(event_dict, access_key, app_id, channel_id)
+            except (EventValidationError, json.JSONDecodeError, ValueError, KeyError) as e:
+                return self._send_json(400, {"message": str(e)})
+            return self._send_json(201, {"eventId": eid})
+
+        return self._send_json(404, {"message": "Not Found"})
+
+    def do_DELETE(self):
+        path = urlparse(self.path).path
+        q = self._query()
+        self._read_body()  # drain for keep-alive correctness
+        auth = self._auth(q)
+        if auth is None:
+            return self._send_json(401, {"message": "Invalid accessKey."})
+        _, app_id, channel_id = auth
+        if path.startswith("/events/") and path.endswith(".json"):
+            eid = path[len("/events/") : -len(".json")]
+            ok = self.storage.l_events().delete(eid, app_id, channel_id)
+            if ok:
+                return self._send_json(200, {"message": "Found"})
+            return self._send_json(404, {"message": "Not Found"})
+        return self._send_json(404, {"message": "Not Found"})
+
+
+class EventServer:
+    """Owns the HTTP server thread; `create_event_server` is the reference's
+    factory spelling."""
+
+    def __init__(self, config: EventServerConfig, storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or Storage.get()
+        self.stats = Stats() if config.stats else None
+
+        handler = type(
+            "BoundEventHandler",
+            (_EventHandler,),
+            {"storage": self.storage, "stats": self.stats},
+        )
+        self.httpd = ThreadingHTTPServer((config.ip, config.port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def create_event_server(
+    config: Optional[EventServerConfig] = None, storage: Optional[Storage] = None
+) -> EventServer:
+    return EventServer(config or EventServerConfig(), storage)

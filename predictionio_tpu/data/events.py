@@ -91,6 +91,8 @@ class Event:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Event":
+        if not isinstance(d, dict):
+            raise EventValidationError("event must be a JSON object")
         try:
             event = d["event"]
             entity_type = d["entityType"]

@@ -121,6 +121,24 @@ def cmd_accesskey(args) -> int:
     return 1
 
 
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+
+    config = EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
+    try:
+        server = EventServer(config)
+    except OSError as e:
+        print(f"Cannot bind {args.ip}:{args.port}: {e.strerror or e}", file=sys.stderr)
+        return 1
+    print(f"Event Server listening on {args.ip}:{server.port} "
+          f"(stats={'on' if args.stats else 'off'})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
 def _not_wired(verb: str):
     def handler(args) -> int:
         print(
@@ -166,8 +184,13 @@ def build_parser() -> argparse.ArgumentParser:
     ak_del.add_argument("key")
     ak.set_defaults(func=cmd_accesskey)
 
+    es = sub.add_parser("eventserver")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+    es.set_defaults(func=cmd_eventserver)
+
     for verb in (
-        "eventserver",
         "build",
         "train",
         "deploy",

@@ -1,0 +1,230 @@
+"""Event server REST conformance — models the reference's
+`tests/pio_tests/scenarios/eventserver_test.py` behaviors (SURVEY.md §4.2):
+single + batch POST, auth failures, filters, channels, stats, webhooks."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.storage.base import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def server(memory_storage):
+    app_id = memory_storage.meta_apps().insert(App(id=0, name="TestApp"))
+    key = AccessKey.generate(app_id)
+    memory_storage.meta_access_keys().insert(key)
+    memory_storage.meta_channels().insert(Channel(id=0, name="ch1", app_id=app_id))
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+                      memory_storage)
+    srv.start()
+    yield srv, key.key
+    srv.shutdown()
+
+
+def call(srv, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+RATE = {"event": "rate", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1",
+        "properties": {"rating": 4.5}, "eventTime": "2026-01-01T00:00:00.000Z"}
+
+
+class TestEventServer:
+    def test_alive(self, server):
+        srv, _ = server
+        assert call(srv, "GET", "/")[0] == 200
+
+    def test_post_and_get_roundtrip(self, server):
+        srv, key = server
+        status, body = call(srv, "POST", f"/events.json?accessKey={key}", RATE)
+        assert status == 201
+        eid = body["eventId"]
+        status, got = call(srv, "GET", f"/events/{eid}.json?accessKey={key}")
+        assert status == 200
+        assert got["event"] == "rate" and got["properties"] == {"rating": 4.5}
+        # list with filters
+        status, events = call(
+            srv, "GET",
+            f"/events.json?accessKey={key}&event=rate&entityId=u1")
+        assert status == 200 and len(events) == 1
+
+    def test_auth_failures(self, server):
+        srv, _ = server
+        assert call(srv, "POST", "/events.json", RATE)[0] == 401
+        assert call(srv, "POST", "/events.json?accessKey=WRONG", RATE)[0] == 401
+        assert call(srv, "GET", "/events.json?accessKey=WRONG")[0] == 401
+
+    def test_validation_rejected(self, server):
+        srv, key = server
+        bad = {"event": "$unset", "entityType": "user", "entityId": "u1"}
+        status, body = call(srv, "POST", f"/events.json?accessKey={key}", bad)
+        assert status == 400
+        assert "properties" in body["message"]
+        # missing required field
+        status, _ = call(srv, "POST", f"/events.json?accessKey={key}",
+                         {"event": "x", "entityType": "user"})
+        assert status == 400
+
+    def test_batch(self, server):
+        srv, key = server
+        batch = [RATE, {"event": "$unset", "entityType": "user", "entityId": "u"},
+                 dict(RATE, entityId="u2")]
+        status, results = call(srv, "POST", f"/batch/events.json?accessKey={key}", batch)
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 400, 201]
+        # oversized batch rejected outright
+        status, _ = call(srv, "POST", f"/batch/events.json?accessKey={key}",
+                         [RATE] * 51)
+        assert status == 400
+
+    def test_delete(self, server):
+        srv, key = server
+        _, body = call(srv, "POST", f"/events.json?accessKey={key}", RATE)
+        eid = body["eventId"]
+        assert call(srv, "DELETE", f"/events/{eid}.json?accessKey={key}")[0] == 200
+        assert call(srv, "DELETE", f"/events/{eid}.json?accessKey={key}")[0] == 404
+        assert call(srv, "GET", f"/events/{eid}.json?accessKey={key}")[0] == 404
+
+    def test_channel_scoping(self, server):
+        srv, key = server
+        call(srv, "POST", f"/events.json?accessKey={key}&channel=ch1", RATE)
+        _, default_events = call(srv, "GET", f"/events.json?accessKey={key}")
+        assert default_events == []
+        _, ch_events = call(srv, "GET", f"/events.json?accessKey={key}&channel=ch1")
+        assert len(ch_events) == 1
+        # unknown channel → auth failure, like the reference
+        assert call(srv, "POST", f"/events.json?accessKey={key}&channel=nope",
+                    RATE)[0] == 401
+
+    def test_time_range_filter(self, server):
+        srv, key = server
+        for i, t in enumerate(["2026-01-01T00:00:00Z", "2026-01-02T00:00:00Z",
+                               "2026-01-03T00:00:00Z"]):
+            call(srv, "POST", f"/events.json?accessKey={key}",
+                 dict(RATE, entityId=f"u{i}", eventTime=t))
+        _, events = call(
+            srv, "GET",
+            f"/events.json?accessKey={key}"
+            "&startTime=2026-01-02T00:00:00Z&untilTime=2026-01-03T00:00:00Z")
+        assert [e["entityId"] for e in events] == ["u1"]
+        # reversed + limit
+        _, events = call(srv, "GET",
+                         f"/events.json?accessKey={key}&reversed=true&limit=1")
+        assert events[0]["entityId"] == "u2"
+
+    def test_event_whitelist_key(self, server, memory_storage):
+        srv, _ = server
+        app = memory_storage.meta_apps().get_by_name("TestApp")
+        limited = AccessKey.generate(app.id, events=["view"])
+        memory_storage.meta_access_keys().insert(limited)
+        status, body = call(srv, "POST", f"/events.json?accessKey={limited.key}", RATE)
+        assert status == 400 and "not allowed" in body["message"]
+        ok = dict(RATE, event="view")
+        assert call(srv, "POST", f"/events.json?accessKey={limited.key}", ok)[0] == 201
+
+    def test_stats(self, server):
+        srv, key = server
+        call(srv, "POST", f"/events.json?accessKey={key}", RATE)
+        status, body = call(srv, "GET", f"/stats.json?accessKey={key}")
+        assert status == 200
+        assert body["counts"] == [{"event": "rate", "status": 201, "count": 1}]
+
+
+class TestWebhooks:
+    def test_segmentio(self, server):
+        srv, key = server
+        payload = {"type": "track", "userId": "u42", "event": "Signed Up",
+                   "properties": {"plan": "pro"},
+                   "timestamp": "2026-01-01T00:00:00Z"}
+        status, body = call(srv, "POST", f"/webhooks/segmentio.json?accessKey={key}",
+                            payload)
+        assert status == 201
+        _, got = call(srv, "GET", f"/events/{body['eventId']}.json?accessKey={key}")
+        assert got["event"] == "track" and got["entityId"] == "u42"
+        assert got["properties"]["plan"] == "pro"
+
+    def test_segmentio_bad_type(self, server):
+        srv, key = server
+        status, _ = call(srv, "POST", f"/webhooks/segmentio.json?accessKey={key}",
+                         {"type": "bogus", "userId": "u"})
+        assert status == 400
+
+    def test_mailchimp_form(self, server):
+        srv, key = server
+        form = ("type=subscribe&fired_at=2026-01-01 00:00:00"
+                "&data[id]=abc123&data[email]=a@b.c&data[list_id]=L1")
+        url = f"http://127.0.0.1:{srv.port}/webhooks/mailchimp.json?accessKey={key}"
+        req = urllib.request.Request(
+            url, data=form.encode(), method="POST",
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 201
+        _, events = call(srv, "GET",
+                         f"/events.json?accessKey={key}&event=subscribe")
+        assert events[0]["properties"]["email"] == "a@b.c"
+
+    def test_unknown_connector(self, server):
+        srv, key = server
+        assert call(srv, "POST", f"/webhooks/none.json?accessKey={key}", {})[0] == 404
+
+
+class TestReviewRegressions:
+    """Regressions from the event-server code review."""
+
+    def test_non_dict_bodies_return_400(self, server):
+        srv, key = server
+        for bad in (42, "x", [1, 2]):
+            status, _ = call(srv, "POST", f"/events.json?accessKey={key}", bad)
+            assert status == 400
+        # batch with a non-dict item: others still insert, item gets 400
+        status, results = call(srv, "POST", f"/batch/events.json?accessKey={key}",
+                               [RATE, 5])
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 400]
+        # webhook with non-dict payload
+        status, _ = call(srv, "POST", f"/webhooks/segmentio.json?accessKey={key}", [])
+        assert status == 400
+
+    def test_duplicate_event_id_returns_400(self, server):
+        srv, key = server
+        with_id = dict(RATE, eventId="fixed-id")
+        assert call(srv, "POST", f"/events.json?accessKey={key}", with_id)[0] == 201
+        status, body = call(srv, "POST", f"/events.json?accessKey={key}", with_id)
+        assert status == 400 and "duplicate" in body["message"]
+
+    def test_keepalive_after_401_post(self, server):
+        import http.client
+        srv, _ = server
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+        payload = json.dumps(RATE)
+        conn.request("POST", "/events.json", body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 401
+        resp.read()
+        # second request on the SAME connection must not see leftover body bytes
+        conn.request("GET", "/")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["status"] == "alive"
+        conn.close()
+
+    def test_port_in_use_clean_error(self, server, capsys):
+        from predictionio_tpu.tools.console import main
+        srv, _ = server
+        rc = main(["eventserver", "--ip", "127.0.0.1", "--port", str(srv.port)])
+        assert rc == 1
+        assert "Cannot bind" in capsys.readouterr().err
