@@ -1,0 +1,100 @@
+"""Controller API — the DASE abstraction (Data source, Algorithm, Serving,
+Evaluation), the framework's public face.
+
+Parity with «core/.../controller/» (SURVEY.md §2.1 [U]): `Engine`,
+`EngineFactory`, `EngineParams`, `PDataSource`/`LDataSource`,
+`PPreparator`, `P2LAlgorithm`/`PAlgorithm`/`LAlgorithm`, `LServing`,
+`Evaluation`, `Metric`, `Params`, `PersistentModel`, `SanityCheck`.
+
+TPU-first redesign notes (SURVEY.md §7.1):
+- The reference's P (RDD/parallel) vs L (local) split collapses: training
+  data is host-side numpy handed to jitted, mesh-sharded XLA programs, so
+  one `DataSource`/`Algorithm` API serves both roles. Aliases with the
+  reference names are provided for familiarity.
+- `Algorithm.train` should be a pure function of (ctx, prepared_data) whose
+  heavy lifting is `jax.jit`-ed under `ctx.mesh`; models are pytrees (or
+  pickleable host objects wrapping them).
+- Reflective `Doer` instantiation survives as `Doer(cls, params)`.
+"""
+
+from predictionio_tpu.controller.params import (
+    EmptyParams,
+    Params,
+    params_from_dict,
+    params_to_dict,
+)
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.base import (
+    Algorithm,
+    DataSource,
+    Doer,
+    LAlgorithm,
+    LDataSource,
+    LPreparator,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    PDataSource,
+    PPreparator,
+    PersistentModel,
+    PersistentModelLoader,
+    Preparator,
+    SanityCheck,
+    Serving,
+    FirstServing,
+    AverageServing,
+    IdentityPreparator,
+)
+from predictionio_tpu.controller.engine import Engine, EngineFactory, EngineParams
+from predictionio_tpu.controller.metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+)
+
+__all__ = [
+    "Params",
+    "EmptyParams",
+    "params_from_dict",
+    "params_to_dict",
+    "WorkflowContext",
+    "DataSource",
+    "PDataSource",
+    "LDataSource",
+    "Preparator",
+    "PPreparator",
+    "LPreparator",
+    "IdentityPreparator",
+    "Algorithm",
+    "P2LAlgorithm",
+    "PAlgorithm",
+    "LAlgorithm",
+    "Serving",
+    "LServing",
+    "FirstServing",
+    "AverageServing",
+    "PersistentModel",
+    "PersistentModelLoader",
+    "SanityCheck",
+    "Doer",
+    "Engine",
+    "EngineFactory",
+    "EngineParams",
+    "Metric",
+    "AverageMetric",
+    "OptionAverageMetric",
+    "StdevMetric",
+    "SumMetric",
+    "ZeroMetric",
+    "Evaluation",
+    "MetricEvaluator",
+    "EngineParamsGenerator",
+]

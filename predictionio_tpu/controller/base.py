@@ -1,0 +1,185 @@
+"""DASE component base classes.
+
+Parity with «core/.../core/Base*.scala» + «core/.../controller/*» (SURVEY.md
+§2.1 [U]). The reference's P*/L* split (RDD vs local JVM) collapses on TPU
+(see package docstring); `P2LAlgorithm`, `PAlgorithm`, `LAlgorithm`,
+`PDataSource`, ... are kept as aliases so template code reads like the
+originals.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Generic, Optional, Sequence, Type, TypeVar
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.params import Params
+
+log = logging.getLogger(__name__)
+
+TD = TypeVar("TD")  # training data
+PD = TypeVar("PD")  # prepared data
+M = TypeVar("M")  # model
+Q = TypeVar("Q")  # query
+R = TypeVar("R")  # predicted result
+A = TypeVar("A")  # actual result
+
+
+class Doer:
+    """Reflective component instantiation («core/.../core/AbstractDoer ::
+    Doer.apply» [U]): constructs a DASE component class with its Params.
+
+    Components take their params object as the single constructor arg;
+    components with no params may omit the constructor entirely.
+    """
+
+    @staticmethod
+    def apply(cls: Type, params: Optional[Params] = None):
+        if params is None:
+            return cls()
+        # Inspect rather than try/except: a TypeError raised *inside* a
+        # valid constructor must not silently drop the user's params.
+        import inspect
+
+        try:
+            sig = inspect.signature(cls)
+            takes_params = len(sig.parameters) >= 1
+        except (TypeError, ValueError):
+            takes_params = True
+        if not takes_params:
+            raise TypeError(
+                f"{cls.__name__} declares params but its constructor takes no "
+                "arguments; accept the params object in __init__."
+            )
+        return cls(params)
+
+
+class DataSource(abc.ABC, Generic[TD, Q, A]):
+    """Reads training data from the event store.
+
+    `read_training` ≈ `PDataSource.readTraining(sc)` [U]; `read_eval` ≈
+    `readEval` — returns k (training data, [(query, actual)]) folds.
+    """
+
+    @abc.abstractmethod
+    def read_training(self, ctx: WorkflowContext) -> TD: ...
+
+    def read_eval(
+        self, ctx: WorkflowContext
+    ) -> list[tuple[TD, Sequence[tuple[Q, A]]]]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; "
+            "evaluation is unavailable for this engine."
+        )
+
+
+class Preparator(abc.ABC, Generic[TD, PD]):
+    """`PPreparator.prepare` [U]: TrainingData → PreparedData (feature
+    extraction, id indexing, device-ready array packing)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: WorkflowContext, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator):
+    def prepare(self, ctx: WorkflowContext, training_data):
+        return training_data
+
+
+class Algorithm(abc.ABC, Generic[PD, M, Q, R]):
+    """`P2LAlgorithm`/`PAlgorithm`/`LAlgorithm` collapsed [U].
+
+    `train` should build jitted XLA programs under `ctx.mesh`; `predict`
+    serves one query from an in-memory model (the serving hot path);
+    `batch_predict` is the bulk-scoring path used by evaluation
+    (`batchPredictBase` [U]) — override it with a vmapped/jitted version
+    for speed, the default just loops `predict`.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx: WorkflowContext, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> R: ...
+
+    def batch_predict(self, model: M, queries: Sequence[Q]) -> list[R]:
+        return [self.predict(model, q) for q in queries]
+
+
+class Serving(abc.ABC, Generic[Q, R]):
+    """`LServing.serve` [U]: combine per-algorithm predictions into one."""
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[R]) -> R: ...
+
+
+class FirstServing(Serving):
+    """`LFirstServing` [U]."""
+
+    def serve(self, query, predictions):
+        if not predictions:
+            raise ValueError("No predictions to serve.")
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """`LAverageServing` [U] — averages numeric predictions."""
+
+    def serve(self, query, predictions):
+        if not predictions:
+            raise ValueError("No predictions to serve.")
+        return sum(predictions) / len(predictions)
+
+
+class PersistentModel(abc.ABC):
+    """Models that persist themselves («controller/PersistentModel.scala»
+    [U]) — e.g. large factor matrices checkpointed via orbax — instead of
+    being pickled into the Models blob store.
+
+    `save` returns True if the model handled its own persistence. The
+    class must also provide `load(id, params)` (the reference's
+    `PersistentModelLoader.apply`).
+    """
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Params) -> bool: ...
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params) -> "PersistentModel": ...
+
+
+class PersistentModelLoader:
+    """Dispatch helper mirroring the reference loader object [U]."""
+
+    @staticmethod
+    def apply(cls: Type[PersistentModel], instance_id: str, params: Params):
+        return cls.load(instance_id, params)
+
+
+class SanityCheck(abc.ABC):
+    """Optional hook («controller/SanityCheck.scala» [U]): training/prepared
+    data and models may self-check after each DASE stage (unless
+    --skip-sanity-check)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None: ...
+
+
+def run_sanity_check(obj: Any, stage: str) -> None:
+    if isinstance(obj, SanityCheck):
+        log.info("SanityCheck %s (%s)", stage, type(obj).__name__)
+        obj.sanity_check()
+
+
+# Reference-spelling aliases (P = parallel/RDD, L = local in the original;
+# one implementation here — SURVEY.md §7.1).
+PDataSource = DataSource
+LDataSource = DataSource
+PPreparator = Preparator
+LPreparator = Preparator
+P2LAlgorithm = Algorithm
+PAlgorithm = Algorithm
+LAlgorithm = Algorithm
+LServing = Serving

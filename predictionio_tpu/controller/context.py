@@ -1,0 +1,73 @@
+"""WorkflowContext — the rebuild's SparkContext analogue.
+
+Parity with «core/.../workflow/WorkflowContext» (SURVEY.md §2.1 [U]): where
+the reference builds a `SparkConf`/`SparkContext` and threads it through
+every DASE call, we thread a context carrying the JAX device mesh, a PRNG
+seed, workflow params, and storage access. jax is imported lazily so
+storage-only processes (event server, CLI metadata verbs) never pay for it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any, Optional
+
+if TYPE_CHECKING:
+    import jax
+
+log = logging.getLogger(__name__)
+
+
+class WorkflowContext:
+    def __init__(
+        self,
+        mesh_shape: Optional[dict[str, int]] = None,
+        seed: int = 0,
+        batch: str = "",
+        verbose: int = 0,
+        storage: Optional[Any] = None,
+    ):
+        """Args:
+        mesh_shape: axis name → size, e.g. ``{"data": 4, "model": 2}``.
+            None = use all local devices on the ``data`` axis.
+        seed: base PRNG seed for all algorithms in this run.
+        batch: human-readable run label (the reference's `--batch`).
+        verbose: debug verbosity (the reference's WorkflowParams.verbose).
+        storage: Storage registry override (defaults to the process one).
+        """
+        self.mesh_shape = mesh_shape
+        self.seed = seed
+        self.batch = batch
+        self.verbose = verbose
+        self._storage = storage
+        self._mesh: Optional["jax.sharding.Mesh"] = None
+
+    @property
+    def storage(self):
+        if self._storage is None:
+            from predictionio_tpu.storage.registry import Storage
+
+            self._storage = Storage.get()
+        return self._storage
+
+    @property
+    def mesh(self) -> "jax.sharding.Mesh":
+        """The device mesh, built on first use (SURVEY.md §2.6/§2.7: axes
+        `data` and `model` are the two parallelism dimensions PredictionIO
+        capability parity needs)."""
+        if self._mesh is None:
+            from predictionio_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(self.mesh_shape)
+        return self._mesh
+
+    def rng(self, salt: int = 0) -> "jax.Array":
+        import jax
+
+        return jax.random.key(self.seed + salt)
+
+    def __repr__(self) -> str:
+        return (
+            f"WorkflowContext(mesh_shape={self.mesh_shape}, seed={self.seed}, "
+            f"batch={self.batch!r})"
+        )

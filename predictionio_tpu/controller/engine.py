@@ -1,0 +1,218 @@
+"""Engine: binds DASE component classes + params into a trainable,
+deployable unit.
+
+Parity with «core/.../controller/Engine.scala :: Engine» (SURVEY.md §2.1
+[U]): holds `dataSourceClassMap`-style name→class maps, `train` runs the
+DASE pipeline, `eval` runs per-fold train+batch-predict, and
+`prepare_deploy` reloads persisted models for serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+from typing import Any, Optional, Sequence, Type
+
+from predictionio_tpu.controller.base import (
+    Algorithm,
+    DataSource,
+    Doer,
+    FirstServing,
+    PersistentModel,
+    Preparator,
+    Serving,
+    IdentityPreparator,
+    run_sanity_check,
+)
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.params import Params, params_from_dict
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """«controller/EngineParams» [U]: per-component (name, params) selections."""
+
+    data_source_name: str = ""
+    data_source_params: Optional[Params] = None
+    preparator_name: str = ""
+    preparator_params: Optional[Params] = None
+    # list of (algorithm name, params) — multiple algorithms train together
+    # and serve together through Serving (SURVEY.md §2.6 strategy 4)
+    algorithm_params_list: list[tuple[str, Optional[Params]]] = dataclasses.field(
+        default_factory=lambda: [("", None)]
+    )
+    serving_name: str = ""
+    serving_params: Optional[Params] = None
+
+
+class Engine:
+    def __init__(
+        self,
+        data_source_class_map: dict[str, Type[DataSource]] | Type[DataSource],
+        preparator_class_map: dict[str, Type[Preparator]] | Type[Preparator] | None = None,
+        algorithm_class_map: dict[str, Type[Algorithm]] | Type[Algorithm] = None,
+        serving_class_map: dict[str, Type[Serving]] | Type[Serving] | None = None,
+    ):
+        def as_map(x, default_cls=None):
+            if x is None:
+                return {"": default_cls}
+            if isinstance(x, dict):
+                return x
+            return {"": x}
+
+        self.data_source_class_map = as_map(data_source_class_map)
+        self.preparator_class_map = as_map(preparator_class_map, IdentityPreparator)
+        self.algorithm_class_map = as_map(algorithm_class_map)
+        self.serving_class_map = as_map(serving_class_map, FirstServing)
+
+    # -- component resolution ---------------------------------------------
+    def _cls(self, class_map: dict, name: str, role: str) -> Type:
+        if name not in class_map:
+            # single-entry maps accept any name for convenience, mirroring
+            # the reference's default "" keys
+            if len(class_map) == 1 and "" in class_map:
+                return class_map[""]
+            raise KeyError(f"Unknown {role} name {name!r} (have {sorted(class_map)})")
+        return class_map[name]
+
+    def components(self, engine_params: EngineParams):
+        ds = Doer.apply(
+            self._cls(self.data_source_class_map, engine_params.data_source_name,
+                      "data source"),
+            engine_params.data_source_params,
+        )
+        prep = Doer.apply(
+            self._cls(self.preparator_class_map, engine_params.preparator_name,
+                      "preparator"),
+            engine_params.preparator_params,
+        )
+        algos = [
+            (
+                name,
+                Doer.apply(self._cls(self.algorithm_class_map, name, "algorithm"),
+                           params),
+            )
+            for name, params in engine_params.algorithm_params_list
+        ]
+        serving = Doer.apply(
+            self._cls(self.serving_class_map, engine_params.serving_name, "serving"),
+            engine_params.serving_params,
+        )
+        return ds, prep, algos, serving
+
+    # -- train (CoreWorkflow.runTrain inner loop, SURVEY.md §3.1) ----------
+    def train(
+        self,
+        ctx: WorkflowContext,
+        engine_params: EngineParams,
+        sanity_check: bool = False,
+    ) -> list[Any]:
+        ds, prep, algos, _ = self.components(engine_params)
+        log.info("Engine.train: reading training data (%s)", type(ds).__name__)
+        td = ds.read_training(ctx)
+        if sanity_check:
+            run_sanity_check(td, "training data")
+        log.info("Engine.train: preparing data (%s)", type(prep).__name__)
+        pd = prep.prepare(ctx, td)
+        if sanity_check:
+            run_sanity_check(pd, "prepared data")
+        models = []
+        for name, algo in algos:
+            log.info("Engine.train: training algorithm %r (%s)",
+                     name, type(algo).__name__)
+            model = algo.train(ctx, pd)
+            if sanity_check:
+                run_sanity_check(model, f"model[{name}]")
+            models.append(model)
+        return models
+
+    # -- eval (Engine.eval, SURVEY.md §3.4) --------------------------------
+    def eval(
+        self, ctx: WorkflowContext, engine_params: EngineParams
+    ) -> list[tuple[Any, list[tuple[Any, Any, Any]]]]:
+        """Per fold: train on the fold's training split, batch-predict its
+        queries. Returns [(fold_td, [(query, predicted, actual), ...])]."""
+        ds, prep, algos, serving = self.components(engine_params)
+        folds = ds.read_eval(ctx)
+        results = []
+        for i, (td, qa_pairs) in enumerate(folds):
+            log.info("Engine.eval: fold %d/%d (%d queries)",
+                     i + 1, len(folds), len(qa_pairs))
+            pd = prep.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for _, algo in algos]
+            queries = [q for q, _ in qa_pairs]
+            per_algo = [
+                algo.batch_predict(model, queries)
+                for (_, algo), model in zip(algos, models)
+            ]
+            qpa = [
+                (q, serving.serve(q, [preds[j] for preds in per_algo]), a)
+                for j, (q, a) in enumerate(qa_pairs)
+            ]
+            results.append((td, qpa))
+        return results
+
+    # -- model persistence (Engine.makeSerializableModels / prepareDeploy,
+    #    SURVEY.md §3.1/§3.2) ----------------------------------------------
+    def serialize_models(
+        self, models: Sequence[Any], instance_id: str, engine_params: EngineParams
+    ) -> bytes:
+        """PersistentModel models save themselves and leave a marker; all
+        others are pickled into the blob."""
+        out = []
+        for model, (name, algo_params) in zip(models, engine_params.algorithm_params_list):
+            if isinstance(model, PersistentModel):
+                saved = model.save(instance_id, algo_params)
+                if saved:
+                    out.append(("__persistent__", type(model).__module__,
+                                type(model).__qualname__))
+                    continue
+            out.append(("__pickled__", model, None))
+        return pickle.dumps(out)
+
+    def deserialize_models(
+        self, blob: bytes, instance_id: str, engine_params: EngineParams
+    ) -> list[Any]:
+        import importlib
+
+        entries = pickle.loads(blob)
+        models = []
+        for entry, (name, algo_params) in zip(entries, engine_params.algorithm_params_list):
+            kind, a, b = entry
+            if kind == "__persistent__":
+                module, qualname = a, b
+                cls = importlib.import_module(module)
+                for part in qualname.split("."):
+                    cls = getattr(cls, part)
+                models.append(cls.load(instance_id, algo_params))
+            else:
+                models.append(a)
+        return models
+
+    # -- serving-time prediction (ServerActor route, SURVEY.md §3.2) -------
+    def predict(
+        self,
+        engine_params: EngineParams,
+        models: Sequence[Any],
+        query: Any,
+    ) -> Any:
+        _, _, algos, serving = self.components(engine_params)
+        predictions = [
+            algo.predict(model, query) for (_, algo), model in zip(algos, models)
+        ]
+        return serving.serve(query, predictions)
+
+
+class EngineFactory:
+    """«controller/EngineFactory» [U]: subclass and implement `apply()`
+    returning an Engine; referenced by dotted path in engine.json."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError
+
+    # Engine.json shape helpers: subclasses may override to map params
+    # blocks to their Params dataclasses.
+    params_classes: dict[str, type] = {}

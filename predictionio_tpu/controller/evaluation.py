@@ -1,0 +1,129 @@
+"""Evaluation + MetricEvaluator: offline param-grid search.
+
+Parity with «core/.../controller/{Evaluation,MetricEvaluator,
+EngineParamsGenerator}.scala» (SURVEY.md §2.1 [U]): an Evaluation binds an
+engine to metrics; an EngineParamsGenerator yields the params grid; the
+MetricEvaluator scores every (engine params, fold) combination and ranks
+engine params by the primary metric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Optional, Sequence
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.metrics import Metric
+from predictionio_tpu.controller.params import params_to_dict
+
+log = logging.getLogger(__name__)
+
+
+class Evaluation:
+    """Subclass and set `engine` + `metric` (and optionally `metrics` for
+    secondary metrics)."""
+
+    engine: Engine
+    metric: Metric
+    metrics: Sequence[Metric] = ()
+
+    def all_metrics(self) -> list[Metric]:
+        return [self.metric, *self.metrics]
+
+
+class EngineParamsGenerator:
+    """Subclass and set `engine_params_list`."""
+
+    engine_params_list: Sequence[EngineParams]
+
+
+@dataclasses.dataclass
+class MetricScores:
+    engine_params: EngineParams
+    scores: dict[str, float]  # metric name → aggregated value
+    per_fold: list[dict[str, float]]
+
+
+@dataclasses.dataclass
+class EvaluationResult:
+    best: MetricScores
+    all_results: list[MetricScores]
+    metric_name: str
+
+    def to_json(self) -> str:
+        def ep_dict(ep: EngineParams) -> dict:
+            return {
+                "dataSource": params_to_dict(ep.data_source_params) if ep.data_source_params else {},
+                "preparator": params_to_dict(ep.preparator_params) if ep.preparator_params else {},
+                "algorithms": [
+                    {"name": name, "params": params_to_dict(p) if p else {}}
+                    for name, p in ep.algorithm_params_list
+                ],
+                "serving": params_to_dict(ep.serving_params) if ep.serving_params else {},
+            }
+
+        return json.dumps(
+            {
+                "metric": self.metric_name,
+                "bestScore": self.best.scores[self.metric_name],
+                "bestEngineParams": ep_dict(self.best.engine_params),
+                "results": [
+                    {"engineParams": ep_dict(r.engine_params), "scores": r.scores}
+                    for r in self.all_results
+                ],
+            },
+            indent=2,
+        )
+
+    def summary(self) -> str:
+        lines = [f"Metric: {self.metric_name}"]
+        for r in self.all_results:
+            marker = " <= BEST" if r is self.best else ""
+            lines.append(f"  score={r.scores[self.metric_name]:.6f}{marker}")
+        return "\n".join(lines)
+
+
+class MetricEvaluator:
+    """`MetricEvaluator.evaluateBase` [U]."""
+
+    @staticmethod
+    def evaluate(
+        ctx: WorkflowContext,
+        evaluation: Evaluation,
+        engine_params_list: Sequence[EngineParams],
+    ) -> EvaluationResult:
+        if not engine_params_list:
+            raise ValueError("No engine params to evaluate (empty generator list).")
+        engine = evaluation.engine
+        metrics = evaluation.all_metrics()
+        primary = metrics[0]
+        all_results: list[MetricScores] = []
+        for i, ep in enumerate(engine_params_list):
+            log.info("MetricEvaluator: engine params %d/%d", i + 1,
+                     len(engine_params_list))
+            fold_results = engine.eval(ctx, ep)
+            per_fold: list[dict[str, float]] = []
+            for _, qpa in fold_results:
+                fold_scores = {}
+                for metric in metrics:
+                    scores = [metric.calculate(q, p, a) for q, p, a in qpa]
+                    fold_scores[metric.name] = metric.aggregate(scores)
+                per_fold.append(fold_scores)
+            agg = {
+                m.name: (
+                    sum(f[m.name] for f in per_fold) / len(per_fold)
+                    if per_fold
+                    else float("nan")
+                )
+                for m in metrics
+            }
+            all_results.append(MetricScores(ep, agg, per_fold))
+        best = all_results[0]
+        for r in all_results[1:]:
+            if primary.compare(r.scores[primary.name], best.scores[primary.name]) > 0:
+                best = r
+        return EvaluationResult(best=best, all_results=all_results,
+                                metric_name=primary.name)

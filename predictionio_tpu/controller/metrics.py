@@ -1,0 +1,77 @@
+"""Metrics for offline evaluation.
+
+Parity with «core/.../controller/Metric.scala» (SURVEY.md §2.1 [U]):
+`Metric` (calculate per (query, predicted, actual) point + aggregate),
+`AverageMetric`, `OptionAverageMetric` (skips None points), `StdevMetric`,
+`SumMetric`, `ZeroMetric`.
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import Any, Generic, Optional, Sequence, TypeVar
+
+Q = TypeVar("Q")
+R = TypeVar("R")
+A = TypeVar("A")
+
+
+class Metric(abc.ABC, Generic[Q, R, A]):
+    #: higher is better by default; metrics like RMSE set False
+    higher_is_better: bool = True
+
+    @abc.abstractmethod
+    def calculate(self, query: Q, predicted: R, actual: A) -> Optional[float]:
+        """Score one evaluation point. None = excluded (OptionAverage)."""
+
+    def aggregate(self, scores: Sequence[Optional[float]]) -> float:
+        """Combine per-point scores into the metric value."""
+        vals = [s for s in scores if s is not None]
+        if not vals:
+            return float("nan")
+        return sum(vals) / len(vals)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def compare(self, a: float, b: float) -> int:
+        """>0 if a better than b."""
+        if math.isnan(a):
+            return -1
+        if math.isnan(b):
+            return 1
+        d = a - b if self.higher_is_better else b - a
+        return (d > 0) - (d < 0)
+
+
+class AverageMetric(Metric[Q, R, A], abc.ABC):
+    """Mean of per-point scores (None treated as 0 contribution excluded —
+    the reference's AverageMetric requires all points; keep the tolerant
+    aggregate, matching observed template usage)."""
+
+
+class OptionAverageMetric(Metric[Q, R, A], abc.ABC):
+    """Mean over points where calculate() returns a value [U]."""
+
+
+class SumMetric(Metric[Q, R, A], abc.ABC):
+    def aggregate(self, scores: Sequence[Optional[float]]) -> float:
+        return float(sum(s for s in scores if s is not None))
+
+
+class StdevMetric(Metric[Q, R, A], abc.ABC):
+    def aggregate(self, scores: Sequence[Optional[float]]) -> float:
+        vals = [s for s in scores if s is not None]
+        if len(vals) < 2:
+            return 0.0
+        mean = sum(vals) / len(vals)
+        return math.sqrt(sum((v - mean) ** 2 for v in vals) / (len(vals) - 1))
+
+
+class ZeroMetric(Metric[Any, Any, Any]):
+    """Always 0 — placeholder secondary metric [U]."""
+
+    def calculate(self, query, predicted, actual) -> float:
+        return 0.0

@@ -1,0 +1,67 @@
+"""Component parameter classes + JSON extraction.
+
+Parity with «core/.../controller/Params.scala» and
+«core/.../workflow/WorkflowUtils.scala :: extractParams» (SURVEY.md §2.1
+[U]). The reference extracts engine.json `params` blocks into Scala case
+classes via json4s reflection; here `Params` subclasses are dataclasses and
+extraction is `params_from_dict`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Type, TypeVar
+
+log = logging.getLogger(__name__)
+
+P = TypeVar("P", bound="Params")
+
+
+class Params:
+    """Marker base class for component parameters. Subclasses should be
+    ``@dataclasses.dataclass``-decorated."""
+
+
+@dataclasses.dataclass
+class EmptyParams(Params):
+    pass
+
+
+class ParamsError(ValueError):
+    """Raised when an engine.json params block doesn't match the Params class."""
+
+
+def params_from_dict(cls: Type[P], d: dict[str, Any]) -> P:
+    """Instantiate a Params dataclass from a JSON dict.
+
+    Unknown keys are an error (matching the reference's strict json4s
+    extraction — a typo in engine.json should not silently train with
+    defaults); missing keys fall back to dataclass defaults, and missing
+    keys without defaults raise.
+    """
+    if d is None:
+        d = {}
+    if not dataclasses.is_dataclass(cls):
+        if d:
+            raise ParamsError(
+                f"{cls.__name__} is not a dataclass but params {sorted(d)} were given"
+            )
+        return cls()
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - field_names
+    if unknown:
+        raise ParamsError(
+            f"Unknown parameter(s) {sorted(unknown)} for {cls.__name__} "
+            f"(accepted: {sorted(field_names)})"
+        )
+    try:
+        return cls(**d)
+    except TypeError as e:
+        raise ParamsError(f"Cannot build {cls.__name__} from {d!r}: {e}") from e
+
+
+def params_to_dict(params: Params) -> dict[str, Any]:
+    if dataclasses.is_dataclass(params):
+        return dataclasses.asdict(params)
+    return {}

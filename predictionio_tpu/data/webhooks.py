@@ -87,8 +87,9 @@ class MailChimpConnector(FormConnector):
         )
         if not entity_id:
             raise ValueError("there is no data[id]/data[email] in the payload.")
+        # data[merges][EMAIL] → "merges.EMAIL"; data[email] → "email"
         properties = {
-            k[len("data[") : -1]: v
+            k[len("data[") : -1].replace("][", "."): v
             for k, v in payload.items()
             if k.startswith("data[") and k.endswith("]")
         }

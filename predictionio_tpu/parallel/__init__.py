@@ -1,0 +1,24 @@
+"""Parallelism layer: device mesh, shardings, collectives, multi-host init.
+
+The rebuild's replacement for the reference's Spark shuffle + Akka RPC
+communication backend (SURVEY.md §2.7): XLA collectives over ICI/DCN under
+`jit`/`shard_map`, with `jax.distributed` as the multi-host control plane.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    host_shard,
+    make_mesh,
+    named_sharding,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "named_sharding",
+    "replicated",
+    "host_shard",
+]

@@ -139,6 +139,66 @@ def cmd_eventserver(args) -> int:
     return 0
 
 
+def cmd_build(args) -> int:
+    """`pio build` [U]. There is no sbt: building = validating that the
+    engine.json parses, the factory resolves, and params extract cleanly."""
+    from predictionio_tpu.workflow.workflow_utils import (
+        extract_engine_params,
+        get_engine,
+        read_engine_json,
+    )
+
+    try:
+        variant = read_engine_json(args.engine_json)
+        engine = get_engine(variant.engine_factory)
+        extract_engine_params(engine, variant)
+    except Exception as e:
+        print(f"Engine build failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Engine {variant.id!r} ({variant.engine_factory}) is ready for training.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import run_train
+
+    try:
+        instance = run_train(
+            engine_json=args.engine_json,
+            engine_version=args.engine_version,
+            batch=args.batch,
+            seed=args.seed,
+            mesh=args.mesh,
+            skip_sanity_check=args.skip_sanity_check,
+            verbose=args.verbose,
+        )
+    except FileNotFoundError as e:
+        print(f"Cannot read engine variant: {e}", file=sys.stderr)
+        return 1
+    print(f"Training completed. Engine instance ID: {instance.id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.workflow.create_workflow import run_evaluation
+
+    try:
+        instance, result = run_evaluation(
+            evaluation_class=args.evaluation_class,
+            generator_class=args.generator_class,
+            batch=args.batch,
+            seed=args.seed,
+            mesh=args.mesh,
+            verbose=args.verbose,
+        )
+    except (ImportError, AttributeError, ValueError, TypeError) as e:
+        print(f"Evaluation failed: {e}", file=sys.stderr)
+        return 1
+    print(result.summary())
+    print(f"Evaluation completed. Instance ID: {instance.id}")
+    return 0
+
+
 def _not_wired(verb: str):
     def handler(args) -> int:
         print(
@@ -190,11 +250,33 @@ def build_parser() -> argparse.ArgumentParser:
     es.add_argument("--stats", action="store_true")
     es.set_defaults(func=cmd_eventserver)
 
+    build = sub.add_parser("build")
+    build.add_argument("--engine-json", default="engine.json")
+    build.set_defaults(func=cmd_build)
+
+    def add_run_args(sp):
+        sp.add_argument("--batch", default="")
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--mesh", default=None,
+                        help="device mesh spec, e.g. data=4,model=2")
+        sp.add_argument("--verbose", type=int, default=0)
+
+    train = sub.add_parser("train")
+    train.add_argument("--engine-json", default="engine.json",
+                       help="engine variant file (the reference's --variant)")
+    train.add_argument("--engine-version", default="1")
+    add_run_args(train)
+    train.add_argument("--skip-sanity-check", action="store_true")
+    train.set_defaults(func=cmd_train)
+
+    ev = sub.add_parser("eval")
+    ev.add_argument("evaluation_class")
+    ev.add_argument("generator_class", nargs="?", default=None)
+    add_run_args(ev)
+    ev.set_defaults(func=cmd_eval)
+
     for verb in (
-        "build",
-        "train",
         "deploy",
-        "eval",
         "import",
         "export",
         "batchpredict",

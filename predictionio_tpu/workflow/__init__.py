@@ -1,0 +1,23 @@
+"""Workflow runtime: the train/eval/serve executables.
+
+Parity with «core/.../workflow/» (SURVEY.md §2.1 [U]): `CreateWorkflow`
+(trainer entry), `CoreWorkflow` (runTrain/runEvaluation), `CreateServer`
+(prediction server), `WorkflowUtils` (engine.json + reflection),
+`BatchPredict` (bulk scoring).
+"""
+
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+    read_engine_json,
+)
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+__all__ = [
+    "EngineVariant",
+    "get_engine",
+    "read_engine_json",
+    "extract_engine_params",
+    "CoreWorkflow",
+]

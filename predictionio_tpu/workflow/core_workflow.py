@@ -1,0 +1,126 @@
+"""CoreWorkflow — train/eval runs with EngineInstance bookkeeping.
+
+Parity with «core/.../workflow/{CoreWorkflow,CreateWorkflow,
+EvaluationWorkflow}.scala» (SURVEY.md §3.1/§3.4 [U]): one EngineInstance
+row per `pio train` (RUNNING → COMPLETED/FAILED, holding the engine params
+JSON and keyed to the stored model blob), one EvaluationInstance per
+`pio eval`. The idempotent re-run contract — re-running train after a
+failure just creates a new instance — is the reference's failure-recovery
+story and is preserved (SURVEY.md §5 'Failure detection').
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from datetime import datetime, timezone
+from typing import Optional, Sequence
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.controller.engine import Engine, EngineParams
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    EvaluationResult,
+    MetricEvaluator,
+)
+from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance, Model
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    engine_params_to_json,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+class CoreWorkflow:
+    @staticmethod
+    def run_train(
+        engine: Engine,
+        engine_params: EngineParams,
+        variant: EngineVariant,
+        ctx: WorkflowContext,
+        engine_version: str = "1",
+        sanity_check: bool = True,
+    ) -> EngineInstance:
+        """The `pio train` body (SURVEY.md §3.1): train → persist models →
+        mark instance COMPLETED."""
+        storage = ctx.storage
+        instances = storage.meta_engine_instances()
+        instance = EngineInstance(
+            id="",
+            status="RUNNING",
+            start_time=_now(),
+            end_time=_now(),
+            engine_id=variant.id,
+            engine_version=engine_version,
+            engine_variant=variant.id,
+            engine_factory=variant.engine_factory,
+            batch=ctx.batch,
+            env={},
+            **engine_params_to_json(engine_params),
+        )
+        instance_id = instances.insert(instance)
+        log.info("CoreWorkflow.run_train: engine instance %s RUNNING", instance_id)
+        try:
+            models = engine.train(ctx, engine_params, sanity_check=sanity_check)
+            blob = engine.serialize_models(models, instance_id, engine_params)
+            storage.model_data_models().insert(Model(id=instance_id, models=blob))
+            instance.status = "COMPLETED"
+            instance.end_time = _now()
+            instances.update(instance)
+            log.info("CoreWorkflow.run_train: instance %s COMPLETED (%d model(s), "
+                     "%d byte blob)", instance_id, len(models), len(blob))
+            return instance
+        except Exception:
+            instance.status = "FAILED"
+            instance.end_time = _now()
+            instances.update(instance)
+            log.error("CoreWorkflow.run_train: instance %s FAILED\n%s",
+                      instance_id, traceback.format_exc())
+            raise
+
+    @staticmethod
+    def run_evaluation(
+        evaluation: Evaluation,
+        generator: EngineParamsGenerator,
+        ctx: WorkflowContext,
+        evaluation_class: str = "",
+        generator_class: str = "",
+    ) -> tuple[EvaluationInstance, EvaluationResult]:
+        """The `pio eval` body (SURVEY.md §3.4)."""
+        storage = ctx.storage
+        instances = storage.meta_evaluation_instances()
+        instance = EvaluationInstance(
+            id="",
+            status="EVALRUNNING",
+            start_time=_now(),
+            end_time=_now(),
+            evaluation_class=evaluation_class or type(evaluation).__name__,
+            engine_params_generator_class=generator_class or type(generator).__name__,
+            batch=ctx.batch,
+        )
+        instance_id = instances.insert(instance)
+        try:
+            result = MetricEvaluator.evaluate(
+                ctx, evaluation, list(generator.engine_params_list)
+            )
+            instance.status = "EVALCOMPLETED"
+            instance.end_time = _now()
+            instance.evaluator_results = result.summary()
+            instance.evaluator_results_json = result.to_json()
+            instances.update(instance)
+            log.info("CoreWorkflow.run_evaluation: instance %s EVALCOMPLETED",
+                     instance_id)
+            return instance, result
+        except Exception:
+            instance.status = "EVALFAILED"
+            instance.end_time = _now()
+            instances.update(instance)
+            log.error("CoreWorkflow.run_evaluation: instance %s EVALFAILED\n%s",
+                      instance_id, traceback.format_exc())
+            raise

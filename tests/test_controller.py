@@ -1,0 +1,372 @@
+"""DASE wiring tests with a fake engine — mirrors the reference's
+`EngineTest`/`EngineWorkflowTest` strategy (SURVEY.md §4.1): trivial DASE
+classes run through the REAL Engine.train/eval and CoreWorkflow, asserting
+plumbing, multi-algo fan-out, params extraction, persistence, and failure
+status rows."""
+
+import dataclasses
+import json
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    Engine,
+    EngineParams,
+    EngineFactory,
+    FirstServing,
+    OptionAverageMetric,
+    Params,
+    Preparator,
+    SanityCheck,
+    WorkflowContext,
+    params_from_dict,
+)
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+)
+from predictionio_tpu.controller.params import ParamsError
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+
+# ---- fake DASE components (the reference's PDataSource0/PAlgo0... style) ----
+
+@dataclasses.dataclass
+class DSParams(Params):
+    n: int = 4
+
+
+class DataSource0(DataSource):
+    params_class = DSParams
+
+    def __init__(self, params: DSParams = None):
+        self.params = params or DSParams()
+
+    def read_training(self, ctx):
+        return list(range(self.params.n))
+
+    def read_eval(self, ctx):
+        # two folds; queries are ints, actual = query * 10
+        td = list(range(self.params.n))
+        return [
+            (td, [(q, q * 10) for q in (1, 2)]),
+            (td, [(q, q * 10) for q in (3, 4)]),
+        ]
+
+
+class Prep0(Preparator):
+    def prepare(self, ctx, td):
+        return [x * 2 for x in td]
+
+
+@dataclasses.dataclass
+class AlgoParams(Params):
+    mult: int = 1
+
+
+class Algo0(Algorithm):
+    params_class = AlgoParams
+
+    def __init__(self, params: AlgoParams = None):
+        self.params = params or AlgoParams()
+
+    def train(self, ctx, pd):
+        return {"sum": sum(pd), "mult": self.params.mult}
+
+    def predict(self, model, query):
+        return model["sum"] * model["mult"] * query
+
+
+class SanityModelAlgo(Algo0):
+    class Model(dict, SanityCheck):
+        def sanity_check(self):
+            if self.get("sum", 0) < 0:
+                raise ValueError("negative sum")
+
+    def train(self, ctx, pd):
+        return SanityModelAlgo.Model(sum=sum(pd), mult=self.params.mult)
+
+    def predict(self, model, query):
+        return model["sum"] * model["mult"] * query
+
+
+class FailingAlgo(Algorithm):
+    def train(self, ctx, pd):
+        raise RuntimeError("boom")
+
+    def predict(self, model, query):
+        raise NotImplementedError
+
+
+def make_engine(algo_map=None):
+    return Engine(
+        data_source_class_map=DataSource0,
+        preparator_class_map=Prep0,
+        algorithm_class_map=algo_map or {"a0": Algo0},
+        serving_class_map=FirstServing,
+    )
+
+
+class TestEngineFactoryFn(EngineFactory):
+    def apply(self):
+        return make_engine()
+
+
+VARIANT = {
+    "id": "test-engine",
+    "description": "fake",
+    "engineFactory": "tests.test_controller.TestEngineFactoryFn",
+    "datasource": {"params": {"n": 3}},
+    "preparator": {"params": {}},
+    "algorithms": [{"name": "a0", "params": {"mult": 5}}],
+    "serving": {"params": {}},
+}
+
+
+class TestEngineTrain:
+    def test_train_pipeline(self):
+        engine = make_engine()
+        ep = EngineParams(algorithm_params_list=[("a0", AlgoParams(mult=2))])
+        models = engine.train(WorkflowContext(), ep)
+        # DataSource gives [0,1,2,3], Prep doubles → sum 12
+        assert models == [{"sum": 12, "mult": 2}]
+
+    def test_multi_algo_fanout(self):
+        engine = make_engine()
+        ep = EngineParams(
+            algorithm_params_list=[("a0", AlgoParams(1)), ("a0", AlgoParams(3))]
+        )
+        models = engine.train(WorkflowContext(), ep)
+        assert [m["mult"] for m in models] == [1, 3]
+
+    def test_predict_through_serving(self):
+        engine = make_engine()
+        ep = EngineParams(
+            algorithm_params_list=[("a0", AlgoParams(1)), ("a0", AlgoParams(3))]
+        )
+        models = engine.train(WorkflowContext(), ep)
+        # FirstServing → first algo's prediction: 12 * 1 * q
+        assert engine.predict(ep, models, 2) == 24
+
+    def test_average_serving(self):
+        engine = Engine(DataSource0, Prep0, {"a0": Algo0}, AverageServing)
+        ep = EngineParams(
+            algorithm_params_list=[("a0", AlgoParams(1)), ("a0", AlgoParams(3))]
+        )
+        models = engine.train(WorkflowContext(), ep)
+        assert engine.predict(ep, models, 1) == (12 + 36) / 2
+
+    def test_sanity_check_runs(self):
+        engine = make_engine({"a0": SanityModelAlgo})
+
+        class NegDS(DataSource0):
+            def read_training(self, ctx):
+                return [-100]
+
+        engine.data_source_class_map = {"": NegDS}
+        ep = EngineParams(algorithm_params_list=[("a0", AlgoParams(1))])
+        with pytest.raises(ValueError, match="negative sum"):
+            engine.train(WorkflowContext(), ep, sanity_check=True)
+        # skipped when disabled
+        engine.train(WorkflowContext(), ep, sanity_check=False)
+
+
+class TestParamsExtraction:
+    def test_engine_json_roundtrip(self):
+        variant = EngineVariant.from_dict(VARIANT)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        assert ep.data_source_params == DSParams(n=3)
+        assert ep.algorithm_params_list == [("a0", AlgoParams(mult=5))]
+
+    def test_unknown_param_rejected(self):
+        bad = json.loads(json.dumps(VARIANT))
+        bad["algorithms"][0]["params"]["typo"] = 1
+        variant = EngineVariant.from_dict(bad)
+        engine = get_engine(variant.engine_factory)
+        with pytest.raises(ParamsError, match="typo"):
+            extract_engine_params(engine, variant)
+
+    def test_params_from_dict_defaults_and_missing(self):
+        assert params_from_dict(DSParams, {}) == DSParams(n=4)
+
+        @dataclasses.dataclass
+        class Req(Params):
+            x: int
+
+        with pytest.raises(ParamsError):
+            params_from_dict(Req, {})
+
+    def test_missing_factory_key(self):
+        with pytest.raises(ValueError, match="engineFactory"):
+            EngineVariant.from_dict({"id": "x"})
+
+
+class TestCoreWorkflow:
+    def _variant(self):
+        return EngineVariant.from_dict(VARIANT)
+
+    def test_run_train_completes_and_persists(self, memory_storage):
+        variant = self._variant()
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+        # stored row is retrievable as latest completed
+        got = memory_storage.meta_engine_instances().get_latest_completed(
+            "test-engine", "1", "test-engine")
+        assert got is not None and got.id == instance.id
+        assert json.loads(got.algorithms_params)[0]["params"]["mult"] == 5
+        # model blob deserializes back to the trained model
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        assert models == [{"sum": 6, "mult": 5}]  # n=3 → [0,2,4] sum 6
+
+    def test_run_train_failure_marks_failed(self, memory_storage):
+        variant = self._variant()
+        engine = make_engine({"a0": FailingAlgo})
+        ep = EngineParams(algorithm_params_list=[("a0", None)])
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(RuntimeError, match="boom"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+        rows = memory_storage.meta_engine_instances().get_all()
+        assert [r.status for r in rows] == ["FAILED"]
+        # idempotent re-run contract: a new train just adds a new row
+        engine_ok = get_engine(variant.engine_factory)
+        ep_ok = extract_engine_params(engine_ok, variant)
+        instance = CoreWorkflow.run_train(engine_ok, ep_ok, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+
+class TestEvaluation:
+    def test_metric_evaluator_ranks_params(self, memory_storage):
+        engine = make_engine()
+
+        class AbsErrMetric(OptionAverageMetric):
+            higher_is_better = False
+
+            def calculate(self, q, p, a):
+                return abs(p - a)
+
+        class Eval0(Evaluation):
+            pass
+
+        Eval0.engine = engine
+        Eval0.metric = AbsErrMetric()
+
+        # mult=1: predict = sum(prep)*q = 12q vs actual 10q → err 2q
+        # mult=3: 36q vs 10q → err 26q  ⇒ mult=1 is better (lower err)
+        eps = [
+            EngineParams(algorithm_params_list=[("a0", AlgoParams(mult=3))]),
+            EngineParams(algorithm_params_list=[("a0", AlgoParams(mult=1))]),
+        ]
+        result = MetricEvaluator.evaluate(WorkflowContext(), Eval0(), eps)
+        assert result.best.engine_params.algorithm_params_list[0][1].mult == 1
+        assert len(result.all_results) == 2
+        # folds: queries (1,2) and (3,4) → mult=1 errs [2,4] and [6,8] → mean 5
+        assert result.best.scores["AbsErrMetric"] == pytest.approx(5.0)
+
+    def test_run_evaluation_stores_instance(self, memory_storage):
+        engine = make_engine()
+
+        class M(OptionAverageMetric):
+            def calculate(self, q, p, a):
+                return 1.0
+
+        class Eval1(Evaluation, EngineParamsGenerator):
+            engine_params_list = [
+                EngineParams(algorithm_params_list=[("a0", AlgoParams(1))])
+            ]
+
+        Eval1.engine = engine
+        Eval1.metric = M()
+
+        ctx = WorkflowContext(storage=memory_storage)
+        ev = Eval1()
+        instance, result = CoreWorkflow.run_evaluation(ev, ev, ctx)
+        assert instance.status == "EVALCOMPLETED"
+        stored = memory_storage.meta_evaluation_instances().get_completed()
+        assert stored[0].id == instance.id
+        assert json.loads(stored[0].evaluator_results_json)["metric"] == "M"
+
+
+class TestReviewRegressions:
+    """Regressions from the controller/workflow code review."""
+
+    def test_named_single_entry_map_resolves_end_to_end(self, memory_storage):
+        # engine whose algorithm map key is 'als' but engine.json omits name
+        engine = Engine(DataSource0, Prep0, {"als": Algo0}, FirstServing)
+        variant = EngineVariant.from_dict({
+            "id": "named", "engineFactory": "x",
+            "datasource": {"params": {"n": 2}},
+            "algorithms": [{"params": {"mult": 2}}],
+        })
+        ep = extract_engine_params(engine, variant)
+        assert ep.algorithm_params_list[0][0] == "als"
+        models = engine.train(WorkflowContext(), ep)  # must not KeyError
+        assert models == [{"sum": 2, "mult": 2}]
+
+    def test_doer_rejects_paramless_ctor_given_params(self):
+        from predictionio_tpu.controller.base import Doer
+
+        class NoCtor(Algorithm):
+            def __init__(self):
+                pass
+
+            def train(self, ctx, pd):
+                return None
+
+            def predict(self, model, query):
+                return None
+
+        with pytest.raises(TypeError, match="constructor takes no"):
+            Doer.apply(NoCtor, AlgoParams(1))
+        # and a TypeError inside a valid ctor propagates, not swallowed
+        class BadCtor(Algorithm):
+            def __init__(self, params):
+                raise TypeError("inner boom")
+
+            def train(self, ctx, pd):
+                return None
+
+            def predict(self, model, query):
+                return None
+
+        with pytest.raises(TypeError, match="inner boom"):
+            Doer.apply(BadCtor, AlgoParams(1))
+
+    def test_mailchimp_nested_form_keys(self):
+        from predictionio_tpu.data.webhooks import MailChimpConnector
+
+        d = MailChimpConnector().to_event_dict({
+            "type": "subscribe",
+            "data[id]": "x",
+            "data[merges][EMAIL]": "a@b.c",
+        })
+        assert d["properties"]["merges.EMAIL"] == "a@b.c"
+
+    def test_empty_generator_clear_error(self):
+        class E(Evaluation):
+            pass
+
+        E.engine = make_engine()
+        E.metric = None
+        with pytest.raises(ValueError, match="No engine params"):
+            MetricEvaluator.evaluate(WorkflowContext(), E(), [])
+
+    def test_eval_cli_bad_class_clean_error(self, memory_storage, capsys):
+        from predictionio_tpu.tools.console import main
+
+        rc = main(["eval", "no.such.module.Eval"])
+        assert rc == 1
+        assert "Evaluation failed" in capsys.readouterr().err
