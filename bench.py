@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Benchmark: ALS epoch time at MovieLens-100K scale (BASELINE.json config 1).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference (PredictionIO) publishes no numbers and its mount
+was empty (see BASELINE.md), so the baseline is our self-measured
+single-thread numpy CPU ALS on the same synthetic ML-100K-scale workload:
+82 ms/epoch (rank 10, 100k ratings, 943x1682; measured on this image's
+1-vCPU host, 2026-07-29 — see BASELINE.md for the derivation).
+`vs_baseline` > 1 means faster than that CPU baseline.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+CPU_BASELINE_EPOCH_S = 0.082  # measured numpy ALS epoch (BASELINE.md)
+
+N_USERS, N_ITEMS, N_RATINGS, RANK = 943, 1682, 100_000, 10
+
+
+def synth_ml100k():
+    """Deterministic synthetic workload with ML-100K's shape and a
+    popularity-skewed item distribution (ML-100K's items follow a power
+    law; uniform item draws would understate bucket raggedness)."""
+    rng = np.random.default_rng(42)
+    ui = rng.integers(0, N_USERS, N_RATINGS).astype(np.int32)
+    pop = rng.zipf(1.3, size=N_RATINGS) % N_ITEMS
+    ii = pop.astype(np.int32)
+    r = rng.integers(1, 6, N_RATINGS).astype(np.float32)
+    return ui, ii, r
+
+
+def main():
+    from predictionio_tpu.ops.als import ALSConfig, als_train
+
+    ui, ii, r = synth_ml100k()
+    # warm-up: compiles the fused training loop
+    warm = ALSConfig(rank=RANK, iterations=100, reg=0.05, seed=0)
+    als_train(ui, ii, r, N_USERS, N_ITEMS, warm)
+    # timed: same config reuses the compiled executable; 100 iterations in
+    # one on-device scan amortizes dispatch, timing fenced by scalar read
+    result = als_train(ui, ii, r, N_USERS, N_ITEMS, warm)
+    epoch_s = float(np.median(result.epoch_times))
+    print(json.dumps({
+        "metric": "als_epoch_time_ml100k_rank10",
+        "value": round(epoch_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(CPU_BASELINE_EPOCH_S / epoch_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

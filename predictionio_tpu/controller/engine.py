@@ -31,6 +31,18 @@ from predictionio_tpu.controller.params import Params, params_from_dict
 log = logging.getLogger(__name__)
 
 
+def resolve_component(class_map: dict, name: str, role: str) -> Type:
+    """THE component-name resolution rule, shared by engine.json extraction
+    and runtime lookup so the two can't drift: an empty name falls back to
+    a single-entry map's only class; a non-empty name must match exactly
+    (a typo'd name errors instead of silently training something else)."""
+    if name in class_map:
+        return class_map[name]
+    if name == "" and len(class_map) == 1:
+        return next(iter(class_map.values()))
+    raise KeyError(f"Unknown {role} name {name!r} (have {sorted(class_map)})")
+
+
 @dataclasses.dataclass
 class EngineParams:
     """«controller/EngineParams» [U]: per-component (name, params) selections."""
@@ -56,6 +68,12 @@ class Engine:
         algorithm_class_map: dict[str, Type[Algorithm]] | Type[Algorithm] = None,
         serving_class_map: dict[str, Type[Serving]] | Type[Serving] | None = None,
     ):
+        if data_source_class_map is None or algorithm_class_map is None:
+            raise ValueError(
+                "Engine requires data_source_class_map and algorithm_class_map "
+                "(preparator/serving default to identity/first)."
+            )
+
         def as_map(x, default_cls=None):
             if x is None:
                 return {"": default_cls}
@@ -69,36 +87,30 @@ class Engine:
         self.serving_class_map = as_map(serving_class_map, FirstServing)
 
     # -- component resolution ---------------------------------------------
-    def _cls(self, class_map: dict, name: str, role: str) -> Type:
-        if name not in class_map:
-            # single-entry maps accept any name for convenience, mirroring
-            # the reference's default "" keys
-            if len(class_map) == 1 and "" in class_map:
-                return class_map[""]
-            raise KeyError(f"Unknown {role} name {name!r} (have {sorted(class_map)})")
-        return class_map[name]
-
     def components(self, engine_params: EngineParams):
         ds = Doer.apply(
-            self._cls(self.data_source_class_map, engine_params.data_source_name,
-                      "data source"),
+            resolve_component(self.data_source_class_map,
+                              engine_params.data_source_name, "data source"),
             engine_params.data_source_params,
         )
         prep = Doer.apply(
-            self._cls(self.preparator_class_map, engine_params.preparator_name,
-                      "preparator"),
+            resolve_component(self.preparator_class_map,
+                              engine_params.preparator_name, "preparator"),
             engine_params.preparator_params,
         )
         algos = [
             (
                 name,
-                Doer.apply(self._cls(self.algorithm_class_map, name, "algorithm"),
-                           params),
+                Doer.apply(
+                    resolve_component(self.algorithm_class_map, name, "algorithm"),
+                    params,
+                ),
             )
             for name, params in engine_params.algorithm_params_list
         ]
         serving = Doer.apply(
-            self._cls(self.serving_class_map, engine_params.serving_name, "serving"),
+            resolve_component(self.serving_class_map, engine_params.serving_name,
+                              "serving"),
             engine_params.serving_params,
         )
         return ds, prep, algos, serving
@@ -198,8 +210,14 @@ class Engine:
         engine_params: EngineParams,
         models: Sequence[Any],
         query: Any,
+        components=None,
     ) -> Any:
-        _, _, algos, serving = self.components(engine_params)
+        """Serve one query. The prediction server resolves `components`
+        once at deploy time and passes them in — per-request reflective
+        instantiation would put Doer overhead on the hot path."""
+        if components is None:
+            components = self.components(engine_params)
+        _, _, algos, serving = components
         predictions = [
             algo.predict(model, query) for (_, algo), model in zip(algos, models)
         ]

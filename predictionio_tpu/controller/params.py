@@ -48,6 +48,11 @@ def params_from_dict(cls: Type[P], d: dict[str, Any]) -> P:
                 f"{cls.__name__} is not a dataclass but params {sorted(d)} were given"
             )
         return cls()
+    # _ALIASES lets a Params class accept JSON keys that aren't valid Python
+    # identifiers (e.g. engine.json's "lambda" → field "lambda_").
+    aliases: dict[str, str] = getattr(cls, "_ALIASES", {})
+    if aliases:
+        d = {aliases.get(k, k): v for k, v in d.items()}
     field_names = {f.name for f in dataclasses.fields(cls)}
     unknown = set(d) - field_names
     if unknown:

@@ -1,0 +1,1 @@
+"""Model objects: pytree-backed trained models with serving helpers."""

@@ -1,0 +1,74 @@
+"""ALSModel — trained factor matrices + id mappings, with serving helpers.
+
+Parity with the Recommendation template's «ALSModel extends PersistentModel»
+and the Similar-Product template's collected feature map (SURVEY.md §2.4
+[U]). Factors live as numpy on the host for low-latency single-query
+serving; bulk paths go through the jitted scorer in ops.ranking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops import ranking
+
+
+@dataclasses.dataclass
+class ALSModel:
+    user_factors: np.ndarray  # [n_users, K]
+    item_factors: np.ndarray  # [n_items, K]
+    user_ids: BiMap  # user id string → row
+    item_ids: BiMap  # item id string → row
+    seen: Optional[dict[int, np.ndarray]] = None  # user row → seen item rows
+    rmse_history: list = dataclasses.field(default_factory=list)
+
+    def recommend_products(
+        self, user: str, num: int, exclude_seen: bool = True
+    ) -> list[tuple[str, float]]:
+        """Top-num (item id, score) for a user («recommendProducts» [U]).
+        Unknown user → empty list (the reference's template behavior)."""
+        row = self.user_ids.get(user)
+        if row is None:
+            return []
+        exclude = None
+        if exclude_seen and self.seen:
+            exclude = {int(row): self.seen.get(int(row), np.empty(0, np.int32))}
+        scores, idx = ranking.recommend_topk(
+            self.user_factors, self.item_factors,
+            np.asarray([row], dtype=np.int32), num, exclude,
+        )
+        inv = self.item_ids.inverse()
+        out = []
+        for s, i in zip(scores[0], idx[0]):
+            if not np.isfinite(s):
+                continue  # fewer than num unseen items exist
+            out.append((inv[int(i)], float(s)))
+        return out
+
+    def similar_products(
+        self, items: list[str], num: int, exclude_self: bool = True
+    ) -> list[tuple[str, float]]:
+        """Item-item cosine on item factors — the Similar-Product template's
+        predict path («ALSModel(productFeatures.collectAsMap)» [U]).
+        Multiple query items → average of their unit vectors."""
+        rows = [self.item_ids.get(i) for i in items]
+        rows = [r for r in rows if r is not None]
+        if not rows:
+            return []
+        v = self.item_factors[rows]
+        v = v / np.maximum(np.linalg.norm(v, axis=1, keepdims=True), 1e-9)
+        q = v.mean(axis=0)
+        norms = np.maximum(np.linalg.norm(self.item_factors, axis=1), 1e-9)
+        sims = (self.item_factors @ q) / norms
+        if exclude_self:
+            sims[rows] = -np.inf
+        top = np.argsort(-sims)[:num]
+        inv = self.item_ids.inverse()
+        return [(inv[int(i)], float(sims[i])) for i in top if np.isfinite(sims[i])]
+
+    # numpy arrays + BiMaps pickle cleanly; nothing device-resident here,
+    # so the default blob-store persistence (Engine.serialize_models) works.

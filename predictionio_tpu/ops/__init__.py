@@ -1,0 +1,8 @@
+"""Compute kernels: jitted XLA programs + Pallas kernels for the hot ops.
+
+This is the rebuild's replacement for Spark MLlib (SURVEY.md §2.5): where
+the reference calls `ALS.train`, `NaiveBayes.train`,
+`LogisticRegressionWithSGD`, `Word2Vec.fit` on RDDs, these modules build
+the same math as mesh-sharded XLA programs (einsum/solve on the MXU,
+psum/all_gather over ICI).
+"""

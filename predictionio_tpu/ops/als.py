@@ -1,0 +1,297 @@
+"""ALS (alternating least squares) matrix factorization, TPU-first.
+
+Replaces the reference templates' calls into Spark MLlib ALS
+(«org.apache.spark.mllib.recommendation.ALS.train / trainImplicit», invoked
+from the Recommendation/Similar-Product/E-Commerce templates — SURVEY.md
+§2.4 [U]). MLlib block-partitions the interaction matrix and ships factor
+blocks over the shuffle every iteration; here the same alternation is two
+jitted half-epochs over a device mesh:
+
+- The interaction matrix is ragged (users have wildly different rating
+  counts); TPUs want dense tiles. Rows are **bucketed by nnz into
+  power-of-two padded dense blocks** (SURVEY.md §7.3): a bucket holds
+  [R, C] column-index/value/mask tiles, R padded to the data-axis size.
+- One half-epoch solves, for every row r in every bucket, the normal
+  equations (Yᵀ_r Y_r + λ(n_r)I) x_r = Yᵀ_r v_r with Y_r the gathered
+  opposing factors — batched einsum ([R,C,K] → [R,K,K], MXU work) +
+  batched `jnp.linalg.solve`.
+- Bucket rows are sharded over the mesh `data` axis; the opposing factor
+  matrix is replicated (factors are tiny relative to interactions), so the
+  only cross-device traffic is the all_gather of freshly-solved rows that
+  GSPMD inserts — the ICI analogue of MLlib's factor-block shuffle.
+- Implicit-feedback mode (trainImplicit) uses the Hu-Koren-Volinsky
+  confidence weighting: A = YᵀY + Yᵀ(C−I)Y + λI, b = YᵀC·1, with the
+  global Gram YᵀY computed once per half-epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MIN_CAP = 8  # smallest bucket width (sublane-friendly)
+
+
+@dataclasses.dataclass
+class Bucket:
+    """Padded dense block of ragged rows with equal capacity."""
+
+    rows: np.ndarray  # [R] int32 — row ids; padding rows get `n_rows` (sentinel)
+    cols: np.ndarray  # [R, C] int32 — column ids, 0-padded
+    vals: np.ndarray  # [R, C] float32 — values, 0-padded
+    mask: np.ndarray  # [R, C] float32 — 1 where real
+
+    @property
+    def cap(self) -> int:
+        return self.cols.shape[1]
+
+
+def bucket_ragged(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    row_multiple: int = 8,
+    max_cap: Optional[int] = None,
+) -> list[Bucket]:
+    """COO triplets → per-row padded buckets, bucketed by nnz.
+
+    Rows with no entries are skipped (their factors stay at init).
+    `row_multiple` pads each bucket's row count (use mesh data-axis size ×
+    8 so shards stay tile-aligned). `max_cap` truncates pathological rows
+    (keeping the most recent entries is the caller's job; default no cap).
+    """
+    rows = np.asarray(rows, dtype=np.int32)
+    cols = np.asarray(cols, dtype=np.int32)
+    vals = np.asarray(vals, dtype=np.float32)
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    uniq, start, counts = np.unique(rows_s, return_index=True, return_counts=True)
+
+    caps = np.maximum(MIN_CAP, 2 ** np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64))
+    if max_cap is not None:
+        caps = np.minimum(caps, max_cap)
+        counts = np.minimum(counts, max_cap)
+
+    buckets: list[Bucket] = []
+    for cap in np.unique(caps):
+        sel = np.nonzero(caps == cap)[0]
+        r = len(sel)
+        r_pad = -(-r // row_multiple) * row_multiple
+        b_rows = np.full(r_pad, n_rows, dtype=np.int32)  # sentinel padding
+        b_cols = np.zeros((r_pad, cap), dtype=np.int32)
+        b_vals = np.zeros((r_pad, cap), dtype=np.float32)
+        b_mask = np.zeros((r_pad, cap), dtype=np.float32)
+        for i, j in enumerate(sel):
+            c = counts[j]
+            s = start[j]
+            b_rows[i] = uniq[j]
+            b_cols[i, :c] = cols_s[s : s + c]
+            b_vals[i, :c] = vals_s[s : s + c]
+            b_mask[i, :c] = 1.0
+        buckets.append(Bucket(b_rows, b_cols, b_vals, b_mask))
+    return buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    """Frozen (hashable) so jitted solvers cache across als_train calls."""
+
+    rank: int = 10
+    iterations: int = 10
+    reg: float = 0.01
+    weighted_reg: bool = True  # λ·n_r (ALS-WR, MLlib's scheme) vs plain λ
+    implicit: bool = False
+    alpha: float = 1.0  # implicit confidence scale
+    seed: int = 0
+    dtype: str = "float32"
+
+
+def _solve_buckets_device(
+    opposing,  # [n_cols(+1 pad row), K] — gathered from
+    out_rows: int,  # static: rows in the solved-for factor matrix
+    buckets_dev: Sequence[tuple],  # per bucket: (rows, cols, vals, mask)
+    cfg: ALSConfig,
+):
+    """One half-epoch: solve every row's normal equations, scatter into a
+    fresh [out_rows, K] matrix. Pure jittable function of device arrays."""
+    import jax.numpy as jnp
+
+    k = opposing.shape[-1]
+    eye = jnp.eye(k, dtype=opposing.dtype)
+    new = jnp.zeros((out_rows, k), dtype=opposing.dtype)
+
+    if cfg.implicit:
+        # global Gram over real (non-sentinel-pad) opposing rows
+        gram = opposing.T @ opposing
+
+    for rows, cols, vals, mask in buckets_dev:
+        y = opposing[cols]  # [R, C, K] gather
+        ym = y * mask[..., None]
+        if cfg.implicit:
+            conf = cfg.alpha * vals  # C - I, zero at padding
+            a = gram[None] + jnp.einsum("rck,rc,rcl->rkl", ym, conf, ym)
+            b = jnp.einsum("rck,rc->rk", ym, 1.0 + conf)
+            n = mask.sum(-1)
+        else:
+            a = jnp.einsum("rck,rcl->rkl", ym, y)
+            b = jnp.einsum("rck,rc->rk", ym, vals)
+            n = mask.sum(-1)
+        reg = cfg.reg * (n if cfg.weighted_reg else jnp.ones_like(n))
+        a = a + reg[:, None, None] * eye[None]
+        x = jnp.linalg.solve(a, b[..., None])[..., 0]
+        # sentinel row ids (== out_rows) fall outside and are dropped
+        new = new.at[rows].set(x, mode="drop")
+    return new
+
+
+def _predict_sq_err(u_factors, i_factors, buckets_dev):
+    """Σ (uᵀv − r)² over all real entries (for RMSE history)."""
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), dtype=jnp.float32)
+    count = jnp.zeros((), dtype=jnp.float32)
+    for rows, cols, vals, mask in buckets_dev:
+        u = u_factors[rows.clip(0, u_factors.shape[0] - 1)]  # [R, K]
+        v = i_factors[cols]  # [R, C, K]
+        pred = jnp.einsum("rk,rck->rc", u, v)
+        err = (pred - vals) * mask
+        total = total + jnp.sum(err * err)
+        count = count + jnp.sum(mask)
+    return total, count
+
+
+@functools.lru_cache(maxsize=64)
+def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
+                    compute_rmse: bool):
+    """The full training run as ONE jitted program: `lax.scan` over
+    iterations, so a train is a single dispatch with no host round trips
+    (under `jit` everything is traced once and compiled — SURVEY.md §7.1's
+    'no data-dependent Python control flow' rule applied to the ALS loop).
+    RMSE history is accumulated on-device and read back once."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(item_factors0, user_factors0, ub_dev, ib_dev):
+        def body(carry, _):
+            user_f, item_f = carry
+            user_f = _solve_buckets_device(item_f, n_users, ub_dev, cfg)
+            item_f = _solve_buckets_device(user_f, n_items, ib_dev, cfg)
+            if compute_rmse:
+                total, count = _predict_sq_err(user_f, item_f, ub_dev)
+                rmse = jnp.sqrt(jnp.maximum(total, 0.0) / jnp.maximum(count, 1.0))
+            else:
+                rmse = jnp.zeros((), dtype=jnp.float32)
+            return (user_f, item_f), rmse
+
+        (user_f, item_f), rmses = jax.lax.scan(
+            body, (user_factors0, item_factors0), xs=None, length=cfg.iterations
+        )
+        return user_f, item_f, rmses
+
+    return jax.jit(run)
+
+
+@dataclasses.dataclass
+class ALSResult:
+    user_factors: np.ndarray  # [n_users, K]
+    item_factors: np.ndarray  # [n_items, K]
+    rmse_history: list[float]
+    epoch_times: list[float] = dataclasses.field(default_factory=list)
+    # wall seconds per iteration, synced (first entry includes compile)
+
+
+def als_train(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    cfg: ALSConfig,
+    mesh=None,
+    compute_rmse: bool = False,
+) -> ALSResult:
+    """Train ALS factors from COO ratings.
+
+    mesh: a `jax.sharding.Mesh` (default: all local devices on `data`).
+    Bucket rows are sharded over the `data` axis; factor matrices are
+    replicated. This is SURVEY.md §2.6 strategy 2 (MLlib's block-parallel
+    ALS) re-expressed for ICI.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    row_multiple = max(8, n_data)
+
+    user_buckets = bucket_ragged(user_idx, item_idx, ratings, n_users, row_multiple)
+    item_buckets = bucket_ragged(item_idx, user_idx, ratings, n_items, row_multiple)
+    log.info(
+        "als_train: %d ratings, %d users (%d buckets, caps %s), %d items "
+        "(%d buckets, caps %s), rank %d, mesh %s",
+        len(ratings), n_users, len(user_buckets),
+        [b.cap for b in user_buckets], n_items, len(item_buckets),
+        [b.cap for b in item_buckets], cfg.rank, dict(mesh.shape),
+    )
+
+    dtype = jnp.dtype(cfg.dtype)
+    row_shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def put_buckets(buckets: list[Bucket]):
+        out = []
+        for b in buckets:
+            out.append(tuple(
+                jax.device_put(arr, row_shard)
+                for arr in (b.rows, b.cols, b.vals, b.mask)
+            ))
+        return out
+
+    ub_dev = put_buckets(user_buckets)
+    ib_dev = put_buckets(item_buckets)
+
+    # init item factors ~ N(0, 1/sqrt(rank)) like MLlib; users solved first
+    key = jax.random.key(cfg.seed)
+    item_factors = jax.device_put(
+        (jax.random.normal(key, (n_items, cfg.rank), dtype=dtype) / np.sqrt(cfg.rank)),
+        rep,
+    )
+    user_factors = jax.device_put(jnp.zeros((n_users, cfg.rank), dtype=dtype), rep)
+
+    import time
+
+    # One dispatch for the whole run: the iteration loop is a lax.scan
+    # inside a single jitted program, so there are no per-epoch host round
+    # trips (this TPU sits behind a tunnel; a sync per epoch would dwarf
+    # the compute at quickstart scale). Epoch time = wall / iterations.
+    train = _get_train_loop(n_users, n_items, cfg, compute_rmse)
+    t_start = time.perf_counter()
+    user_factors, item_factors, rmses = train(item_factors, user_factors,
+                                              ub_dev, ib_dev)
+    # a scalar readback is the reliable execution fence on this platform
+    # (block_until_ready can return early behind the axon tunnel)
+    float(item_factors[0, 0])
+    wall = time.perf_counter() - t_start
+    epoch_times = [wall / max(cfg.iterations, 1)] * cfg.iterations
+    rmse_history = [float(x) for x in np.asarray(rmses)] if compute_rmse else []
+    if compute_rmse and rmse_history:
+        log.info("als_train: rmse %.4f → %.4f over %d iters",
+                 rmse_history[0], rmse_history[-1], cfg.iterations)
+
+    return ALSResult(
+        user_factors=np.asarray(user_factors),
+        item_factors=np.asarray(item_factors),
+        rmse_history=rmse_history,
+        epoch_times=epoch_times,
+    )

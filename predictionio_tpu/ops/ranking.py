@@ -1,0 +1,101 @@
+"""Top-k scoring + ranking metrics (MAP@k, precision@k, NDCG@k).
+
+The serving/eval math of the Recommendation templates: score = U Vᵀ with
+seen-item exclusion, then top-k. Batched over users in chunks so the
+[chunk, n_items] score tile stays MXU-sized instead of materializing the
+full n_users × n_items matrix (SURVEY.md §6 tracks MAP@10 on ML-20M).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=16)
+def _topk_fn(k: int, masked: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def score_topk(u_vecs, item_factors, exclude_mask=None):
+        # u_vecs [B, K]; item_factors [N, K]; exclude_mask [B, N] (1 = hide)
+        scores = u_vecs @ item_factors.T
+        if masked:
+            scores = jnp.where(exclude_mask > 0, -jnp.inf, scores)
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        return top_scores, top_idx
+
+    return score_topk
+
+
+def recommend_topk(
+    user_factors: np.ndarray,
+    item_factors: np.ndarray,
+    user_ids: np.ndarray,
+    k: int,
+    exclude: Optional[dict[int, np.ndarray]] = None,
+    chunk: int = 1024,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k items for each user id. `exclude` maps user id → item-id array
+    to hide (the 'unseen only' contract of the reference templates)."""
+    n_items = item_factors.shape[0]
+    k = min(k, n_items)
+    masked = bool(exclude)
+    fn = _topk_fn(k, masked)
+    all_scores, all_idx = [], []
+    for s in range(0, len(user_ids), chunk):
+        ids = user_ids[s : s + chunk]
+        u = user_factors[ids]
+        if masked:
+            # dense mask only when exclusions exist; the no-exclusion path
+            # ships nothing but factors (the [chunk, n_items] tile would
+            # dominate transfer cost at ML-20M scale otherwise)
+            mask = np.zeros((len(ids), n_items), dtype=np.float32)
+            for i, uid in enumerate(ids):
+                ex = exclude.get(int(uid))
+                if ex is not None and len(ex):
+                    mask[i, ex] = 1.0
+            ts, ti = fn(u, item_factors, mask)
+        else:
+            ts, ti = fn(u, item_factors)
+        all_scores.append(np.asarray(ts))
+        all_idx.append(np.asarray(ti))
+    return np.concatenate(all_scores), np.concatenate(all_idx)
+
+
+def average_precision_at_k(predicted, actual: set, k: int) -> float:
+    """AP@k for one user (the MAP building block the reference's
+    Recommendation template evaluation uses [U]). Works on int row indices
+    or string item ids — elements are compared as-is against `actual`."""
+    if not actual:
+        return 0.0
+    hits = 0
+    score = 0.0
+    for i, p in enumerate(predicted[:k]):
+        p = p.item() if isinstance(p, np.generic) else p
+        if p in actual:
+            hits += 1
+            score += hits / (i + 1.0)
+    return score / min(len(actual), k)
+
+
+def map_at_k(
+    user_factors: np.ndarray,
+    item_factors: np.ndarray,
+    test_user_items: dict[int, set],
+    k: int = 10,
+    exclude: Optional[dict[int, np.ndarray]] = None,
+) -> float:
+    """Mean AP@k over users with test items."""
+    user_ids = np.asarray(sorted(test_user_items), dtype=np.int32)
+    if len(user_ids) == 0:
+        return float("nan")
+    _, top_idx = recommend_topk(user_factors, item_factors, user_ids, k, exclude)
+    aps = [
+        average_precision_at_k(top_idx[i], test_user_items[int(uid)], k)
+        for i, uid in enumerate(user_ids)
+    ]
+    return float(np.mean(aps))

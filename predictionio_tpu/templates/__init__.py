@@ -1,0 +1,10 @@
+"""Built-in engine templates.
+
+The reference ships these as separate repos instantiated into a user dir
+(SURVEY.md §2.4); here they are importable packages whose engine.json files
+keep the reference shape, so `pio-tpu build/train/deploy` runs them
+unchanged at the engine.json level (BASELINE.json north-star requirement).
+
+Templates: recommendation, similarproduct, classification, ecommerce,
+textclassification.
+"""

@@ -1,0 +1,32 @@
+"""Recommendation template — ALS on rate/buy events.
+
+Parity with the reference Recommendation template (SURVEY.md §2.4 [U]):
+`DataSource` reads "rate" and "buy" events (`buy` ⇒ implicit rating 4.0,
+matching the quickstart), `ALSAlgorithm.train` runs mesh-sharded ALS,
+`predict` answers {"user": ..., "num": ...} queries with
+{"itemScores": [{"item": ..., "score": ...}]}.
+"""
+
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSource,
+    DataSourceParams,
+    Preparator,
+    PreparedData,
+    Query,
+    RecommendationEngine,
+    TrainingData,
+)
+
+__all__ = [
+    "RecommendationEngine",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "Query",
+]

@@ -1,0 +1,224 @@
+"""Recommendation engine template (DASE components).
+
+Mirrors the reference template's `src/main/scala/{DataSource,Preparator,
+Algorithm,Serving}.scala` shapes (SURVEY.md §2.4 [U]) with the ALS compute
+replaced by `predictionio_tpu.ops.als` (mesh-sharded XLA) instead of Spark
+MLlib.
+
+Wire shapes (kept reference-compatible):
+    query:  {"user": "1", "num": 4}
+    result: {"itemScores": [{"item": "i5", "score": 3.2}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource as BaseDataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator as BasePreparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.als_model import ALSModel
+from predictionio_tpu.ops.als import ALSConfig, als_train
+
+log = logging.getLogger(__name__)
+
+Query = dict  # {"user": str, "num": int}
+PredictedResult = dict  # {"itemScores": [{"item": str, "score": float}]}
+
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    appName: str = ""
+    eventNames: list = dataclasses.field(default_factory=lambda: ["rate", "buy"])
+    buyRating: float = 4.0  # implicit rating assigned to "buy" (quickstart rule)
+    evalK: int = 0  # >0 enables read_eval with k folds
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: list  # entity ids (strings)
+    items: list
+    ratings: np.ndarray  # [n] float32, aligned with users/items
+
+    def sanity_check(self):
+        if len(self.ratings) == 0:
+            raise ValueError("TrainingData has no rating events; ingest events first.")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read_events(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        events = store.find(
+            app_name=self.params.appName,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.eventNames),
+        )
+        users, items, ratings = [], [], []
+        for e in events:
+            if e.target_entity_id is None:
+                continue
+            if e.event == "rate":
+                r = e.properties.get_opt("rating", float)
+                if r is None:
+                    continue
+            else:  # "buy" and other implicit events
+                r = self.params.buyRating
+            users.append(e.entity_id)
+            items.append(e.target_entity_id)
+            ratings.append(float(r))
+        return TrainingData(users, items, np.asarray(ratings, dtype=np.float32))
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        td = self._read_events(ctx)
+        log.info("DataSource: %d rating events from app %r",
+                 len(td.ratings), self.params.appName)
+        return td
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold split by event hash («DataSource.readEval» [U]): fold i
+        tests on every k-th event, trains on the rest. Queries ask top-10
+        for each test user; actual = that user's held-out items."""
+        k = self.params.evalK
+        if k <= 1:
+            raise ValueError("DataSourceParams.evalK must be >= 2 for evaluation")
+        td = self._read_events(ctx)
+        n = len(td.ratings)
+        assign = np.arange(n) % k
+        folds = []
+        for fold in range(k):
+            train_sel = assign != fold
+            test_sel = ~train_sel
+            fold_td = TrainingData(
+                [u for u, s in zip(td.users, train_sel) if s],
+                [i for i, s in zip(td.items, train_sel) if s],
+                td.ratings[train_sel],
+            )
+            actual_by_user: dict[str, set] = {}
+            for u, i, s in zip(td.users, td.items, test_sel):
+                if s:
+                    actual_by_user.setdefault(u, set()).add(i)
+            qa = [
+                ({"user": u, "num": 10}, {"items": sorted(items)})
+                for u, items in sorted(actual_by_user.items())
+            ]
+            folds.append((fold_td, qa))
+        return folds
+
+
+@dataclasses.dataclass
+class PreparedData:
+    user_ids: BiMap
+    item_ids: BiMap
+    user_idx: np.ndarray  # [n] int32
+    item_idx: np.ndarray
+    ratings: np.ndarray  # [n] float32
+
+
+class Preparator(BasePreparator):
+    """BiMap the string ids to dense rows («BiMap.stringLong» before MLlib,
+    SURVEY.md §2.2 [U]) and emit device-ready COO arrays. Duplicate
+    (user, item) pairs keep the last value (re-rating overwrites)."""
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
+        user_ids = BiMap.string_int(td.users)
+        item_ids = BiMap.string_int(td.items)
+        u = user_ids.to_index(td.users)
+        i = item_ids.to_index(td.items)
+        # dedup keeping last occurrence
+        pair = u.astype(np.int64) * max(len(item_ids), 1) + i
+        _, last_pos = np.unique(pair[::-1], return_index=True)
+        keep = len(pair) - 1 - last_pos
+        keep.sort()
+        return PreparedData(
+            user_ids=user_ids,
+            item_ids=item_ids,
+            user_idx=u[keep],
+            item_idx=i[keep],
+            ratings=td.ratings[keep],
+        )
+
+
+@dataclasses.dataclass
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 10
+    lambda_: float = 0.01  # engine.json key "lambda" (see _ALIASES)
+    implicitPrefs: bool = False
+    alpha: float = 1.0
+    seed: Optional[int] = None
+    computeRMSE: bool = False
+
+    _ALIASES = {"lambda": "lambda_"}
+
+
+class ALSAlgorithm(Algorithm):
+    """«ALSAlgorithm.train» → mesh-sharded ALS; model keeps factors +
+    bimaps + seen items for serve-time exclusion."""
+
+    params_class = ALSAlgorithmParams
+
+    def __init__(self, params: ALSAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> ALSModel:
+        p = self.params
+        cfg = ALSConfig(
+            rank=p.rank,
+            iterations=p.numIterations,
+            reg=p.lambda_,
+            implicit=p.implicitPrefs,
+            alpha=p.alpha,
+            seed=ctx.seed if p.seed is None else p.seed,
+        )
+        result = als_train(
+            pd.user_idx, pd.item_idx, pd.ratings,
+            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+            cfg=cfg, mesh=ctx.mesh, compute_rmse=p.computeRMSE,
+        )
+        seen: dict[int, list] = {}
+        for u, i in zip(pd.user_idx, pd.item_idx):
+            seen.setdefault(int(u), []).append(int(i))
+        seen_np = {u: np.asarray(v, dtype=np.int32) for u, v in seen.items()}
+        return ALSModel(
+            user_factors=result.user_factors,
+            item_factors=result.item_factors,
+            user_ids=pd.user_ids,
+            item_ids=pd.item_ids,
+            seen=seen_np,
+            rmse_history=result.rmse_history,
+        )
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        num = int(query.get("num", 10))
+        recs = model.recommend_products(str(query["user"]), num)
+        return {"itemScores": [{"item": i, "score": s} for i, s in recs]}
+
+
+class RecommendationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={"als": ALSAlgorithm},
+            serving_class_map=FirstServing,
+        )

@@ -175,6 +175,9 @@ def cmd_train(args) -> int:
     except FileNotFoundError as e:
         print(f"Cannot read engine variant: {e}", file=sys.stderr)
         return 1
+    except (ImportError, AttributeError, ValueError, TypeError, KeyError) as e:
+        print(f"Training failed: {e}", file=sys.stderr)
+        return 1
     print(f"Training completed. Engine instance ID: {instance.id}")
     return 0
 

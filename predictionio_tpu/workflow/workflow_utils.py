@@ -134,19 +134,16 @@ def _component_params(
 def extract_engine_params(engine: Engine, variant: EngineVariant) -> EngineParams:
     """engine.json blocks → typed EngineParams (`extractParams` [U])."""
 
+    from predictionio_tpu.controller.engine import resolve_component
+
     def pick(class_map: dict, block: dict[str, Any], role: str):
         name = block.get("name", "")
-        if name not in class_map and len(class_map) == 1:
-            # single-entry maps accept any name; record the real key so the
-            # stored EngineParams resolve at train/deploy time
-            name_used, cls = next(iter(class_map.items()))
-            return name_used, cls
+        cls = resolve_component(class_map, name, role)
+        # record the real key (an empty name may have resolved to a
+        # single-entry map's key) so stored EngineParams resolve later
         if name not in class_map:
-            raise KeyError(
-                f"Unknown {role} name {name!r} in engine.json (have "
-                f"{sorted(class_map)})"
-            )
-        return name, class_map[name]
+            name = next(k for k, v in class_map.items() if v is cls)
+        return name, cls
 
     ds_name, ds_cls = pick(engine.data_source_class_map, variant.datasource,
                            "datasource")

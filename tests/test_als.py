@@ -1,0 +1,198 @@
+"""ALS op correctness: bucketing, normal-equation solves vs a dense numpy
+reference, low-rank recovery, implicit mode, and ranking metrics."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, Bucket, als_train, bucket_ragged
+from predictionio_tpu.ops.ranking import (
+    average_precision_at_k,
+    map_at_k,
+    recommend_topk,
+)
+
+
+def synth_ratings(n_users=60, n_items=40, rank=3, density=0.3, seed=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    u = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    v = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    full = u @ v.T
+    mask = rng.random((n_users, n_items)) < density
+    ui, ii = np.nonzero(mask)
+    r = full[ui, ii] + noise * rng.normal(size=len(ui))
+    return ui.astype(np.int32), ii.astype(np.int32), r.astype(np.float32), full
+
+
+class TestBucketing:
+    def test_buckets_cover_all_entries(self):
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, 50, 500).astype(np.int32)
+        cols = rng.integers(0, 30, 500).astype(np.int32)
+        vals = rng.random(500).astype(np.float32)
+        buckets = bucket_ragged(rows, cols, vals, n_rows=50, row_multiple=8)
+        # every real entry appears exactly once
+        total = sum(int(b.mask.sum()) for b in buckets)
+        assert total == 500
+        # row counts padded to multiple of 8, rows unique across buckets
+        seen_rows = []
+        for b in buckets:
+            assert b.rows.shape[0] % 8 == 0
+            assert b.cols.shape == b.vals.shape == b.mask.shape
+            real = b.rows[b.rows < 50]
+            seen_rows.extend(real.tolist())
+            # capacity fits the largest row in the bucket
+            assert int(b.mask.sum(1).max()) <= b.cap
+        assert sorted(seen_rows) == sorted(np.unique(rows).tolist())
+        # sentinel rows are fully masked out
+        for b in buckets:
+            pad = b.rows >= 50
+            assert b.mask[pad].sum() == 0
+
+    def test_power_of_two_caps(self):
+        rows = np.asarray([0] * 3 + [1] * 9 + [2] * 17, dtype=np.int32)
+        cols = np.arange(29, dtype=np.int32)
+        vals = np.ones(29, dtype=np.float32)
+        buckets = bucket_ragged(rows, cols, vals, n_rows=3)
+        caps = sorted(b.cap for b in buckets)
+        assert caps == [8, 16, 32]  # 3→8 (min), 9→16, 17→32
+
+    def test_max_cap_truncates(self):
+        rows = np.zeros(100, dtype=np.int32)
+        cols = np.arange(100, dtype=np.int32)
+        vals = np.ones(100, dtype=np.float32)
+        (b,) = bucket_ragged(rows, cols, vals, n_rows=1, max_cap=32)
+        assert b.cap == 32
+        assert int(b.mask.sum()) == 32
+
+
+def dense_als_reference(ui, ii, r, n_users, n_items, rank, reg, iters, seed,
+                        weighted=True):
+    """Straightforward numpy ALS with identical init for comparison."""
+    import jax
+
+    key = jax.random.key(seed)
+    v = np.asarray(jax.random.normal(key, (n_items, rank), dtype=np.float32)
+                   ) / np.sqrt(rank)
+    u = np.zeros((n_users, rank), dtype=np.float32)
+    R = np.zeros((n_users, n_items), dtype=np.float32)
+    M = np.zeros((n_users, n_items), dtype=bool)
+    R[ui, ii] = r
+    M[ui, ii] = True
+    for _ in range(iters):
+        for X, Y, Rm, Mm in ((u, v, R, M), (v, u, R.T, M.T)):
+            for row in range(X.shape[0]):
+                sel = Mm[row]
+                n = sel.sum()
+                if n == 0:
+                    continue
+                Ys = Y[sel]
+                lam = reg * (n if weighted else 1.0)
+                A = Ys.T @ Ys + lam * np.eye(rank)
+                X[row] = np.linalg.solve(A, Ys.T @ Rm[row, sel])
+    return u, v
+
+
+class TestALSCorrectness:
+    def test_matches_dense_reference(self):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, density=0.4)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.1, seed=7)
+        res = als_train(ui, ii, r, 30, 20, cfg)
+        u_ref, v_ref = dense_als_reference(ui, ii, r, 30, 20, 4, 0.1, 3, 7)
+        # f32 einsum vs numpy-loop accumulation order → ~1e-3 noise
+        np.testing.assert_allclose(res.user_factors, u_ref, rtol=2e-2, atol=5e-3)
+        np.testing.assert_allclose(res.item_factors, v_ref, rtol=2e-2, atol=5e-3)
+
+    def test_low_rank_recovery_rmse(self):
+        ui, ii, r, _ = synth_ratings(n_users=80, n_items=50, rank=3, density=0.4)
+        cfg = ALSConfig(rank=3, iterations=12, reg=1e-3, seed=0)
+        res = als_train(ui, ii, r, 80, 50, cfg, compute_rmse=True)
+        assert res.rmse_history[-1] < 0.05  # exact low-rank data → tiny residual
+        assert res.rmse_history[-1] <= res.rmse_history[0]
+
+    def test_users_with_no_ratings_stay_zero(self):
+        ui = np.asarray([0, 0, 2], dtype=np.int32)  # user 1 has nothing
+        ii = np.asarray([0, 1, 1], dtype=np.int32)
+        r = np.ones(3, dtype=np.float32)
+        res = als_train(ui, ii, r, 3, 2, ALSConfig(rank=2, iterations=2))
+        assert np.all(res.user_factors[1] == 0)
+        assert np.any(res.user_factors[0] != 0)
+
+    def test_implicit_mode_ranks_observed_higher(self):
+        # two user groups with disjoint item preferences
+        rng = np.random.default_rng(0)
+        ui, ii, r = [], [], []
+        for u in range(20):
+            prefer = range(0, 10) if u < 10 else range(10, 20)
+            for i in rng.choice(list(prefer), 6, replace=False):
+                ui.append(u); ii.append(int(i)); r.append(1.0)
+        ui = np.asarray(ui, np.int32); ii = np.asarray(ii, np.int32)
+        r = np.asarray(r, np.float32)
+        cfg = ALSConfig(rank=8, iterations=8, reg=0.1, implicit=True, alpha=10.0)
+        res = als_train(ui, ii, r, 20, 20, cfg)
+        scores = res.user_factors @ res.item_factors.T
+        # user 0 (likes items 0-9) should score in-group items higher on average
+        assert scores[0, :10].mean() > scores[0, 10:].mean() + 0.1
+
+
+class TestRanking:
+    def test_average_precision(self):
+        assert average_precision_at_k(np.asarray([1, 2, 3]), {1, 2, 3}, 3) == 1.0
+        assert average_precision_at_k(np.asarray([9, 1]), {1}, 2) == pytest.approx(0.5)
+        assert average_precision_at_k(np.asarray([1]), set(), 1) == 0.0
+
+    def test_recommend_topk_excludes(self):
+        u = np.asarray([[1.0, 0.0]])
+        v = np.asarray([[2.0, 0], [1.5, 0], [1.0, 0]])
+        _, idx = recommend_topk(u, v, np.asarray([0]), 2)
+        assert idx[0].tolist() == [0, 1]
+        _, idx = recommend_topk(u, v, np.asarray([0]), 2,
+                                exclude={0: np.asarray([0])})
+        assert idx[0].tolist() == [1, 2]
+
+    def test_map_at_k_end_to_end(self):
+        ui, ii, r, full = synth_ratings(n_users=50, n_items=40, rank=3,
+                                        density=0.35, seed=2)
+        cfg = ALSConfig(rank=3, iterations=10, reg=1e-3)
+        res = als_train(ui, ii, r, 50, 40, cfg)
+        # test set: for each user, the top unrated item by true score
+        rated = {u: set() for u in range(50)}
+        for u_, i_ in zip(ui, ii):
+            rated[int(u_)].add(int(i_))
+        test = {}
+        exclude = {}
+        for u in range(50):
+            unrated = [i for i in range(40) if i not in rated[u]]
+            if unrated:
+                test[u] = {max(unrated, key=lambda i: full[u, i])}
+                exclude[u] = np.asarray(sorted(rated[u]), dtype=np.int32)
+        score = map_at_k(res.user_factors, res.item_factors, test, k=10,
+                         exclude=exclude)
+        assert score > 0.3  # exact low-rank data → should rank well
+
+
+class TestReviewRegressions:
+    def test_engine_requires_algorithm_map(self):
+        from predictionio_tpu.controller import Engine
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="algorithm_class_map"):
+            Engine(data_source_class_map=dict, algorithm_class_map=None)
+
+    def test_resolve_component_strict_on_typo(self):
+        from predictionio_tpu.controller.engine import resolve_component
+        import pytest as _pytest
+
+        class A: pass
+        assert resolve_component({"als": A}, "", "algorithm") is A
+        assert resolve_component({"als": A}, "als", "algorithm") is A
+        with _pytest.raises(KeyError, match="alss"):
+            resolve_component({"als": A}, "alss", "algorithm")
+
+    def test_recommend_topk_no_exclude_no_mask_path(self):
+        u = np.asarray([[1.0, 0.0]])
+        v = np.asarray([[2.0, 0], [1.5, 0], [1.0, 0]])
+        s, idx = recommend_topk(u, v, np.asarray([0]), 2, exclude=None)
+        assert idx[0].tolist() == [0, 1]
+        # empty-dict exclude also takes the unmasked path
+        s, idx = recommend_topk(u, v, np.asarray([0]), 2, exclude={})
+        assert idx[0].tolist() == [0, 1]

@@ -1,0 +1,155 @@
+"""Recommendation template end-to-end: events in storage → DASE train via
+CoreWorkflow → model persistence → query serving — the §7.2 step-4
+'minimum end-to-end slice' (SURVEY.md)."""
+
+import json
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.recommendation.RecommendationEngine"
+
+
+def ingest_ratings(storage, app_name="RecApp", n_users=12, n_items=8, seed=0):
+    """Block structure: even users love even items, odd users love odd."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    rng = np.random.default_rng(seed)
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    expected = {}
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        disliked = [i for i in range(n_items) if i % 2 != u % 2]
+        # rotate the held-out liked item so every item is rated by someone
+        holdout = liked[(u // 2) % len(liked)]
+        for i in liked:
+            if i == holdout:
+                continue
+            le.insert(Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                            target_entity_type="item", target_entity_id=f"i{i}",
+                            properties=DataMap({"rating": 5.0}), event_time=t0),
+                      app_id)
+        for i in disliked[: len(disliked) // 2]:
+            le.insert(Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                            target_entity_type="item", target_entity_id=f"i{i}",
+                            properties=DataMap({"rating": 1.0}), event_time=t0),
+                      app_id)
+        expected[f"u{u}"] = f"i{holdout}"
+    # one "buy" event (implicit 4.0 path)
+    le.insert(Event(event="buy", entity_type="user", entity_id="u0",
+                    target_entity_type="item", target_entity_id="i2",
+                    event_time=t0), app_id)
+    return expected
+
+
+def variant_dict(app_name="RecApp", rank=4, iters=15):
+    return {
+        "id": "rec-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": rank, "numIterations": iters, "lambda": 0.05, "seed": 1}}],
+    }
+
+
+class TestRecommendationEndToEnd:
+    def test_train_and_recommend(self, memory_storage):
+        expected = ingest_ratings(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        # reload through the persistence path, as deploy would
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        result = engine.predict(ep, models, {"user": "u0", "num": 3})
+        items = [s["item"] for s in result["itemScores"]]
+        assert len(items) == 3
+        # scores sorted descending
+        scores = [s["score"] for s in result["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        # the held-out liked item should be the top recommendation
+        assert items[0] == expected["u0"]
+        # seen items are excluded
+        seen_items = {f"i{i}" for i in range(8)} - {expected["u0"]}
+        assert not (set(items) & seen_items) or items[0] == expected["u0"]
+
+    def test_unknown_user_empty_result(self, memory_storage):
+        ingest_ratings(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        models_list = engine.train(ctx, ep)
+        result = engine.predict(ep, models_list, {"user": "ghost", "num": 3})
+        assert result == {"itemScores": []}
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name="EmptyApp"))
+        variant = EngineVariant.from_dict(variant_dict("EmptyApp"))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no rating events"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+        rows = memory_storage.meta_engine_instances().get_all()
+        assert rows[0].status == "FAILED"
+
+    def test_evaluation_with_map_metric(self, memory_storage):
+        ingest_ratings(memory_storage, n_users=16, n_items=10)
+        variant = EngineVariant.from_dict({
+            "id": "rec-eval",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "RecApp", "evalK": 3}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 8, "lambda": 0.05}}],
+        })
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        from predictionio_tpu.controller import OptionAverageMetric
+        from predictionio_tpu.controller.evaluation import Evaluation, MetricEvaluator
+        from predictionio_tpu.ops.ranking import average_precision_at_k
+
+        class MAPat10(OptionAverageMetric):
+            def calculate(self, q, p, a):
+                predicted = np.asarray(
+                    [s["item"] for s in p["itemScores"]], dtype=object)
+                return average_precision_at_k(predicted, set(a["items"]), 10)
+
+        class RecEval(Evaluation):
+            pass
+
+        RecEval.engine = engine
+        RecEval.metric = MAPat10()
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        result = MetricEvaluator.evaluate(ctx, RecEval(), [ep])
+        score = result.best.scores["MAPat10"]
+        assert 0.0 <= score <= 1.0
+        assert not np.isnan(score)
+
+    def test_template_engine_json_parses(self):
+        import os
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "predictionio_tpu", "templates",
+            "recommendation", "engine.json")
+        from predictionio_tpu.workflow.workflow_utils import read_engine_json
+        variant = read_engine_json(path)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        assert ep.algorithm_params_list[0][1].lambda_ == 0.01
+        assert ep.algorithm_params_list[0][1].rank == 10
