@@ -13,6 +13,10 @@ from typing import Optional
 
 import numpy as np
 
+# batches up to this size score on the host (serving path); larger go to
+# the accelerator (eval/bulk path)
+SERVE_HOST_MAX_BATCH = 64
+
 
 @functools.lru_cache(maxsize=16)
 def _topk_fn(k: int, masked: bool):
@@ -43,7 +47,30 @@ def recommend_topk(
     to hide (the 'unseen only' contract of the reference templates)."""
     n_items = item_factors.shape[0]
     k = min(k, n_items)
+    if k <= 0 or len(user_ids) == 0:
+        return (np.zeros((len(user_ids), 0), np.float32),
+                np.zeros((len(user_ids), 0), np.int32))
     masked = bool(exclude)
+    if len(user_ids) <= SERVE_HOST_MAX_BATCH:
+        # Serving fast path: tiny batches score in numpy on the host. A
+        # device round trip costs more than the dot product at any catalog
+        # size that fits serving, and it keeps the prediction server off
+        # the accelerator entirely — a deployed server must not hold the
+        # (single-tenant) TPU that a concurrent `pio train` needs.
+        scores = user_factors[user_ids] @ item_factors.T
+        if masked:
+            for i, uid in enumerate(user_ids):
+                ex = exclude.get(int(uid))
+                if ex is not None and len(ex):
+                    scores[i, ex] = -np.inf
+        idx = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        part = np.take_along_axis(scores, idx, axis=1)
+        order = np.argsort(-part, axis=1)
+        # pin dtypes to the device path's (float32 scores, int32 indices)
+        return (
+            np.take_along_axis(part, order, axis=1).astype(np.float32),
+            np.take_along_axis(idx, order, axis=1).astype(np.int32),
+        )
     fn = _topk_fn(k, masked)
     all_scores, all_idx = [], []
     for s in range(0, len(user_ids), chunk):

@@ -202,6 +202,76 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.workflow.create_server import (
+        PredictionServer,
+        ServerConfig,
+    )
+
+    engine_id, engine_variant = args.engine_id, args.engine_variant
+    if args.engine_json and not (engine_id and engine_variant):
+        # convenience: take the engine id/variant from engine.json, like
+        # the reference console resolving the manifest in the engine dir
+        import os
+
+        if os.path.exists(args.engine_json):
+            from predictionio_tpu.workflow.workflow_utils import read_engine_json
+
+            try:
+                vid = read_engine_json(args.engine_json).id
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"Cannot parse {args.engine_json}: {e} "
+                      "(pass --engine-id/--engine-variant to skip it)",
+                      file=sys.stderr)
+                return 1
+            engine_id = engine_id or vid
+            engine_variant = engine_variant or vid
+    engine_id = engine_id or "default"
+    engine_variant = engine_variant or "default"
+    config = ServerConfig(
+        ip=args.ip,
+        port=args.port,
+        engine_id=engine_id,
+        engine_version=args.engine_version,
+        engine_variant=engine_variant,
+    )
+    try:
+        server = PredictionServer(config)
+    except (RuntimeError, ImportError, AttributeError, ValueError, TypeError,
+            KeyError) as e:
+        print(f"Deploy failed: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"Cannot bind {args.ip}:{args.port}: {e.strerror or e}", file=sys.stderr)
+        return 1
+    print(f"Engine instance {server.instance_id} deployed on "
+          f"{args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def cmd_batchpredict(args) -> int:
+    from predictionio_tpu.workflow.batch_predict import run_batch_predict
+
+    try:
+        n = run_batch_predict(
+            input_path=args.input,
+            output_path=args.output,
+            engine_id=args.engine_id,
+            engine_version=args.engine_version,
+            engine_variant=args.engine_variant,
+        )
+    except (RuntimeError, FileNotFoundError, ValueError, TypeError, KeyError,
+            ImportError, AttributeError) as e:
+        print(f"Batch predict failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Batch predict completed: {n} queries → {args.output}")
+    return 0
+
+
 def _not_wired(verb: str):
     def handler(args) -> int:
         print(
@@ -278,11 +348,26 @@ def build_parser() -> argparse.ArgumentParser:
     add_run_args(ev)
     ev.set_defaults(func=cmd_eval)
 
+    deploy = sub.add_parser("deploy")
+    deploy.add_argument("--ip", default="0.0.0.0")
+    deploy.add_argument("--port", type=int, default=8000)
+    deploy.add_argument("--engine-id", default=None)
+    deploy.add_argument("--engine-version", default="1")
+    deploy.add_argument("--engine-variant", default=None)
+    deploy.add_argument("--engine-json", default="engine.json")
+    deploy.set_defaults(func=cmd_deploy)
+
+    bp = sub.add_parser("batchpredict")
+    bp.add_argument("--input", required=True)
+    bp.add_argument("--output", required=True)
+    bp.add_argument("--engine-id", default="default")
+    bp.add_argument("--engine-version", default="1")
+    bp.add_argument("--engine-variant", default="default")
+    bp.set_defaults(func=cmd_batchpredict)
+
     for verb in (
-        "deploy",
         "import",
         "export",
-        "batchpredict",
         "dashboard",
         "adminserver",
     ):

@@ -1,0 +1,53 @@
+"""BatchPredict — bulk scoring from a queries file.
+
+Parity with «core/.../workflow/BatchPredict.scala» (≥0.12, SURVEY.md §2.1
+[U]): read JSON-lines queries, score them through the deployed engine's
+`batch_predict` path, write JSON-lines {query, prediction} results.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.create_server import ServerConfig, load_served_state
+
+log = logging.getLogger(__name__)
+
+
+def run_batch_predict(
+    input_path: str,
+    output_path: str,
+    engine_id: str = "default",
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    storage: Optional[Storage] = None,
+) -> int:
+    """Returns the number of queries scored."""
+    storage = storage or Storage.get()
+    config = ServerConfig(engine_id=engine_id, engine_version=engine_version,
+                          engine_variant=engine_variant)
+    state = load_served_state(storage, config)
+    _, _, algos, serving = state.components
+
+    queries = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                queries.append(json.loads(line))
+
+    # bulk path: per-algorithm batch_predict (vectorized where the
+    # algorithm overrides it), then serve per query
+    per_algo = [
+        algo.batch_predict(model, queries)
+        for (_, algo), model in zip(algos, state.models)
+    ]
+    with open(output_path, "w") as f:
+        for j, query in enumerate(queries):
+            prediction = serving.serve(query, [preds[j] for preds in per_algo])
+            f.write(json.dumps({"query": query, "prediction": prediction}) + "\n")
+    log.info("BatchPredict: scored %d queries → %s", len(queries), output_path)
+    return len(queries)

@@ -1,0 +1,202 @@
+"""CreateServer — the `pio deploy` prediction server.
+
+Parity with «core/.../workflow/CreateServer.scala :: CreateServer,
+MasterActor, ServerActor» (SURVEY.md §3.2 [U]): load the latest COMPLETED
+EngineInstance, rebuild typed engine params from the stored instance row,
+deserialize models, and serve:
+
+    POST /queries.json  {"user": "1", "num": 4}  → PredictedResult JSON
+    GET  /              → status page (engine info, instance id)
+    POST /reload        → hot-swap to the newest COMPLETED instance
+    POST /stop          → shut the server down
+
+The reference supervises ServerActor with a MasterActor and hot-reloads on
+re-deploy; here the served state is one immutable tuple swapped atomically
+on /reload, and components are resolved once per load (not per query — the
+query path is reflection-free).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ServerConfig:
+    def __init__(
+        self,
+        ip: str = "0.0.0.0",
+        port: int = 8000,
+        engine_id: str = "default",
+        engine_version: str = "1",
+        engine_variant: str = "default",
+    ):
+        self.ip = ip
+        self.port = port
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+
+
+class _ServedState:
+    """Everything needed to answer queries — swapped atomically on reload."""
+
+    def __init__(self, engine, engine_params, components, models,
+                 instance: EngineInstance):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.components = components
+        self.models = models
+        self.instance = instance
+
+
+def variant_from_instance(instance: EngineInstance) -> EngineVariant:
+    """Rebuild an EngineVariant from the params JSON stored on the
+    EngineInstance row (`pio deploy` reads the row, not engine.json —
+    SURVEY.md §3.2)."""
+    return EngineVariant.from_dict({
+        "id": instance.engine_id,
+        "engineFactory": instance.engine_factory,
+        "datasource": {"params": json.loads(instance.data_source_params or "{}")},
+        "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+        "algorithms": json.loads(instance.algorithms_params or "[]") or [{}],
+        "serving": {"params": json.loads(instance.serving_params or "{}")},
+    })
+
+
+def load_served_state(
+    storage: Storage, config: ServerConfig
+) -> _ServedState:
+    instances = storage.meta_engine_instances()
+    instance = instances.get_latest_completed(
+        config.engine_id, config.engine_version, config.engine_variant
+    )
+    if instance is None:
+        raise RuntimeError(
+            f"No completed engine instance found for engine "
+            f"{config.engine_id!r} v{config.engine_version} "
+            f"variant {config.engine_variant!r}. Run `pio-tpu train` first."
+        )
+    variant = variant_from_instance(instance)
+    engine = get_engine(variant.engine_factory)
+    engine_params = extract_engine_params(engine, variant)
+    blob = storage.model_data_models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(f"Model blob for instance {instance.id} is missing.")
+    models = engine.deserialize_models(blob.models, instance.id, engine_params)
+    components = engine.components(engine_params)
+    log.info("Deployed engine instance %s (trained %s)", instance.id,
+             instance.start_time)
+    return _ServedState(engine, engine_params, components, models, instance)
+
+
+class PredictionServer:
+    def __init__(self, config: ServerConfig, storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or Storage.get()
+        self._state = load_served_state(self.storage, config)
+        self._state_lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "pio-tpu-server/0.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                state = server._state
+                if self.path == "/":
+                    return self._send(200, {
+                        "status": "alive",
+                        "engineId": server.config.engine_id,
+                        "engineVersion": server.config.engine_version,
+                        "engineVariant": server.config.engine_variant,
+                        "engineFactory": state.instance.engine_factory,
+                        "engineInstanceId": state.instance.id,
+                        "startTime": state.instance.start_time.isoformat(),
+                    })
+                return self._send(404, {"message": "Not Found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if self.path == "/queries.json":
+                    state = server._state  # snapshot; reload swaps atomically
+                    try:
+                        query = json.loads(body or b"{}")
+                        result = state.engine.predict(
+                            state.engine_params, state.models, query,
+                            components=state.components,
+                        )
+                    except Exception as e:
+                        log.warning("Query failed: %s", e)
+                        return self._send(400, {"message": str(e)})
+                    return self._send(200, result)
+                if self.path == "/reload":
+                    try:
+                        with server._state_lock:
+                            server._state = load_served_state(
+                                server.storage, server.config)
+                    except Exception as e:
+                        return self._send(500, {"message": str(e)})
+                    return self._send(200, {
+                        "message": "Reloaded",
+                        "engineInstanceId": server._state.instance.id,
+                    })
+                if self.path == "/stop":
+                    self._send(200, {"message": "Shutting down."})
+                    threading.Thread(target=server.shutdown, daemon=True).start()
+                    return None
+                return self._send(404, {"message": "Not Found"})
+
+        self.httpd = ThreadingHTTPServer((config.ip, config.port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def instance_id(self) -> str:
+        return self._state.instance.id
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def create_server(config: Optional[ServerConfig] = None,
+                  storage: Optional[Storage] = None) -> PredictionServer:
+    return PredictionServer(config or ServerConfig(), storage)

@@ -1,0 +1,164 @@
+"""Prediction server conformance: deploy from stored instance, /queries.json,
+hot-reload on retrain, /stop — SURVEY.md §3.2 contract."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.workflow.batch_predict import run_batch_predict
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.create_server import (
+    PredictionServer,
+    ServerConfig,
+    load_served_state,
+)
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+from tests.test_recommendation_template import FACTORY, ingest_ratings, variant_dict
+
+
+def train_once(storage, iters=10):
+    variant = EngineVariant.from_dict(variant_dict(iters=iters))
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    ctx = WorkflowContext(storage=storage, seed=1)
+    return CoreWorkflow.run_train(engine, ep, variant, ctx)
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def deployed(memory_storage):
+    expected = ingest_ratings(memory_storage)
+    train_once(memory_storage)
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                          engine_variant="rec-test")
+    server = PredictionServer(config, memory_storage)
+    server.start()
+    yield server, expected, memory_storage
+    server.shutdown()
+
+
+class TestPredictionServer:
+    def test_status_page(self, deployed):
+        server, _, _ = deployed
+        status, body = call(server.port, "GET", "/")
+        assert status == 200
+        assert body["engineFactory"] == FACTORY
+        assert body["engineInstanceId"] == server.instance_id
+
+    def test_queries(self, deployed):
+        server, expected, _ = deployed
+        status, body = call(server.port, "POST", "/queries.json",
+                            {"user": "u0", "num": 3})
+        assert status == 200
+        items = [s["item"] for s in body["itemScores"]]
+        assert items[0] == expected["u0"]
+        # unknown user → empty scores, not an error
+        status, body = call(server.port, "POST", "/queries.json",
+                            {"user": "nobody", "num": 3})
+        assert status == 200 and body == {"itemScores": []}
+
+    def test_malformed_query_400(self, deployed):
+        server, _, _ = deployed
+        status, _ = call(server.port, "POST", "/queries.json", {"num": 3})
+        assert status == 400  # missing "user" key
+
+    def test_deploy_without_training_fails_cleanly(self, memory_storage):
+        config = ServerConfig(engine_id="never-trained")
+        with pytest.raises(RuntimeError, match="No completed engine instance"):
+            load_served_state(memory_storage, config)
+
+    def test_hot_reload_serves_new_instance(self, deployed):
+        server, _, storage = deployed
+        old_id = server.instance_id
+        new_instance = train_once(storage, iters=12)  # retrain
+        status, body = call(server.port, "POST", "/reload")
+        assert status == 200
+        assert body["engineInstanceId"] == new_instance.id != old_id
+        # still serves queries after reload
+        status, _ = call(server.port, "POST", "/queries.json",
+                         {"user": "u0", "num": 2})
+        assert status == 200
+
+    def test_stop_endpoint(self, memory_storage):
+        ingest_ratings(memory_storage)
+        train_once(memory_storage)
+        config = ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                              engine_variant="rec-test")
+        server = PredictionServer(config, memory_storage)
+        server.start()
+        status, body = call(server.port, "POST", "/stop")
+        assert status == 200
+        import time
+        for _ in range(50):  # wait for socket to close
+            time.sleep(0.1)
+            try:
+                call(server.port, "GET", "/")
+            except (ConnectionError, urllib.error.URLError, OSError):
+                break
+        else:
+            pytest.fail("server still alive after /stop")
+
+
+class TestBatchPredict:
+    def test_batch_predict_roundtrip(self, deployed, tmp_path):
+        server, expected, storage = deployed
+        inp = tmp_path / "queries.jsonl"
+        out = tmp_path / "out.jsonl"
+        inp.write_text('{"user": "u0", "num": 2}\n{"user": "u1", "num": 2}\n')
+        n = run_batch_predict(str(inp), str(out), engine_id="rec-test",
+                              engine_variant="rec-test", storage=storage)
+        assert n == 2
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert lines[0]["query"] == {"user": "u0", "num": 2}
+        assert lines[0]["prediction"]["itemScores"][0]["item"] == expected["u0"]
+        assert lines[1]["prediction"]["itemScores"][0]["item"] == expected["u1"]
+
+
+class TestReviewRegressions:
+    def test_topk_dtypes_consistent_across_batch_sizes(self):
+        import numpy as np
+        from predictionio_tpu.ops.ranking import recommend_topk
+
+        u = np.random.default_rng(0).normal(size=(100, 4)).astype(np.float32)
+        v = np.random.default_rng(1).normal(size=(20, 4)).astype(np.float32)
+        s_small, i_small = recommend_topk(u, v, np.arange(3, dtype=np.int32), 5)
+        s_big, i_big = recommend_topk(u, v, np.arange(100, dtype=np.int32), 5)
+        assert s_small.dtype == s_big.dtype == np.float32
+        assert i_small.dtype == i_big.dtype == np.int32
+        # same answers either path
+        np.testing.assert_array_equal(i_small, i_big[:3])
+
+    def test_deploy_cli_bad_engine_json(self, memory_storage, tmp_path, capsys):
+        from predictionio_tpu.tools.console import main
+
+        bad = tmp_path / "engine.json"
+        bad.write_text("{not json")
+        rc = main(["deploy", "--engine-json", str(bad), "--port", "0"])
+        assert rc == 1
+        assert "Cannot parse" in capsys.readouterr().err
+
+    def test_deploy_cli_untrained_clean(self, memory_storage, capsys):
+        from predictionio_tpu.tools.console import main
+
+        rc = main(["deploy", "--engine-id", "ghost", "--engine-variant", "ghost",
+                   "--engine-json", "/nonexistent", "--port", "0"])
+        assert rc == 1
+        assert "Deploy failed" in capsys.readouterr().err
