@@ -26,9 +26,11 @@ import sqlite3
 import threading
 import time
 from collections import Counter
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.utils.http import HttpService
 
 from predictionio_tpu.data.events import (
     Event,
@@ -276,7 +278,7 @@ class _EventHandler(BaseHTTPRequestHandler):
         return self._send_json(404, {"message": "Not Found"})
 
 
-class EventServer:
+class EventServer(HttpService):
     """Owns the HTTP server thread; `create_event_server` is the reference's
     factory spelling."""
 
@@ -290,25 +292,7 @@ class EventServer:
             (_EventHandler,),
             {"storage": self.storage, "stats": self.stats},
         )
-        self.httpd = ThreadingHTTPServer((config.ip, config.port), handler)
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-
-    def serve_forever(self) -> None:
-        self.httpd.serve_forever()
-
-    def shutdown(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        super().__init__(config.ip, config.port, handler)
 
 
 def create_event_server(

@@ -28,6 +28,23 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def _apply_platform_override() -> None:
+    """Honor PIO_JAX_PLATFORM (e.g. "cpu") before first backend use.
+
+    Needed because this image's sitecustomize force-registers the single-
+    tenant axon TPU backend; running a CPU-only train/eval next to a
+    process holding the TPU requires overriding the platform in config
+    (the env var alone is read too early to win)."""
+    import os
+
+    want = os.environ.get("PIO_JAX_PLATFORM")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception as e:  # already initialized to something else
+            log.warning("PIO_JAX_PLATFORM=%s ignored: %s", want, e)
+
+
 def make_mesh(
     mesh_shape: Optional[dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -38,6 +55,8 @@ def make_mesh(
     the right shape for every reference workload up to config 4; config 5
     (rank-128 ALS on v5e-64) wants e.g. ``{"data": 16, "model": 4}``.
     """
+    if devices is None:
+        _apply_platform_override()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if mesh_shape is None:

@@ -1,0 +1,70 @@
+"""Recommendation template evaluation: MAP@k over a params grid.
+
+Parity with the reference Recommendation template's `Evaluation.scala`
+(MAP@k metric + `EngineParamsGenerator` grid — SURVEY.md §2.4 [U]).
+Run with:
+
+    pio-tpu eval predictionio_tpu.templates.recommendation.evaluation.RecommendationEvaluation
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import OptionAverageMetric
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import EngineParamsGenerator, Evaluation
+from predictionio_tpu.ops.ranking import average_precision_at_k
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+
+
+class MAPatK(OptionAverageMetric):
+    """MAP@k on {"itemScores": [...]} predictions vs {"items": [...]} actuals."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def name(self) -> str:
+        return f"MAP@{self.k}"
+
+    def calculate(self, query, predicted, actual):
+        items = [s["item"] for s in predicted.get("itemScores", [])]
+        actual_set = set(actual.get("items", []))
+        if not actual_set:
+            return None  # excluded from the mean (OptionAverageMetric)
+        return average_precision_at_k(items, actual_set, self.k)
+
+
+def _engine_params(rank: int, iters: int, lam: float,
+                   app_name: str, eval_k: int) -> EngineParams:
+    return EngineParams(
+        data_source_name="",
+        data_source_params=DataSourceParams(appName=app_name, evalK=eval_k),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=rank, numIterations=iters,
+                                       lambda_=lam))
+        ],
+    )
+
+
+class RecommendationEvaluation(Evaluation, EngineParamsGenerator):
+    """Grid over rank × lambda, primary metric MAP@10. App name comes from
+    the PIO_EVAL_APP_NAME env var (default "MyApp1") so the CLI needs no
+    extra plumbing, mirroring how the reference template hardcodes it in
+    the evaluation object."""
+
+    def __init__(self):
+        import os
+
+        app_name = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        eval_k = int(os.environ.get("PIO_EVAL_K", "3"))
+        self.engine = RecommendationEngine().apply()
+        self.metric = MAPatK(10)
+        self.engine_params_list = [
+            _engine_params(rank, 20, lam, app_name, eval_k)
+            for rank in (8, 16)
+            for lam in (0.01, 0.1)
+        ]

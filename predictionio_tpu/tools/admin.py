@@ -1,0 +1,73 @@
+"""Admin server — REST app/key CRUD on :7071.
+
+Parity with «tools/.../tools/admin/AdminServer.scala» (SURVEY.md §2.3 [U],
+marked experimental upstream). All mutations go through the shared
+CommandClient so console and admin semantics stay identical. Routes:
+
+    GET    /                      → {"status": "alive"}
+    GET    /cmd/app               → list apps
+    POST   /cmd/app               → create app  {"name": ..., "description": ...}
+    DELETE /cmd/app/<name>        → delete app (+ keys, channels, events)
+    DELETE /cmd/app/<name>/data   → delete app's events (all channels)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.tools.command_client import CommandClient
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+
+class AdminServer(HttpService):
+    def __init__(self, ip: str = "0.0.0.0", port: int = 7071,
+                 storage: Optional[Storage] = None):
+        client = CommandClient(storage)
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                self.read_body()
+                if self.path == "/":
+                    return self.send_json(200, {"status": "alive"})
+                if self.path == "/cmd/app":
+                    return self.send_json(200, [
+                        {"name": a.name, "id": a.id, "accessKeys": a.access_keys}
+                        for a in client.list_apps()
+                    ])
+                return self.send_json(404, {"message": "Not Found"})
+
+            def do_POST(self):
+                body = self.read_body()
+                if self.path == "/cmd/app":
+                    try:
+                        d = json.loads(body or b"{}")
+                        name = d["name"]
+                    except (json.JSONDecodeError, KeyError, TypeError):
+                        return self.send_json(
+                            400, {"message": 'body must be {"name": ...}'})
+                    created = client.create_app(name, d.get("description", ""))
+                    if created is None:
+                        return self.send_json(409, {"message": f"App {name!r} exists."})
+                    app_id, key = created
+                    return self.send_json(201, {"name": name, "id": app_id,
+                                                "accessKey": key})
+                return self.send_json(404, {"message": "Not Found"})
+
+            def do_DELETE(self):
+                self.read_body()
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) >= 3 and parts[:2] == ["cmd", "app"]:
+                    name = parts[2]
+                    if len(parts) == 3:
+                        if client.delete_app(name):
+                            return self.send_json(200, {"message": f"Deleted {name}."})
+                        return self.send_json(404, {"message": "Not Found"})
+                    if len(parts) == 4 and parts[3] == "data":
+                        if client.delete_app_data(name):
+                            return self.send_json(200, {"message": "Data deleted."})
+                        return self.send_json(404, {"message": "Not Found"})
+                return self.send_json(404, {"message": "Not Found"})
+
+        super().__init__(ip, port, Handler)

@@ -1,0 +1,83 @@
+"""CommandClient — shared app/key lifecycle operations.
+
+Parity with «tools/.../tools/admin/CommandClient.scala» (SURVEY.md §2.3
+[U]): one implementation of app create/delete/data-delete shared by the
+console verbs and the admin server so the two can't drift (app deletion
+must also remove access keys, ALL channels and their events, not just the
+default channel's).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from predictionio_tpu.storage.base import AccessKey, App, Channel
+from predictionio_tpu.storage.registry import Storage
+
+
+@dataclasses.dataclass
+class AppInfo:
+    id: int
+    name: str
+    description: str
+    access_keys: list[str]
+
+
+class CommandClient:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.storage = storage or Storage.get()
+
+    def create_app(self, name: str, description: str = "") -> Optional[tuple[int, str]]:
+        """Returns (app_id, access_key) or None if the name is taken."""
+        app_id = self.storage.meta_apps().insert(
+            App(id=0, name=name, description=description))
+        if app_id is None:
+            return None
+        key = AccessKey.generate(app_id)
+        self.storage.meta_access_keys().insert(key)
+        return app_id, key.key
+
+    def list_apps(self) -> list[AppInfo]:
+        keys = self.storage.meta_access_keys()
+        return [
+            AppInfo(a.id, a.name, a.description,
+                    [k.key for k in keys.get_by_app_id(a.id)])
+            for a in self.storage.meta_apps().get_all()
+        ]
+
+    def get_app(self, name: str) -> Optional[App]:
+        return self.storage.meta_apps().get_by_name(name)
+
+    def delete_app_data(self, name: str) -> bool:
+        """Delete the app's events across the default channel AND every
+        named channel."""
+        app = self.get_app(name)
+        if app is None:
+            return False
+        le = self.storage.l_events()
+        le.remove(app.id)
+        for channel in self.storage.meta_channels().get_by_app_id(app.id):
+            le.remove(app.id, channel.id)
+        return True
+
+    def delete_app(self, name: str) -> bool:
+        """Delete the app, its access keys, its channels, and all events."""
+        app = self.get_app(name)
+        if app is None:
+            return False
+        self.delete_app_data(name)
+        channels = self.storage.meta_channels()
+        for channel in channels.get_by_app_id(app.id):
+            channels.delete(channel.id)
+        keys = self.storage.meta_access_keys()
+        for k in keys.get_by_app_id(app.id):
+            keys.delete(k.key)
+        return self.storage.meta_apps().delete(app.id)
+
+    def create_channel(self, app_name: str, channel_name: str) -> Optional[int]:
+        app = self.get_app(app_name)
+        if app is None:
+            return None
+        return self.storage.meta_channels().insert(
+            Channel(id=0, name=channel_name, app_id=app.id))

@@ -35,56 +35,41 @@ def cmd_status(args) -> int:
 
 
 def cmd_app(args) -> int:
-    from predictionio_tpu.storage import AccessKey, App, Channel, Storage
+    from predictionio_tpu.tools.command_client import CommandClient
 
-    storage = Storage.get()
-    apps = storage.meta_apps()
-    keys = storage.meta_access_keys()
+    client = CommandClient()
     if args.app_command == "new":
-        app_id = apps.insert(App(id=0, name=args.name, description=args.description or ""))
-        if app_id is None:
+        created = client.create_app(args.name, args.description or "")
+        if created is None:
             print(f"App {args.name!r} already exists.", file=sys.stderr)
             return 1
-        key = AccessKey.generate(app_id)
-        keys.insert(key)
+        app_id, key = created
         print(f"Created a new app:")
         print(f"      Name: {args.name}")
         print(f"        ID: {app_id}")
-        print(f"Access Key: {key.key}")
+        print(f"Access Key: {key}")
         return 0
     if args.app_command == "list":
-        for app in apps.get_all():
-            ks = keys.get_by_app_id(app.id)
-            key_str = ks[0].key if ks else "(none)"
-            print(f"  {app.id} {app.name} key={key_str}")
+        for info in client.list_apps():
+            key_str = info.access_keys[0] if info.access_keys else "(none)"
+            print(f"  {info.id} {info.name} key={key_str}")
         return 0
     if args.app_command == "delete":
-        app = apps.get_by_name(args.name)
-        if app is None:
+        if not client.delete_app(args.name):
             print(f"App {args.name!r} does not exist.", file=sys.stderr)
             return 1
-        for k in keys.get_by_app_id(app.id):
-            keys.delete(k.key)
-        storage.l_events().remove(app.id)
-        apps.delete(app.id)
         print(f"Deleted app {args.name}.")
         return 0
     if args.app_command == "data-delete":
-        app = apps.get_by_name(args.name)
-        if app is None:
+        if not client.delete_app_data(args.name):
             print(f"App {args.name!r} does not exist.", file=sys.stderr)
             return 1
-        storage.l_events().remove(app.id)
         print(f"Deleted all events of app {args.name}.")
         return 0
     if args.app_command == "channel-new":
-        app = apps.get_by_name(args.name)
-        if app is None:
-            print(f"App {args.name!r} does not exist.", file=sys.stderr)
-            return 1
-        cid = storage.meta_channels().insert(Channel(id=0, name=args.channel, app_id=app.id))
+        cid = client.create_channel(args.name, args.channel)
         if cid is None:
-            print(f"Invalid or duplicate channel name {args.channel!r}.", file=sys.stderr)
+            print(f"Unknown app or invalid/duplicate channel name.", file=sys.stderr)
             return 1
         print(f"Created channel {args.channel} (id={cid}) for app {args.name}.")
         return 0
@@ -125,18 +110,11 @@ def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api import EventServer, EventServerConfig
 
     config = EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
-    try:
-        server = EventServer(config)
-    except OSError as e:
-        print(f"Cannot bind {args.ip}:{args.port}: {e.strerror or e}", file=sys.stderr)
-        return 1
-    print(f"Event Server listening on {args.ip}:{server.port} "
-          f"(stats={'on' if args.stats else 'off'})")
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        server.shutdown()
-    return 0
+    return _run_service(
+        lambda: EventServer(config),
+        f"Event Server (stats={'on' if args.stats else 'off'})",
+        args.ip, args.port,
+    )
 
 
 def cmd_build(args) -> int:
@@ -272,16 +250,57 @@ def cmd_batchpredict(args) -> int:
     return 0
 
 
-def _not_wired(verb: str):
-    def handler(args) -> int:
-        print(
-            f"`pio-tpu {verb}` is not wired up yet in this build; "
-            "see SURVEY.md §7.2 for the construction order.",
-            file=sys.stderr,
-        )
-        return 2
+def cmd_import(args) -> int:
+    from predictionio_tpu.tools.transfer import file_to_events
 
-    return handler
+    try:
+        imported, skipped = file_to_events(args.input, args.appname, args.channel)
+    except (ValueError, OSError) as e:
+        print(f"Import failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Imported {imported} events" +
+          (f" ({skipped} invalid lines skipped)" if skipped else "") + ".")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.tools.transfer import events_to_file
+
+    try:
+        n = events_to_file(args.output, args.appname, args.channel)
+    except (ValueError, OSError) as e:
+        print(f"Export failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Exported {n} events to {args.output}.")
+    return 0
+
+
+def _run_service(make_server, what: str, ip: str, port: int) -> int:
+    try:
+        server = make_server()
+    except OSError as e:
+        print(f"Cannot bind {ip}:{port}: {e.strerror or e}", file=sys.stderr)
+        return 1
+    print(f"{what} listening on {ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import Dashboard
+
+    return _run_service(lambda: Dashboard(ip=args.ip, port=args.port),
+                        "Dashboard", args.ip, args.port)
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    return _run_service(lambda: AdminServer(ip=args.ip, port=args.port),
+                        "Admin server", args.ip, args.port)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -365,15 +384,27 @@ def build_parser() -> argparse.ArgumentParser:
     bp.add_argument("--engine-variant", default="default")
     bp.set_defaults(func=cmd_batchpredict)
 
-    for verb in (
-        "import",
-        "export",
-        "dashboard",
-        "adminserver",
-    ):
-        sp = sub.add_parser(verb)
-        sp.set_defaults(func=_not_wired(verb))
-        sp.add_argument("rest", nargs=argparse.REMAINDER)
+    imp = sub.add_parser("import")
+    imp.add_argument("--appname", required=True)
+    imp.add_argument("--input", required=True)
+    imp.add_argument("--channel", default=None)
+    imp.set_defaults(func=cmd_import)
+
+    exp = sub.add_parser("export")
+    exp.add_argument("--appname", required=True)
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--channel", default=None)
+    exp.set_defaults(func=cmd_export)
+
+    dash = sub.add_parser("dashboard")
+    dash.add_argument("--ip", default="0.0.0.0")
+    dash.add_argument("--port", type=int, default=9000)
+    dash.set_defaults(func=cmd_dashboard)
+
+    adm = sub.add_parser("adminserver")
+    adm.add_argument("--ip", default="0.0.0.0")
+    adm.add_argument("--port", type=int, default=7071)
+    adm.set_defaults(func=cmd_adminserver)
 
     return p
 

@@ -1,0 +1,94 @@
+"""Dashboard — web UI listing evaluation + engine instances.
+
+Parity with «tools/.../tools/dashboard/Dashboard.scala» (SURVEY.md §2.3
+[U]): the reference serves a page on :9000 listing completed evaluation
+instances with their params and scores; engine instances are shown too for
+train-run visibility.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from typing import Optional
+
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+_PAGE = """<!doctype html>
+<html><head><title>pio-tpu dashboard</title>
+<style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 2em; }}
+th, td {{ border: 1px solid #ccc; padding: 6px 10px; text-align: left;
+          vertical-align: top; }}
+th {{ background: #f0f0f0; }}
+pre {{ margin: 0; font-size: 12px; white-space: pre-wrap; max-width: 48em; }}
+.status-COMPLETED, .status-EVALCOMPLETED {{ color: #087f23; }}
+.status-FAILED, .status-EVALFAILED {{ color: #ba000d; }}
+.status-RUNNING, .status-EVALRUNNING {{ color: #a06f00; }}
+</style></head><body>
+<h1>pio-tpu dashboard</h1>
+<h2>Completed evaluations</h2>
+{evals}
+<h2>Engine instances</h2>
+{instances}
+</body></html>"""
+
+
+def _eval_table(rows) -> str:
+    if not rows:
+        return "<p>No completed evaluations.</p>"
+    out = ["<table><tr><th>ID</th><th>Started</th><th>Evaluation</th>"
+           "<th>Results</th></tr>"]
+    for r in rows:
+        out.append(
+            f"<tr><td>{html.escape(r.id)}</td>"
+            f"<td>{r.start_time:%Y-%m-%d %H:%M:%S}</td>"
+            f"<td>{html.escape(r.evaluation_class)}</td>"
+            f"<td><pre>{html.escape(r.evaluator_results)}</pre></td></tr>"
+        )
+    out.append("</table>")
+    return "".join(out)
+
+
+def _instance_table(rows) -> str:
+    if not rows:
+        return "<p>No engine instances.</p>"
+    out = ["<table><tr><th>ID</th><th>Status</th><th>Engine</th>"
+           "<th>Started</th><th>Algorithms</th></tr>"]
+    for r in rows:
+        try:
+            algos = json.dumps(json.loads(r.algorithms_params), indent=1)
+        except ValueError:
+            algos = r.algorithms_params
+        out.append(
+            f"<tr><td>{html.escape(r.id)}</td>"
+            f"<td class='status-{html.escape(r.status)}'>{html.escape(r.status)}</td>"
+            f"<td>{html.escape(r.engine_factory)}</td>"
+            f"<td>{r.start_time:%Y-%m-%d %H:%M:%S}</td>"
+            f"<td><pre>{html.escape(algos)}</pre></td></tr>"
+        )
+    out.append("</table>")
+    return "".join(out)
+
+
+class Dashboard(HttpService):
+    def __init__(self, ip: str = "0.0.0.0", port: int = 9000,
+                 storage: Optional[Storage] = None):
+        self.storage = storage or Storage.get()
+        dashboard = self
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                self.read_body()
+                if self.path not in ("/", "/index.html"):
+                    return self.send_json(404, {"message": "Not Found"})
+                evals = dashboard.storage.meta_evaluation_instances().get_completed()
+                instances = dashboard.storage.meta_engine_instances().get_all()
+                return self.send_html(200, _PAGE.format(
+                    evals=_eval_table(evals),
+                    instances=_instance_table(instances),
+                ))
+
+        super().__init__(ip, port, Handler)

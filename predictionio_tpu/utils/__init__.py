@@ -1,0 +1,1 @@
+"""Shared utilities: HTTP service scaffolding, logging helpers."""

@@ -1,0 +1,69 @@
+"""Shared HTTP service scaffolding.
+
+All four services (event server :7070, prediction server :8000, dashboard
+:9000, admin server :7071 — SURVEY.md §1 L5) are threaded stdlib HTTP
+servers with the same lifecycle; this base class carries it once.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Type
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Base handler: JSON responses, silenced access log, body drain."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_html(self, code: int, html_body: str) -> None:
+        body = html_body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_body(self) -> bytes:
+        """Drain the request body (required before any early reply on
+        HTTP/1.1 keep-alive connections)."""
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+
+class HttpService:
+    """Owns a ThreadingHTTPServer + background thread lifecycle."""
+
+    def __init__(self, ip: str, port: int, handler_cls: Type[BaseHTTPRequestHandler]):
+        self.httpd = ThreadingHTTPServer((ip, port), handler_cls)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

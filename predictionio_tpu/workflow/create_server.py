@@ -21,8 +21,10 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
+
+from predictionio_tpu.utils.http import HttpService
 
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
@@ -103,7 +105,7 @@ def load_served_state(
     return _ServedState(engine, engine_params, components, models, instance)
 
 
-class PredictionServer:
+class PredictionServer(HttpService):
     def __init__(self, config: ServerConfig, storage: Optional[Storage] = None):
         self.config = config
         self.storage = storage or Storage.get()
@@ -172,29 +174,11 @@ class PredictionServer:
                     return None
                 return self._send(404, {"message": "Not Found"})
 
-        self.httpd = ThreadingHTTPServer((config.ip, config.port), Handler)
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
+        HttpService.__init__(self, config.ip, config.port, Handler)
 
     @property
     def instance_id(self) -> str:
         return self._state.instance.id
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-
-    def serve_forever(self) -> None:
-        self.httpd.serve_forever()
-
-    def shutdown(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
 
 
 def create_server(config: Optional[ServerConfig] = None,

@@ -1,0 +1,215 @@
+"""Tools: import/export round-trip, dashboard, admin server, console verbs —
+mirrors the reference's tools specs (SURVEY.md §4.1)."""
+
+import json
+import urllib.error
+import urllib.request
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.tools.admin import AdminServer
+from predictionio_tpu.tools.console import main
+from predictionio_tpu.tools.dashboard import Dashboard
+from predictionio_tpu.tools.transfer import events_to_file, file_to_events
+
+
+def ts(h):
+    return datetime(2026, 1, 1, h, tzinfo=timezone.utc)
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            raw = resp.read()
+            return resp.status, (json.loads(raw) if "json" in ctype
+                                 else raw.decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class TestImportExport:
+    def test_roundtrip(self, memory_storage, tmp_path):
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="IOApp"))
+        le = memory_storage.l_events()
+        for i in range(5):
+            le.insert(Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                            target_entity_type="item", target_entity_id="i1",
+                            properties=DataMap({"rating": float(i)}),
+                            event_time=ts(i)), app_id)
+        out = tmp_path / "events.jsonl"
+        n = events_to_file(str(out), "IOApp", storage=memory_storage)
+        assert n == 5
+
+        app2 = memory_storage.meta_apps().insert(App(id=0, name="IOApp2"))
+        imported, skipped = file_to_events(str(out), "IOApp2",
+                                           storage=memory_storage)
+        assert (imported, skipped) == (5, 0)
+        events = list(le.find(app_id=app2, limit=10))
+        assert len(events) == 5
+        assert events[0].properties.to_dict() == {"rating": 0.0}
+
+    def test_import_skips_bad_lines(self, memory_storage, tmp_path):
+        memory_storage.meta_apps().insert(App(id=0, name="IOApp"))
+        f = tmp_path / "mixed.jsonl"
+        f.write_text(
+            '{"event": "view", "entityType": "user", "entityId": "u1"}\n'
+            "not json at all\n"
+            '{"event": "$delete", "entityType": "user", "entityId": "u1", '
+            '"properties": {"x": 1}}\n'
+        )
+        imported, skipped = file_to_events(str(f), "IOApp", storage=memory_storage)
+        assert (imported, skipped) == (1, 2)
+
+    def test_unknown_app_errors(self, memory_storage, tmp_path):
+        with pytest.raises(ValueError, match="does not exist"):
+            events_to_file(str(tmp_path / "x"), "Nope", storage=memory_storage)
+
+    def test_cli_verbs(self, memory_storage, tmp_path, capsys):
+        memory_storage.meta_apps().insert(App(id=0, name="CliApp"))
+        f = tmp_path / "e.jsonl"
+        f.write_text('{"event": "view", "entityType": "user", "entityId": "u"}\n')
+        assert main(["import", "--appname", "CliApp", "--input", str(f)]) == 0
+        out = tmp_path / "o.jsonl"
+        assert main(["export", "--appname", "CliApp", "--output", str(out)]) == 0
+        assert len(out.read_text().splitlines()) == 1
+        assert main(["export", "--appname", "Ghost", "--output", str(out)]) == 1
+
+
+class TestDashboard:
+    def test_lists_instances_and_evals(self, memory_storage):
+        from predictionio_tpu.controller import WorkflowContext
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+        from tests.test_recommendation_template import ingest_ratings
+        from tests.test_prediction_server import train_once
+
+        ingest_ratings(memory_storage)
+        train_once(memory_storage, iters=3)
+        dash = Dashboard(ip="127.0.0.1", port=0, storage=memory_storage)
+        dash.start()
+        try:
+            status, page = call(dash.port, "GET", "/")
+            assert status == 200
+            assert "RecommendationEngine" in page
+            assert "COMPLETED" in page
+            assert call(dash.port, "GET", "/nope")[0] == 404
+        finally:
+            dash.shutdown()
+
+
+class TestAdminServer:
+    @pytest.fixture()
+    def admin(self, memory_storage):
+        server = AdminServer(ip="127.0.0.1", port=0, storage=memory_storage)
+        server.start()
+        yield server
+        server.shutdown()
+
+    def test_app_crud(self, admin, memory_storage):
+        status, body = call(admin.port, "POST", "/cmd/app", {"name": "AdmApp"})
+        assert status == 201 and body["accessKey"]
+        # duplicate
+        assert call(admin.port, "POST", "/cmd/app", {"name": "AdmApp"})[0] == 409
+        status, apps = call(admin.port, "GET", "/cmd/app")
+        assert [a["name"] for a in apps] == ["AdmApp"]
+        # data delete then app delete
+        assert call(admin.port, "DELETE", "/cmd/app/AdmApp/data")[0] == 200
+        assert call(admin.port, "DELETE", "/cmd/app/AdmApp")[0] == 200
+        assert call(admin.port, "GET", "/cmd/app")[1] == []
+        assert call(admin.port, "DELETE", "/cmd/app/AdmApp")[0] == 404
+
+    def test_bad_body(self, admin):
+        assert call(admin.port, "POST", "/cmd/app", {"nope": 1})[0] == 400
+
+
+class TestRecommendationEvaluationTemplate:
+    def test_map_metric(self):
+        from predictionio_tpu.templates.recommendation.evaluation import MAPatK
+
+        m = MAPatK(2)
+        assert m.name == "MAP@2"
+        score = m.calculate(
+            {}, {"itemScores": [{"item": "a", "score": 1.0},
+                                {"item": "b", "score": 0.5}]},
+            {"items": ["b"]})
+        assert score == pytest.approx(0.5)
+        assert m.calculate({}, {"itemScores": []}, {"items": []}) is None
+
+    def test_grid_evaluation_runs(self, memory_storage, monkeypatch):
+        from predictionio_tpu.controller import WorkflowContext
+        from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+        from predictionio_tpu.templates.recommendation.evaluation import (
+            RecommendationEvaluation,
+        )
+        from tests.test_recommendation_template import ingest_ratings
+
+        ingest_ratings(memory_storage, n_users=12, n_items=8)
+        monkeypatch.setenv("PIO_EVAL_APP_NAME", "RecApp")
+        monkeypatch.setenv("PIO_EVAL_K", "2")
+        ev = RecommendationEvaluation()
+        ev.engine_params_list = ev.engine_params_list[:2]  # keep the test fast
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        instance, result = CoreWorkflow.run_evaluation(ev, ev, ctx)
+        assert instance.status == "EVALCOMPLETED"
+        assert "MAP@10" in instance.evaluator_results
+
+
+class TestCommandClientRegressions:
+    """App deletion must clean up channels and channel-scoped events."""
+
+    def test_delete_app_removes_channels_and_channel_events(self, memory_storage):
+        from predictionio_tpu.tools.command_client import CommandClient
+
+        client = CommandClient(memory_storage)
+        app_id, _ = client.create_app("ChApp")
+        cid = client.create_channel("ChApp", "ch1")
+        le = memory_storage.l_events()
+        le.insert(Event(event="view", entity_type="user", entity_id="u",
+                        event_time=ts(1)), app_id)
+        le.insert(Event(event="view", entity_type="user", entity_id="u",
+                        event_time=ts(1)), app_id, channel_id=cid)
+
+        assert client.delete_app("ChApp")
+        assert memory_storage.meta_apps().get_by_name("ChApp") is None
+        assert memory_storage.meta_channels().get_by_app_id(app_id) == []
+        assert list(le.find(app_id=app_id)) == []
+        assert list(le.find(app_id=app_id, channel_id=cid)) == []
+
+    def test_data_delete_covers_all_channels(self, memory_storage):
+        from predictionio_tpu.tools.command_client import CommandClient
+
+        client = CommandClient(memory_storage)
+        app_id, _ = client.create_app("ChApp2")
+        cid = client.create_channel("ChApp2", "ch1")
+        le = memory_storage.l_events()
+        le.insert(Event(event="view", entity_type="user", entity_id="u",
+                        event_time=ts(1)), app_id, channel_id=cid)
+        assert client.delete_app_data("ChApp2")
+        assert list(le.find(app_id=app_id, channel_id=cid)) == []
+        # app itself survives a data-delete
+        assert memory_storage.meta_apps().get_by_name("ChApp2") is not None
+
+    def test_import_tolerates_type_errors(self, memory_storage, tmp_path):
+        memory_storage.meta_apps().insert(App(id=0, name="TolApp"))
+        f = tmp_path / "bad_tags.jsonl"
+        f.write_text(
+            '{"event": "view", "entityType": "user", "entityId": "u", "tags": 5}\n'
+            '{"event": "view", "entityType": "user", "entityId": "u2"}\n')
+        imported, skipped = file_to_events(str(f), "TolApp",
+                                           storage=memory_storage)
+        assert (imported, skipped) == (1, 1)
+
+    def test_export_to_directory_clean_cli_error(self, memory_storage, tmp_path,
+                                                 capsys):
+        memory_storage.meta_apps().insert(App(id=0, name="DirApp"))
+        rc = main(["export", "--appname", "DirApp", "--output", str(tmp_path)])
+        assert rc == 1
+        assert "Export failed" in capsys.readouterr().err
