@@ -1,0 +1,192 @@
+"""Classification ops: multinomial Naive Bayes + multinomial logistic
+regression, TPU-first.
+
+Replaces the reference Classification template's calls into Spark MLlib
+(«NaiveBayes.train», «LogisticRegressionWithLBFGS/SGD» — SURVEY.md §2.4
+[U]). MLlib aggregates per-class feature sums with `treeAggregate` over RDD
+partitions (parameter-mixing DP, SURVEY.md §2.6 strategy 3); here both
+trainers are single jitted XLA programs whose example axis is sharded over
+the mesh `data` axis, so the class-count / gradient reductions become the
+hardware allreduces GSPMD inserts (psum over ICI) instead of a driver-side
+tree.
+
+Design notes:
+- NB sufficient statistics are ONE one-hot matmul: `onehot[N,C]ᵀ @ X[N,D]`
+  → [C, D] per-class feature sums on the MXU. No per-class Python loop.
+- LogReg is full-batch softmax regression driven by `lax.scan` over Adam
+  steps — one dispatch for the whole train, no host round trips.
+- Both pad N to the data-axis size; a weight column masks padding out of
+  every reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import math
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NaiveBayesModel:
+    """Multinomial NB: log priors [C] + log feature likelihoods [C, D]."""
+
+    log_prior: np.ndarray
+    log_theta: np.ndarray
+
+    def logits(self, x: np.ndarray) -> np.ndarray:
+        return self.log_prior + x @ self.log_theta.T
+
+
+@dataclasses.dataclass
+class LogRegModel:
+    weights: np.ndarray  # [D, C]
+    bias: np.ndarray  # [C]
+    loss_history: list = dataclasses.field(default_factory=list)
+
+    def logits(self, x: np.ndarray) -> np.ndarray:
+        return x @ self.weights + self.bias
+
+
+def _pad_batch(x: np.ndarray, y: np.ndarray, multiple: int):
+    """Pad the example axis to `multiple`; returns (x, y, weight)."""
+    n = x.shape[0]
+    n_pad = -(-n // multiple) * multiple
+    w = np.zeros(n_pad, dtype=np.float32)
+    w[:n] = 1.0
+    if n_pad != n:
+        x = np.concatenate([x, np.zeros((n_pad - n,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros(n_pad - n, y.dtype)])
+    return x, y, w
+
+
+def _shard_examples(mesh, *arrays):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    return [jax.device_put(a, shard) for a in arrays]
+
+
+@functools.lru_cache(maxsize=32)
+def _nb_fit(n_classes: int, smoothing: float):
+    import jax
+    import jax.numpy as jnp
+
+    def fit(x, y, w):
+        onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None]
+        class_counts = onehot.sum(0)  # [C]
+        feat_sums = onehot.T @ x  # [C, D] — MXU matmul
+        n = w.sum()
+        d = x.shape[1]
+        log_prior = jnp.log(class_counts + smoothing) - jnp.log(
+            n + n_classes * smoothing
+        )
+        log_theta = jnp.log(feat_sums + smoothing) - jnp.log(
+            feat_sums.sum(-1, keepdims=True) + d * smoothing
+        )
+        return log_prior, log_theta
+
+    return jax.jit(fit)
+
+
+def naive_bayes_train(
+    features: np.ndarray,
+    labels: np.ndarray,
+    n_classes: int,
+    smoothing: float = 1.0,
+    mesh=None,
+) -> NaiveBayesModel:
+    """MLlib-compatible multinomial NB («NaiveBayes.train(lambda)» [U]):
+    pi_c = log((n_c + λ)/(n + Cλ)); θ_cj = log((Σ x_j|c + λ)/(Σ x|c + Dλ)).
+    Features must be non-negative counts/frequencies."""
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    x = np.ascontiguousarray(features, dtype=np.float32)
+    y = np.ascontiguousarray(labels, dtype=np.int32)
+    if np.any(x < 0):
+        raise ValueError("multinomial NB requires non-negative features")
+    # lcm: the padded N must divide by the data-axis size for P("data")
+    # placement AND stay sublane-aligned
+    x, y, w = _pad_batch(x, y, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
+    x, y, w = _shard_examples(mesh, x, y, w)
+    log_prior, log_theta = _nb_fit(n_classes, float(smoothing))(x, y, w)
+    return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_theta))
+
+
+@functools.lru_cache(maxsize=32)
+def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+
+    def loss_fn(params, x, y, w):
+        logits = x @ params["w"] + params["b"]
+        ll = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        data = (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+        return data + 0.5 * reg * jnp.sum(params["w"] ** 2)
+
+    def fit(params0, x, y, w):
+        state0 = opt.init(params0)
+
+        def step(carry, _):
+            params, state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y, w)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, state), loss
+
+        (params, _), losses = jax.lax.scan(
+            step, (params0, state0), xs=None, length=iterations
+        )
+        return params, losses
+
+    return jax.jit(fit)
+
+
+def logreg_train(
+    features: np.ndarray,
+    labels: np.ndarray,
+    n_classes: int,
+    iterations: int = 200,
+    learning_rate: float = 0.1,
+    reg: float = 0.0,
+    mesh=None,
+) -> LogRegModel:
+    """Softmax regression, full-batch Adam in one jitted `lax.scan` —
+    gradients over the sharded example axis reduce via GSPMD psum (the
+    `treeAggregate` replacement, SURVEY.md §2.7 'Aggregation')."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    x = np.ascontiguousarray(features, dtype=np.float32)
+    y = np.ascontiguousarray(labels, dtype=np.int32)
+    d = x.shape[1]
+    x, y, w = _pad_batch(x, y, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
+    x, y, w = _shard_examples(mesh, x, y, w)
+    params0 = {
+        "w": jnp.zeros((d, n_classes), dtype=jnp.float32),
+        "b": jnp.zeros((n_classes,), dtype=jnp.float32),
+    }
+    params, losses = _logreg_fit(
+        n_classes, int(iterations), float(learning_rate), float(reg)
+    )(params0, x, y, w)
+    return LogRegModel(
+        weights=np.asarray(params["w"]),
+        bias=np.asarray(params["b"]),
+        loss_history=[float(v) for v in np.asarray(losses)],
+    )

@@ -1,0 +1,35 @@
+"""Classification template — NaiveBayes / LogisticRegression on entity
+properties.
+
+Parity with the reference Classification template (SURVEY.md §2.4 [U]):
+`$set` events carry attr0/attr1/attr2 + "plan" per user; queries send the
+attrs back and get {"label": ...}.
+"""
+
+from predictionio_tpu.templates.classification.engine import (
+    ClassificationEngine,
+    DataSource,
+    DataSourceParams,
+    LogisticRegressionAlgorithm,
+    LogisticRegressionParams,
+    NaiveBayesAlgorithm,
+    NaiveBayesParams,
+    Preparator,
+    PreparedData,
+    Query,
+    TrainingData,
+)
+
+__all__ = [
+    "ClassificationEngine",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "NaiveBayesAlgorithm",
+    "NaiveBayesParams",
+    "LogisticRegressionAlgorithm",
+    "LogisticRegressionParams",
+    "Query",
+]

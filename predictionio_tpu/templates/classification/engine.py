@@ -1,0 +1,270 @@
+"""Classification engine template (DASE components).
+
+Parity with the reference Classification template (SURVEY.md §2.4 [U]):
+`DataSource` builds labeled points from `$set` entity properties
+(`PEventStore.aggregateProperties` → attr0/attr1/attr2 features, "plan"
+label — the quickstart schema), algorithms are `P2LAlgorithm`-shaped
+NaiveBayes (the template default) and LogisticRegression (the documented
+variant), compute in `predictionio_tpu.ops.classify` instead of MLlib.
+
+Wire shapes (kept reference-compatible):
+    query:  {"attr0": 2.0, "attr1": 0.0, "attr2": 0.0}
+    result: {"label": 4.0}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource as BaseDataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator as BasePreparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.classify import (
+    LogRegModel,
+    NaiveBayesModel,
+    logreg_train,
+    naive_bayes_train,
+)
+
+log = logging.getLogger(__name__)
+
+Query = dict  # {"attr0": float, "attr1": float, "attr2": float}
+PredictedResult = dict  # {"label": float}
+
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    appName: str = ""
+    entityType: str = "user"
+    attributes: list = dataclasses.field(
+        default_factory=lambda: ["attr0", "attr1", "attr2"]
+    )
+    labelAttribute: str = "plan"
+    evalK: int = 0  # >0 enables read_eval with k stratified folds
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # [N, D] float32
+    labels: np.ndarray  # [N] float32 — original label values (MLlib doubles)
+    attributes: list = dataclasses.field(default_factory=list)
+    # feature-column order; carried through to serving so query dicts are
+    # vectorized in training order, whatever the configured attribute names
+
+    def sanity_check(self):
+        if len(self.labels) == 0:
+            raise ValueError(
+                "TrainingData has no labeled points; $set entity properties "
+                "with the configured attributes + label first."
+            )
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read_points(self, ctx) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        props = store.aggregate_properties(
+            app_name=self.params.appName,
+            entity_type=self.params.entityType,
+            required=list(self.params.attributes) + [self.params.labelAttribute],
+        )
+        feats, labels = [], []
+        for eid in sorted(props):
+            p = props[eid]
+            feats.append([float(p[a]) for a in self.params.attributes])
+            labels.append(float(p[self.params.labelAttribute]))
+        return TrainingData(
+            np.asarray(feats, dtype=np.float32).reshape(
+                len(labels), len(self.params.attributes)
+            ),
+            np.asarray(labels, dtype=np.float32),
+            attributes=list(self.params.attributes),
+        )
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        td = self._read_points(ctx)
+        log.info(
+            "DataSource: %d labeled points, %d classes, app %r",
+            len(td.labels), len(np.unique(td.labels)), self.params.appName,
+        )
+        return td
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold by point index («DataSource.readEval» [U]); queries carry
+        the feature dict, actual = {"label": value}."""
+        k = self.params.evalK
+        if k <= 1:
+            raise ValueError("DataSourceParams.evalK must be >= 2 for evaluation")
+        td = self._read_points(ctx)
+        n = len(td.labels)
+        assign = np.arange(n) % k
+        folds = []
+        attrs = list(self.params.attributes)
+        for fold in range(k):
+            train_sel = assign != fold
+            fold_td = TrainingData(
+                td.features[train_sel], td.labels[train_sel], attributes=attrs
+            )
+            qa = [
+                (
+                    {a: float(td.features[j, i]) for i, a in enumerate(attrs)},
+                    {"label": float(td.labels[j])},
+                )
+                for j in np.nonzero(~train_sel)[0]
+            ]
+            folds.append((fold_td, qa))
+        return folds
+
+
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray  # [N, D] float32
+    label_idx: np.ndarray  # [N] int32 — dense class index
+    classes: np.ndarray  # [C] float32 — index → original label value
+    attributes: list  # feature-column order, for query vectorization
+
+
+class Preparator(BasePreparator):
+    """Densify label values to class indices (the BiMap step every MLlib
+    template does before training — SURVEY.md §2.2 [U])."""
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
+        classes, label_idx = np.unique(td.labels, return_inverse=True)
+        return PreparedData(
+            features=td.features,
+            label_idx=label_idx.astype(np.int32),
+            classes=classes.astype(np.float32),
+            attributes=list(td.attributes),
+        )
+
+
+def _query_vector(query: Query, attributes: list) -> np.ndarray:
+    """Vectorize a query dict in TRAINING column order (the configured
+    attribute names); a "features" list is also accepted for schema-free
+    use."""
+    if "features" in query:
+        v = np.asarray(query["features"], dtype=np.float32)
+        if v.shape[0] != len(attributes):
+            raise ValueError(
+                f"query has {v.shape[0]} features, model expects "
+                f"{len(attributes)}"
+            )
+        return v
+    try:
+        return np.asarray(
+            [float(query[a]) for a in attributes], dtype=np.float32
+        )
+    except KeyError as e:
+        raise ValueError(
+            f"query is missing attribute {e.args[0]!r} "
+            f"(model features: {attributes})"
+        ) from None
+
+
+@dataclasses.dataclass
+class NBServingModel:
+    nb: NaiveBayesModel
+    classes: np.ndarray
+    attributes: list
+
+    def predict_label(self, x: np.ndarray) -> float:
+        return float(self.classes[int(np.argmax(self.nb.logits(x)))])
+
+
+@dataclasses.dataclass
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0  # engine.json key "lambda"
+
+    _ALIASES = {"lambda": "lambda_"}
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """«NaiveBayesAlgorithm.train/predict» [U] → ops.classify NB."""
+
+    params_class = NaiveBayesParams
+
+    def __init__(self, params: NaiveBayesParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> NBServingModel:
+        nb = naive_bayes_train(
+            pd.features, pd.label_idx, n_classes=len(pd.classes),
+            smoothing=self.params.lambda_, mesh=ctx.mesh,
+        )
+        return NBServingModel(nb=nb, classes=pd.classes,
+                              attributes=pd.attributes)
+
+    def predict(self, model: NBServingModel, query: Query) -> PredictedResult:
+        x = _query_vector(query, model.attributes)
+        return {"label": model.predict_label(x)}
+
+
+@dataclasses.dataclass
+class LRServingModel:
+    lr: LogRegModel
+    classes: np.ndarray
+    attributes: list
+
+    def predict_label(self, x: np.ndarray) -> float:
+        return float(self.classes[int(np.argmax(self.lr.logits(x)))])
+
+
+@dataclasses.dataclass
+class LogisticRegressionParams(Params):
+    iterations: int = 200
+    stepSize: float = 0.1  # MLlib SGD naming
+    regParam: float = 0.0
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    """«LogisticRegressionWithLBFGS» variant [U] → jitted softmax
+    regression (Adam full-batch; psum gradient allreduce under the mesh)."""
+
+    params_class = LogisticRegressionParams
+
+    def __init__(self, params: LogisticRegressionParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> LRServingModel:
+        lr = logreg_train(
+            pd.features, pd.label_idx, n_classes=len(pd.classes),
+            iterations=self.params.iterations,
+            learning_rate=self.params.stepSize,
+            reg=self.params.regParam, mesh=ctx.mesh,
+        )
+        return LRServingModel(lr=lr, classes=pd.classes,
+                              attributes=pd.attributes)
+
+    def predict(self, model: LRServingModel, query: Query) -> PredictedResult:
+        x = _query_vector(query, model.attributes)
+        return {"label": model.predict_label(x)}
+
+
+class ClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={
+                "naive": NaiveBayesAlgorithm,
+                "logisticregression": LogisticRegressionAlgorithm,
+            },
+            serving_class_map=FirstServing,
+        )

@@ -75,9 +75,16 @@ class CommandClient:
             keys.delete(k.key)
         return self.storage.meta_apps().delete(app.id)
 
-    def create_channel(self, app_name: str, channel_name: str) -> Optional[int]:
+    def create_channel(self, app_name: str, channel_name: str) -> int:
+        """Returns the new channel id; raises KeyError for an unknown app and
+        ValueError for an invalid/duplicate channel name, so callers can
+        report which input was wrong."""
         app = self.get_app(app_name)
         if app is None:
-            return None
-        return self.storage.meta_channels().insert(
+            raise KeyError(f"App {app_name!r} does not exist.")
+        cid = self.storage.meta_channels().insert(
             Channel(id=0, name=channel_name, app_id=app.id))
+        if cid is None:
+            raise ValueError(
+                f"Invalid or duplicate channel name {channel_name!r}.")
+        return cid

@@ -67,9 +67,11 @@ def cmd_app(args) -> int:
         print(f"Deleted all events of app {args.name}.")
         return 0
     if args.app_command == "channel-new":
-        cid = client.create_channel(args.name, args.channel)
-        if cid is None:
-            print(f"Unknown app or invalid/duplicate channel name.", file=sys.stderr)
+        try:
+            cid = client.create_channel(args.name, args.channel)
+        except (KeyError, ValueError) as e:
+            msg = e.args[0] if e.args else str(e)
+            print(msg, file=sys.stderr)
             return 1
         print(f"Created channel {args.channel} (id={cid}) for app {args.name}.")
         return 0

@@ -1,0 +1,226 @@
+"""Classification template end-to-end: $set property events → labeled
+points → NB / LogReg train → label queries (SURVEY.md §2.4 Classification
+row; §7.2 step 7)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.classification.ClassificationEngine"
+
+
+def ingest_users(storage, app_name="ClsApp", n_per_class=20, seed=0):
+    """Three separable classes in attr space: plan c has attrs ~ onehot(c)*4."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    rng = np.random.default_rng(seed)
+    uid = 0
+    for plan in (0.0, 1.0, 2.0):
+        base = np.eye(3)[int(plan)] * 4.0
+        for _ in range(n_per_class):
+            attrs = np.maximum(0.0, base + rng.integers(0, 2, size=3))
+            le.insert(
+                Event(
+                    event="$set", entity_type="user", entity_id=f"u{uid}",
+                    properties=DataMap({
+                        "attr0": float(attrs[0]),
+                        "attr1": float(attrs[1]),
+                        "attr2": float(attrs[2]),
+                        "plan": plan,
+                    }),
+                ),
+                app_id,
+            )
+            uid += 1
+
+
+def variant_dict(app_name="ClsApp", algo="naive", algo_params=None):
+    return {
+        "id": "cls-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": algo, "params": algo_params or {}}],
+    }
+
+
+class TestClassificationEndToEnd:
+    @pytest.mark.parametrize(
+        "algo,params",
+        [
+            ("naive", {"lambda": 1.0}),
+            ("logisticregression", {"iterations": 300, "stepSize": 0.3}),
+        ],
+    )
+    def test_train_and_classify(self, memory_storage, algo, params):
+        ingest_users(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict(algo=algo, algo_params=params))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        # each class prototype should classify back to its own plan
+        for plan in (0.0, 1.0, 2.0):
+            proto = (np.eye(3)[int(plan)] * 4.0).tolist()
+            q = {"attr0": proto[0], "attr1": proto[1], "attr2": proto[2]}
+            assert engine.predict(ep, models, q) == {"label": plan}
+
+    def test_attribute_order_is_training_order(self, memory_storage):
+        """Non-lexicographic attribute config must still vectorize queries
+        in training column order (regression: sorted(query) permuted the
+        features)."""
+        ingest_users(memory_storage)
+        variant = EngineVariant.from_dict({
+            "id": "cls-order",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {
+                "appName": "ClsApp",
+                "attributes": ["attr2", "attr0", "attr1"],
+            }},
+            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+        })
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        models = engine.train(ctx, ep)
+        for plan in (0.0, 1.0, 2.0):
+            proto = np.eye(3)[int(plan)] * 4.0
+            q = {"attr0": proto[0], "attr1": proto[1], "attr2": proto[2]}
+            assert engine.predict(ep, models, q) == {"label": plan}
+        with pytest.raises(ValueError, match="missing attribute"):
+            engine.predict(ep, models, {"attr0": 1.0, "attr1": 2.0})
+
+    def test_query_features_list_form(self, memory_storage):
+        ingest_users(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        models = engine.train(ctx, ep)
+        r = engine.predict(ep, models, {"features": [4.0, 0.0, 0.0]})
+        assert r == {"label": 0.0}
+
+    def test_bad_feature_count_raises(self, memory_storage):
+        ingest_users(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        models = engine.train(ctx, ep)
+        with pytest.raises(ValueError, match="features"):
+            engine.predict(ep, models, {"features": [1.0, 2.0]})
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name="EmptyCls"))
+        variant = EngineVariant.from_dict(variant_dict("EmptyCls"))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no labeled points"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+
+    def test_evaluation_accuracy(self, memory_storage):
+        ingest_users(memory_storage)
+        variant = EngineVariant.from_dict({
+            "id": "cls-eval",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "ClsApp", "evalK": 3}},
+            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+        })
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        from predictionio_tpu.controller import AverageMetric
+        from predictionio_tpu.controller.evaluation import Evaluation, MetricEvaluator
+
+        class Accuracy(AverageMetric):
+            def calculate(self, q, p, a):
+                return 1.0 if p["label"] == a["label"] else 0.0
+
+        class ClsEval(Evaluation):
+            pass
+
+        ClsEval.engine = engine
+        ClsEval.metric = Accuracy()
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        result = MetricEvaluator.evaluate(ctx, ClsEval(), [ep])
+        assert result.best.scores["Accuracy"] >= 0.9
+
+    def test_template_engine_json_parses(self):
+        import os
+
+        from predictionio_tpu.workflow.workflow_utils import read_engine_json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "predictionio_tpu", "templates",
+            "classification", "engine.json")
+        variant = read_engine_json(path)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        assert ep.algorithm_params_list[0][0] == "naive"
+        assert ep.algorithm_params_list[0][1].lambda_ == 1.0
+
+
+class TestClassifyOps:
+    def test_nb_matches_hand_computation(self):
+        from predictionio_tpu.ops.classify import naive_bayes_train
+
+        x = np.array([[1.0, 0.0], [2.0, 0.0], [0.0, 1.0], [0.0, 3.0]],
+                     dtype=np.float32)
+        y = np.array([0, 0, 1, 1], dtype=np.int32)
+        m = naive_bayes_train(x, y, n_classes=2, smoothing=1.0)
+        # priors: (2+1)/(4+2) each → log(0.5)
+        np.testing.assert_allclose(m.log_prior, np.log([0.5, 0.5]), rtol=1e-5)
+        # class 0 feature sums [3, 0], total 3: theta = [(3+1)/(3+2), (0+1)/(3+2)]
+        np.testing.assert_allclose(
+            np.exp(m.log_theta[0]), [4 / 5, 1 / 5], rtol=1e-5)
+        np.testing.assert_allclose(
+            np.exp(m.log_theta[1]), [1 / 6, 5 / 6], rtol=1e-5)
+
+    def test_nb_rejects_negative_features(self):
+        from predictionio_tpu.ops.classify import naive_bayes_train
+
+        with pytest.raises(ValueError, match="non-negative"):
+            naive_bayes_train(
+                np.array([[-1.0]], dtype=np.float32),
+                np.array([0], dtype=np.int32), n_classes=1)
+
+    def test_nb_on_non_divisor_mesh_axis(self):
+        """Padding must reach a common multiple of 8 and the data-axis size
+        (regression: max(8, axis) broke P("data") placement on axis=6)."""
+        import jax
+
+        from predictionio_tpu.ops.classify import naive_bayes_train
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 6, "model": 1}, devices=jax.devices()[:6])
+        rng = np.random.default_rng(0)
+        x = rng.random((10, 3)).astype(np.float32)
+        y = (np.arange(10) % 2).astype(np.int32)
+        m = naive_bayes_train(x, y, n_classes=2, mesh=mesh)
+        assert m.log_theta.shape == (2, 3)
+
+    def test_logreg_separable_converges(self):
+        from predictionio_tpu.ops.classify import logreg_train
+
+        rng = np.random.default_rng(0)
+        x0 = rng.normal(-2.0, 0.5, size=(40, 2))
+        x1 = rng.normal(2.0, 0.5, size=(40, 2))
+        x = np.vstack([x0, x1]).astype(np.float32)
+        y = np.array([0] * 40 + [1] * 40, dtype=np.int32)
+        m = logreg_train(x, y, n_classes=2, iterations=200, learning_rate=0.2)
+        pred = np.argmax(m.logits(x), axis=-1)
+        assert (pred == y).mean() == 1.0
+        assert m.loss_history[-1] < m.loss_history[0]
