@@ -1,0 +1,32 @@
+"""Similar Product template — item-item cosine from implicit-ALS factors.
+
+Parity with the reference Similar Product template (SURVEY.md §2.4 [U]):
+train on `view` events, serve "items similar to this basket" queries with
+category/whiteList/blackList filters.
+"""
+
+from predictionio_tpu.templates.similarproduct.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSource,
+    DataSourceParams,
+    Preparator,
+    PreparedData,
+    Query,
+    SimilarProductEngine,
+    SimilarProductModel,
+    TrainingData,
+)
+
+__all__ = [
+    "SimilarProductEngine",
+    "SimilarProductModel",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "Query",
+]

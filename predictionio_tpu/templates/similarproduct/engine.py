@@ -1,0 +1,244 @@
+"""Similar Product engine template (DASE components).
+
+Parity with the reference Similar Product template (SURVEY.md §2.4 [U]):
+users `view` items, `$set` item entities carry `categories`; ALS is trained
+on implicit view events («ALS.trainImplicit» → ops.als implicit mode) and
+the item factors are collected P2L-style («ALSModel(productFeatures.
+collectAsMap)» [U]) into an in-memory cosine-similarity model. Queries name
+a basket of items and get back the most similar other items, with
+whiteList/blackList/categories filters.
+
+Wire shapes (kept reference-compatible):
+    query:  {"items": ["i1"], "num": 4,
+             "categories": [...]?, "whiteList": [...]?, "blackList": [...]?}
+    result: {"itemScores": [{"item": "i5", "score": 0.93}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource as BaseDataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator as BasePreparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.als import ALSConfig, als_train
+
+log = logging.getLogger(__name__)
+
+Query = dict
+PredictedResult = dict
+
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    appName: str = ""
+    similarEvents: list = dataclasses.field(default_factory=lambda: ["view"])
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: list  # view-event user ids (strings), aligned with items
+    items: list  # viewed item ids
+    item_categories: dict  # item id → list of category strings ($set props)
+
+    def sanity_check(self):
+        if not self.users:
+            raise ValueError(
+                "TrainingData has no view events; ingest view events first."
+            )
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        users, items = [], []
+        for e in store.find(
+            app_name=self.params.appName,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.similarEvents),
+        ):
+            if e.target_entity_id is None:
+                continue
+            users.append(e.entity_id)
+            items.append(e.target_entity_id)
+        item_props = store.aggregate_properties(
+            app_name=self.params.appName, entity_type="item"
+        )
+        item_categories = {
+            eid: list(p.get("categories", []) or [])
+            for eid, p in item_props.items()
+        }
+        log.info(
+            "DataSource: %d view events, %d items with properties, app %r",
+            len(users), len(item_categories), self.params.appName,
+        )
+        return TrainingData(users, items, item_categories)
+
+
+@dataclasses.dataclass
+class PreparedData:
+    user_ids: BiMap
+    item_ids: BiMap
+    user_idx: np.ndarray  # [n] int32
+    item_idx: np.ndarray
+    counts: np.ndarray  # [n] float32 — view counts per (user, item)
+    item_categories: dict
+
+
+class Preparator(BasePreparator):
+    """BiMap ids and fold repeated views into per-pair counts (the implicit
+    'rating' — «MLlib ALS.trainImplicit» treats values as confidence)."""
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
+        user_ids = BiMap.string_int(td.users)
+        # items seen only via $set still get factors' rows? No — factors come
+        # from interactions; category-only items can never score anyway.
+        item_ids = BiMap.string_int(td.items)
+        u = user_ids.to_index(td.users)
+        i = item_ids.to_index(td.items)
+        pair = u.astype(np.int64) * max(len(item_ids), 1) + i
+        uniq, counts = np.unique(pair, return_counts=True)
+        return PreparedData(
+            user_ids=user_ids,
+            item_ids=item_ids,
+            user_idx=(uniq // max(len(item_ids), 1)).astype(np.int32),
+            item_idx=(uniq % max(len(item_ids), 1)).astype(np.int32),
+            counts=counts.astype(np.float32),
+            item_categories=td.item_categories,
+        )
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    """P2L model: L2-normalized item factors + id/category maps. Similarity
+    scoring is one [Q,K]@[K,N] matmul over the normalized factors."""
+
+    item_factors_unit: np.ndarray  # [n_items, K], rows L2-normalized
+    item_ids: BiMap
+    item_categories: dict
+
+    def similar(
+        self,
+        query_items: list,
+        num: int,
+        categories: Optional[list] = None,
+        white_list: Optional[list] = None,
+        black_list: Optional[list] = None,
+    ) -> list[tuple[str, float]]:
+        known = [i for i in query_items if self.item_ids.contains(i)]
+        if not known:
+            return []
+        q = self.item_factors_unit[self.item_ids.to_index(known)]  # [Q, K]
+        scores = (q @ self.item_factors_unit.T).mean(axis=0)  # [n_items]
+
+        mask = np.ones(scores.shape[0], dtype=bool)
+        mask[self.item_ids.to_index(known)] = False  # basket itself
+        if white_list:
+            wl = np.zeros_like(mask)
+            have = [i for i in white_list if self.item_ids.contains(i)]
+            if have:
+                wl[self.item_ids.to_index(have)] = True
+            mask &= wl
+        if black_list:
+            have = [i for i in black_list if self.item_ids.contains(i)]
+            if have:
+                mask[self.item_ids.to_index(have)] = False
+        if categories:
+            cats = set(categories)
+            idxs = np.nonzero(mask)[0]
+            for idx, item in zip(idxs, self.item_ids.from_index(idxs)):
+                if not cats & set(self.item_categories.get(item, [])):
+                    mask[idx] = False
+
+        scores = np.where(mask, scores, -np.inf)
+        k = min(num, int(mask.sum()))
+        if k <= 0:
+            return []
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top])]
+        items = self.item_ids.from_index(top)
+        return [(item, float(scores[idx])) for item, idx in zip(items, top)]
+
+
+@dataclasses.dataclass
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+    _ALIASES = {"lambda": "lambda_"}
+
+
+class ALSAlgorithm(Algorithm):
+    """«ALSAlgorithm.train» (implicit) → cosine item-item model [U]."""
+
+    params_class = ALSAlgorithmParams
+
+    def __init__(self, params: ALSAlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> SimilarProductModel:
+        p = self.params
+        cfg = ALSConfig(
+            rank=p.rank,
+            iterations=p.numIterations,
+            reg=p.lambda_,
+            implicit=True,
+            alpha=p.alpha,
+            seed=ctx.seed if p.seed is None else p.seed,
+        )
+        result = als_train(
+            pd.user_idx, pd.item_idx, pd.counts,
+            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+            cfg=cfg, mesh=ctx.mesh,
+        )
+        f = result.item_factors
+        norms = np.linalg.norm(f, axis=1, keepdims=True)
+        unit = np.where(norms > 0, f / np.maximum(norms, 1e-12), 0.0)
+        return SimilarProductModel(
+            item_factors_unit=unit.astype(np.float32),
+            item_ids=pd.item_ids,
+            item_categories=pd.item_categories,
+        )
+
+    def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
+        sims = model.similar(
+            [str(i) for i in query.get("items", [])],
+            num=int(query.get("num", 10)),
+            categories=query.get("categories"),
+            white_list=query.get("whiteList"),
+            black_list=query.get("blackList"),
+        )
+        return {"itemScores": [{"item": i, "score": s} for i, s in sims]}
+
+
+class SimilarProductEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={"als": ALSAlgorithm},
+            serving_class_map=FirstServing,
+        )

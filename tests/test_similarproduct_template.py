@@ -1,0 +1,131 @@
+"""Similar Product template end-to-end: view events + $set item categories
+→ implicit ALS → item-item cosine queries with filters (SURVEY.md §2.4
+Similar Product row; §7.2 step 7)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.similarproduct.SimilarProductEngine"
+
+
+def ingest_views(storage, app_name="SimApp", n_users=16, n_groups=2,
+                 items_per_group=4):
+    """Users in group g repeatedly view group-g items: items co-viewed
+    within a group should come out more similar than across groups."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    for g in range(n_groups):
+        for j in range(items_per_group):
+            le.insert(
+                Event(event="$set", entity_type="item", entity_id=f"g{g}i{j}",
+                      properties=DataMap({"categories": [f"cat{g}"]})),
+                app_id)
+    for u in range(n_users):
+        g = u % n_groups
+        # each user views all but one item of their group (rotating holdout)
+        for j in range(items_per_group):
+            if j == u % items_per_group:
+                continue
+            le.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"g{g}i{j}"),
+                app_id)
+
+
+def variant_dict(app_name="SimApp", rank=4, iters=15):
+    return {
+        "id": "sim-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": rank, "numIterations": iters, "lambda": 0.05,
+            "alpha": 2.0, "seed": 1}}],
+    }
+
+
+class TestSimilarProductEndToEnd:
+    def test_train_and_similar(self, memory_storage):
+        ingest_views(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        r = engine.predict(ep, models, {"items": ["g0i0"], "num": 3})
+        items = [s["item"] for s in r["itemScores"]]
+        assert len(items) == 3
+        assert "g0i0" not in items  # basket excluded
+        # co-viewed group-0 items must outrank group-1 items
+        assert set(items[:2]) <= {f"g0i{j}" for j in range(4)}
+        scores = [s["score"] for s in r["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_filters(self, memory_storage):
+        ingest_views(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        models = engine.train(ctx, ep)
+
+        # whiteList restricts candidates
+        r = engine.predict(ep, models, {
+            "items": ["g0i0"], "num": 10, "whiteList": ["g1i0", "g1i1"]})
+        assert {s["item"] for s in r["itemScores"]} <= {"g1i0", "g1i1"}
+        # blackList removes candidates
+        r = engine.predict(ep, models, {
+            "items": ["g0i0"], "num": 10, "blackList": ["g0i1"]})
+        assert "g0i1" not in {s["item"] for s in r["itemScores"]}
+        # categories filter keeps only matching items
+        r = engine.predict(ep, models, {
+            "items": ["g0i0"], "num": 10, "categories": ["cat1"]})
+        got = {s["item"] for s in r["itemScores"]}
+        assert got and got <= {f"g1i{j}" for j in range(4)}
+
+    def test_unknown_items_empty(self, memory_storage):
+        ingest_views(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        models = engine.train(ctx, ep)
+        r = engine.predict(ep, models, {"items": ["nope"], "num": 3})
+        assert r == {"itemScores": []}
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name="EmptySim"))
+        variant = EngineVariant.from_dict(variant_dict("EmptySim"))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no view events"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+
+    def test_template_engine_json_parses(self):
+        import os
+
+        from predictionio_tpu.workflow.workflow_utils import read_engine_json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "predictionio_tpu", "templates",
+            "similarproduct", "engine.json")
+        variant = read_engine_json(path)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        assert ep.algorithm_params_list[0][0] == "als"
+        assert ep.algorithm_params_list[0][1].rank == 10
