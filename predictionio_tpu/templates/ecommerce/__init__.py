@@ -1,0 +1,33 @@
+"""E-Commerce Recommendation template — implicit ALS + serve-time business
+rules (seen/unavailable/category filters, cold-start via recent views).
+
+Parity with the reference E-Commerce Recommendation template (SURVEY.md
+§2.4 [U]); the serve-time `LEventStore` lookups are TTL-cached because they
+sit on the query hot path (SURVEY.md §7.3).
+"""
+
+from predictionio_tpu.templates.ecommerce.engine import (
+    DataSource,
+    DataSourceParams,
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommerceEngine,
+    ECommModelData,
+    Preparator,
+    PreparedData,
+    Query,
+    TrainingData,
+)
+
+__all__ = [
+    "ECommerceEngine",
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "ECommModelData",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "Query",
+]

@@ -1,0 +1,208 @@
+"""E-Commerce template end-to-end: view/buy events + $set categories +
+constraint/unavailableItems → implicit ALS → filtered recommendations with
+serve-time LEventStore lookups (SURVEY.md §2.4 E-Commerce row; §3.2
+`ECommAlgorithm.predict → LEventStore.findByEntity`)."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.ecommerce.ECommerceEngine"
+APP = "EcomApp"
+
+
+def ts(h):
+    return datetime(2026, 1, 1, h, tzinfo=timezone.utc)
+
+
+def ingest(storage, n_users=12, n_groups=2, items_per_group=4):
+    """Group structure like the similar-product fixture, plus buys."""
+    app_id = storage.meta_apps().insert(App(id=0, name=APP))
+    le = storage.l_events()
+    for g in range(n_groups):
+        for j in range(items_per_group):
+            le.insert(
+                Event(event="$set", entity_type="item", entity_id=f"g{g}i{j}",
+                      properties=DataMap({"categories": [f"cat{g}"]}),
+                      event_time=ts(0)),
+                app_id)
+    for u in range(n_users):
+        g = u % n_groups
+        holdout = u % items_per_group
+        for j in range(items_per_group):
+            if j == holdout:
+                continue
+            le.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"g{g}i{j}",
+                      event_time=ts(1)),
+                app_id)
+        # one buy to weight the strongest item
+        le.insert(
+            Event(event="buy", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item",
+                  target_entity_id=f"g{g}i{(holdout + 1) % items_per_group}",
+                  event_time=ts(2)),
+            app_id)
+    return app_id
+
+
+def variant_dict(algo_overrides=None):
+    params = {
+        "appName": APP, "rank": 4, "numIterations": 15, "lambda": 0.05,
+        "alpha": 2.0, "seed": 1, "cacheTTLSeconds": 0.0,
+    }
+    params.update(algo_overrides or {})
+    return {
+        "id": "ecom-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": APP}},
+        "algorithms": [{"name": "ecomm", "params": params}],
+    }
+
+
+def trained(memory_storage, algo_overrides=None):
+    variant = EngineVariant.from_dict(variant_dict(algo_overrides))
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    ctx = WorkflowContext(storage=memory_storage, seed=1)
+    models = engine.train(ctx, ep)
+    return engine, ep, models
+
+
+class TestECommerceEndToEnd:
+    def test_unseen_only_excludes_seen_items(self, memory_storage):
+        ingest(memory_storage)
+        engine, ep, models = trained(memory_storage)
+        r = engine.predict(ep, models, {"user": "u0", "num": 10})
+        items = [s["item"] for s in r["itemScores"]]
+        assert items, "expected recommendations"
+        # u0 (group 0, holdout item g0i0) has seen g0i1..3 and bought g0i1
+        seen = {"g0i1", "g0i2", "g0i3"}
+        assert not (set(items) & seen)
+        assert "g0i0" in items  # the held-out item is recommendable
+
+    def test_unavailable_items_filtered_and_constraint_updates(
+        self, memory_storage
+    ):
+        app_id = ingest(memory_storage)
+        engine, ep, models = trained(memory_storage)
+        le = memory_storage.l_events()
+        le.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": ["g0i0"]}), event_time=ts(3)),
+            app_id)
+        r = engine.predict(ep, models, {"user": "u0", "num": 10})
+        assert "g0i0" not in [s["item"] for s in r["itemScores"]]
+        # a newer constraint replaces the old one (findByEntity latest=True)
+        le.insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": []}), event_time=ts(4)),
+            app_id)
+        r = engine.predict(ep, models, {"user": "u0", "num": 10})
+        assert "g0i0" in [s["item"] for s in r["itemScores"]]
+
+    def test_cold_start_scores_via_recent_views(self, memory_storage):
+        app_id = ingest(memory_storage)
+        engine, ep, models = trained(memory_storage)
+        # "fresh" user unknown to the model, with post-train view events
+        le = memory_storage.l_events()
+        le.insert(
+            Event(event="view", entity_type="user", entity_id="fresh",
+                  target_entity_type="item", target_entity_id="g1i0",
+                  event_time=ts(5)),
+            app_id)
+        r = engine.predict(ep, models, {"user": "fresh", "num": 2})
+        items = [s["item"] for s in r["itemScores"]]
+        assert items
+        # recommendations should come from the co-viewed group 1
+        assert set(items) <= {f"g1i{j}" for j in range(4)}
+        assert "g1i0" not in items  # viewed → seen-filtered
+
+    def test_unknown_user_no_history_empty(self, memory_storage):
+        ingest(memory_storage)
+        engine, ep, models = trained(memory_storage)
+        r = engine.predict(ep, models, {"user": "ghost", "num": 3})
+        assert r == {"itemScores": []}
+
+    def test_category_and_whitelist_filters(self, memory_storage):
+        ingest(memory_storage)
+        engine, ep, models = trained(memory_storage, {"unseenOnly": False})
+        r = engine.predict(ep, models, {
+            "user": "u0", "num": 10, "categories": ["cat1"]})
+        got = {s["item"] for s in r["itemScores"]}
+        assert got and got <= {f"g1i{j}" for j in range(4)}
+        r = engine.predict(ep, models, {
+            "user": "u0", "num": 10, "whiteList": ["g0i1"]})
+        assert [s["item"] for s in r["itemScores"]] == ["g0i1"]
+        r = engine.predict(ep, models, {
+            "user": "u0", "num": 10, "blackList": ["g0i1"],
+            "categories": ["cat0"]})
+        assert "g0i1" not in {s["item"] for s in r["itemScores"]}
+
+    def test_ttl_cache_serves_stale_within_ttl(self, memory_storage):
+        """The deploy path resolves components ONCE (Engine.predict docstring)
+        so the algorithm instance — and its TTL cache — persists across
+        queries; within the TTL a new constraint event is not yet visible."""
+        app_id = ingest(memory_storage)
+        engine, ep, models = trained(
+            memory_storage, {"cacheTTLSeconds": 60.0})
+        comps = engine.components(ep)
+        r = engine.predict(ep, models, {"user": "u0", "num": 10},
+                           components=comps)
+        assert "g0i0" in [s["item"] for s in r["itemScores"]]
+        # constraint lands but the cached (empty) unavailable set is used
+        memory_storage.l_events().insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": ["g0i0"]}), event_time=ts(3)),
+            app_id)
+        r = engine.predict(ep, models, {"user": "u0", "num": 10},
+                           components=comps)
+        assert "g0i0" in [s["item"] for s in r["itemScores"]]
+        # a freshly resolved instance (empty cache) sees it immediately
+        r = engine.predict(ep, models, {"user": "u0", "num": 10})
+        assert "g0i0" not in [s["item"] for s in r["itemScores"]]
+
+    def test_model_roundtrips_through_persistence(self, memory_storage):
+        ingest(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        r = engine.predict(ep, models, {"user": "u0", "num": 3})
+        assert r["itemScores"]
+
+    def test_template_engine_json_parses(self):
+        import os
+
+        from predictionio_tpu.workflow.workflow_utils import read_engine_json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "predictionio_tpu", "templates",
+            "ecommerce", "engine.json")
+        variant = read_engine_json(path)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        name, params = ep.algorithm_params_list[0]
+        assert name == "ecomm"
+        assert params.seenEvents == ["buy", "view"]
+        assert params.unseenOnly is True
