@@ -1,0 +1,237 @@
+"""Text-feature ops: hashing TF, IDF, and Word2Vec, TPU-first.
+
+Replaces the reference Text Classification template's calls into Spark
+MLlib («HashingTF»/«IDF» and «mllib.feature.Word2Vec.fit» — SURVEY.md §2.4
+[U]). MLlib's Word2Vec is parameter-mixing data parallelism (per-partition
+embedding updates averaged on the driver, SURVEY.md §2.6 strategy 3); here
+it is skip-gram with negative sampling as ONE jitted `lax.scan` over
+minibatch steps — embedding gathers, a [B,K]·[B,K] contraction, and
+scatter-add updates, with the batch axis sharded over the mesh `data` axis
+so gradient reductions become GSPMD psums.
+
+Host side stays minimal: tokenization and the skip-gram pair enumeration
+(ragged, string-ish work XLA can't help with); everything per-step runs on
+device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import re
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_TOKEN_RE = re.compile(r"[a-z0-9']+")
+
+
+def tokenize(text: str) -> list[str]:
+    """Lowercase word tokenizer (the template's regex split)."""
+    return _TOKEN_RE.findall(text.lower())
+
+
+def hashing_tf(
+    docs_tokens: Sequence[Sequence[str]], num_features: int = 1024
+) -> np.ndarray:
+    """«HashingTF» [U]: term-frequency vectors via the hashing trick.
+    crc32 is stable across processes (unlike Python's seeded str hash), so
+    models serve correctly after deploy reloads."""
+    out = np.zeros((len(docs_tokens), num_features), dtype=np.float32)
+    for d, tokens in enumerate(docs_tokens):
+        for t in tokens:
+            out[d, zlib.crc32(t.encode()) % num_features] += 1.0
+    return out
+
+
+@dataclasses.dataclass
+class IDFModel:
+    idf: np.ndarray  # [D] float32
+
+    def transform(self, tf: np.ndarray) -> np.ndarray:
+        return tf * self.idf
+
+
+def idf_fit(tf: np.ndarray, min_doc_freq: int = 0) -> IDFModel:
+    """«IDF.fit» [U]: idf_j = log((n + 1) / (df_j + 1)) (MLlib's formula);
+    terms below min_doc_freq get idf 0 (dropped)."""
+    n = tf.shape[0]
+    df = (tf > 0).sum(axis=0)
+    idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)
+    if min_doc_freq > 0:
+        idf = np.where(df >= min_doc_freq, idf, 0.0).astype(np.float32)
+    return IDFModel(idf=idf)
+
+
+def build_vocab(
+    docs_tokens: Sequence[Sequence[str]], min_count: int = 1,
+    max_size: Optional[int] = None,
+) -> dict[str, int]:
+    """Frequency-ordered token→id map («Word2Vec» vocab build [U])."""
+    from collections import Counter
+
+    counts = Counter(t for doc in docs_tokens for t in doc)
+    items = [(t, c) for t, c in counts.items() if c >= min_count]
+    items.sort(key=lambda tc: (-tc[1], tc[0]))
+    if max_size is not None:
+        items = items[:max_size]
+    return {t: i for i, (t, _) in enumerate(items)}
+
+
+def skipgram_pairs(
+    docs_tokens: Sequence[Sequence[str]], vocab: dict[str, int], window: int = 5
+) -> np.ndarray:
+    """Enumerate (center, context) id pairs within ±window, per doc."""
+    pairs = []
+    for doc in docs_tokens:
+        ids = [vocab[t] for t in doc if t in vocab]
+        for i, c in enumerate(ids):
+            lo = max(0, i - window)
+            for j in range(lo, min(len(ids), i + window + 1)):
+                if j != i:
+                    pairs.append((c, ids[j]))
+    if not pairs:
+        return np.zeros((0, 2), dtype=np.int32)
+    return np.asarray(pairs, dtype=np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Word2VecConfig:
+    """Frozen (hashable) so the jitted step caches across calls."""
+
+    dim: int = 64
+    window: int = 5
+    negatives: int = 5
+    steps: int = 500
+    batch_size: int = 1024
+    learning_rate: float = 0.05
+    min_count: int = 1
+    max_vocab: Optional[int] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Word2VecModel:
+    vectors: np.ndarray  # [V, dim] — input (center) embeddings
+    vocab: dict  # token → row
+
+    def vector(self, token: str) -> Optional[np.ndarray]:
+        i = self.vocab.get(token)
+        return None if i is None else self.vectors[i]
+
+    def doc_vector(self, tokens: Sequence[str]) -> np.ndarray:
+        """Mean of known-token vectors (the template's document embedding)."""
+        rows = [self.vocab[t] for t in tokens if t in self.vocab]
+        if not rows:
+            return np.zeros(self.vectors.shape[1], dtype=np.float32)
+        return self.vectors[np.asarray(rows)].mean(axis=0)
+
+    def similar(self, token: str, num: int = 10) -> list[tuple[str, float]]:
+        """«Word2VecModel.findSynonyms» [U]: top cosine neighbours."""
+        v = self.vector(token)
+        if v is None:
+            return []
+        norms = np.linalg.norm(self.vectors, axis=1)
+        sims = self.vectors @ v / np.maximum(
+            norms * max(np.linalg.norm(v), 1e-12), 1e-12
+        )
+        order = np.argsort(-sims)
+        inv = {i: t for t, i in self.vocab.items()}
+        out = []
+        for idx in order:
+            t = inv[int(idx)]
+            if t != token:
+                out.append((t, float(sims[idx])))
+            if len(out) >= num:
+                break
+        return out
+
+
+@functools.lru_cache(maxsize=16)
+def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig):
+    """Whole training run as one jitted program: `lax.scan` over steps,
+    each step samples a pair batch + negatives on device and applies SGD
+    scatter-add updates (the MXU-light but bandwidth-friendly formulation;
+    a Pallas fused kernel is the planned upgrade — SURVEY.md §2.5)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(key, pairs, emb_in0, emb_out0):
+        def step(carry, _):
+            emb_in, emb_out, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            idx = jax.random.randint(k1, (cfg.batch_size,), 0, n_pairs)
+            batch = pairs[idx]  # [B, 2]
+            center, ctx = batch[:, 0], batch[:, 1]
+            neg = jax.random.randint(
+                k2, (cfg.batch_size, cfg.negatives), 0, vocab_size
+            )
+
+            def loss_fn(params):
+                e_in, e_out = params
+                c = e_in[center]  # [B, K]
+                pos = e_out[ctx]  # [B, K]
+                ngs = e_out[neg]  # [B, N, K]
+                pos_score = jnp.sum(c * pos, axis=-1)
+                neg_score = jnp.einsum("bk,bnk->bn", c, ngs)
+                loss = -(
+                    jax.nn.log_sigmoid(pos_score).mean()
+                    + jax.nn.log_sigmoid(-neg_score).sum(-1).mean()
+                )
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)((emb_in, emb_out))
+            emb_in = emb_in - cfg.learning_rate * grads[0]
+            emb_out = emb_out - cfg.learning_rate * grads[1]
+            return (emb_in, emb_out, key), loss
+
+        (emb_in, emb_out, _), losses = jax.lax.scan(
+            step, (emb_in0, emb_out0, key), xs=None, length=cfg.steps
+        )
+        return emb_in, losses
+
+    return jax.jit(run)
+
+
+def word2vec_train(
+    docs_tokens: Sequence[Sequence[str]],
+    cfg: Word2VecConfig = Word2VecConfig(),
+    mesh=None,
+) -> Word2VecModel:
+    """Train skip-gram embeddings («Word2Vec.fit» replacement [U])."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.parallel.mesh import make_mesh, replicated
+
+    vocab = build_vocab(docs_tokens, cfg.min_count, cfg.max_vocab)
+    if not vocab:
+        raise ValueError("word2vec_train: empty vocabulary")
+    pairs = skipgram_pairs(docs_tokens, vocab, cfg.window)
+    if len(pairs) == 0:
+        raise ValueError("word2vec_train: no skip-gram pairs (docs too short)")
+    if mesh is None:
+        mesh = make_mesh()
+    rep = replicated(mesh)
+
+    v = len(vocab)
+    key = jax.random.key(cfg.seed)
+    k_init, k_run = jax.random.split(key)
+    emb_in = jax.device_put(
+        (jax.random.uniform(k_init, (v, cfg.dim), minval=-0.5, maxval=0.5)
+         / cfg.dim).astype(jnp.float32), rep)
+    emb_out = jax.device_put(jnp.zeros((v, cfg.dim), dtype=jnp.float32), rep)
+    pairs_dev = jax.device_put(jnp.asarray(pairs), rep)
+
+    run = _w2v_train_loop(len(pairs), v, cfg)
+    emb, losses = run(k_run, pairs_dev, emb_in, emb_out)
+    losses = np.asarray(losses)
+    log.info(
+        "word2vec_train: vocab %d, %d pairs, %d steps, loss %.4f → %.4f",
+        v, len(pairs), cfg.steps, losses[0], losses[-1],
+    )
+    return Word2VecModel(vectors=np.asarray(emb), vocab=vocab)

@@ -1,0 +1,38 @@
+"""Text Classification template — hashing tf-idf + NB/LR, Word2Vec variant.
+
+Parity with the reference Text Classification template (SURVEY.md §2.4
+[U]): `$set` content entities carry text + category; queries send text and
+get {"category", "confidence"}.
+"""
+
+from predictionio_tpu.templates.textclassification.engine import (
+    DataSource,
+    DataSourceParams,
+    LRAlgorithm,
+    LRParams,
+    NBAlgorithm,
+    NBParams,
+    Preparator,
+    PreparedData,
+    Query,
+    TextClassificationEngine,
+    TrainingData,
+    Word2VecAlgorithm,
+    Word2VecParams,
+)
+
+__all__ = [
+    "TextClassificationEngine",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "NBAlgorithm",
+    "NBParams",
+    "LRAlgorithm",
+    "LRParams",
+    "Word2VecAlgorithm",
+    "Word2VecParams",
+    "Query",
+]

@@ -1,0 +1,211 @@
+"""Text Classification template end-to-end + text ops units (SURVEY.md
+§2.4 Text Classification row; §7.2 step 7)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = (
+    "predictionio_tpu.templates.textclassification.TextClassificationEngine"
+)
+APP = "TextApp"
+
+SPAM = [
+    "buy cheap pills online now",
+    "cheap pills great deal buy now",
+    "win money now cheap offer",
+    "online pharmacy cheap pills deal",
+    "great offer win money online",
+    "cheap deal buy pills win",
+]
+HAM = [
+    "meeting tomorrow about the quarterly report",
+    "please review the attached quarterly report",
+    "lunch meeting with the team tomorrow",
+    "the report needs review before the meeting",
+    "team review of the quarterly numbers",
+    "schedule the team meeting for tomorrow",
+]
+
+
+def ingest_docs(storage):
+    app_id = storage.meta_apps().insert(App(id=0, name=APP))
+    le = storage.l_events()
+    for i, text in enumerate(SPAM):
+        le.insert(Event(event="$set", entity_type="content",
+                        entity_id=f"spam{i}",
+                        properties=DataMap({"text": text, "category": "spam"})),
+                  app_id)
+    for i, text in enumerate(HAM):
+        le.insert(Event(event="$set", entity_type="content",
+                        entity_id=f"ham{i}",
+                        properties=DataMap({"text": text, "category": "ham"})),
+                  app_id)
+
+
+def variant_dict(algo="nb", params=None):
+    return {
+        "id": "text-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": APP}},
+        "algorithms": [{"name": algo, "params": params or {}}],
+    }
+
+
+class TestTextClassificationEndToEnd:
+    @pytest.mark.parametrize(
+        "algo,params",
+        [
+            ("nb", {"lambda": 1.0, "numFeatures": 256}),
+            ("lr", {"iterations": 300, "stepSize": 0.3, "numFeatures": 256}),
+        ],
+    )
+    def test_train_and_classify(self, memory_storage, algo, params):
+        ingest_docs(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict(algo, params))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        r = engine.predict(ep, models, {"text": "cheap pills buy now"})
+        assert r["category"] == "spam"
+        assert 0.0 < r["confidence"] <= 1.0
+        r = engine.predict(
+            ep, models, {"text": "quarterly report for the team meeting"})
+        assert r["category"] == "ham"
+
+    def test_word2vec_variant(self, memory_storage):
+        ingest_docs(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict("word2vec", {
+            "dim": 16, "steps": 200, "window": 3, "seed": 0,
+            "iterations": 300, "stepSize": 0.3}))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        models = engine.train(ctx, ep)
+        r = engine.predict(ep, models, {"text": "cheap pills online"})
+        assert r["category"] == "spam"
+        r = engine.predict(ep, models, {"text": "team meeting tomorrow"})
+        assert r["category"] == "ham"
+
+    def test_evaluation_kfold_accuracy(self, memory_storage):
+        ingest_docs(memory_storage)
+        variant = EngineVariant.from_dict({
+            "id": "text-eval",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": APP, "evalK": 3}},
+            "algorithms": [{"name": "nb", "params": {"numFeatures": 256}}],
+        })
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        from predictionio_tpu.controller import AverageMetric
+        from predictionio_tpu.controller.evaluation import (
+            Evaluation,
+            MetricEvaluator,
+        )
+
+        class Accuracy(AverageMetric):
+            def calculate(self, q, p, a):
+                return 1.0 if p["category"] == a["category"] else 0.0
+
+        class TextEval(Evaluation):
+            pass
+
+        TextEval.engine = engine
+        TextEval.metric = Accuracy()
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        result = MetricEvaluator.evaluate(ctx, TextEval(), [ep])
+        assert result.best.scores["Accuracy"] >= 0.7
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name=APP))
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no documents"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+
+    def test_template_engine_json_parses(self):
+        import os
+
+        from predictionio_tpu.workflow.workflow_utils import read_engine_json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "predictionio_tpu", "templates",
+            "textclassification", "engine.json")
+        variant = read_engine_json(path)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        assert ep.algorithm_params_list[0][0] == "nb"
+        assert ep.algorithm_params_list[0][1].numFeatures == 1024
+
+
+class TestTextOps:
+    def test_tokenize(self):
+        from predictionio_tpu.ops.text import tokenize
+
+        assert tokenize("Hello, World! it's 42.") == ["hello", "world", "it's", "42"]
+
+    def test_hashing_tf_counts_and_stability(self):
+        from predictionio_tpu.ops.text import hashing_tf
+
+        tf = hashing_tf([["a", "b", "a"], ["b"]], num_features=32)
+        assert tf.shape == (2, 32)
+        assert tf[0].sum() == 3.0 and tf[1].sum() == 1.0
+        # same token → same bucket across calls (crc32, process-stable)
+        tf2 = hashing_tf([["a", "b", "a"], ["b"]], num_features=32)
+        np.testing.assert_array_equal(tf, tf2)
+
+    def test_idf_formula(self):
+        from predictionio_tpu.ops.text import idf_fit
+
+        tf = np.array([[1, 0], [1, 1]], dtype=np.float32)
+        m = idf_fit(tf)
+        np.testing.assert_allclose(
+            m.idf, [np.log(3 / 3), np.log(3 / 2)], rtol=1e-6)
+
+    def test_skipgram_pairs_window(self):
+        from predictionio_tpu.ops.text import build_vocab, skipgram_pairs
+
+        docs = [["a", "b", "c"]]
+        vocab = build_vocab(docs)
+        pairs = skipgram_pairs(docs, vocab, window=1)
+        got = {(vocab_inv(vocab, c), vocab_inv(vocab, x))
+               for c, x in pairs.tolist()}
+        assert got == {("a", "b"), ("b", "a"), ("b", "c"), ("c", "b")}
+
+    def test_word2vec_cooccurring_tokens_similar(self):
+        from predictionio_tpu.ops.text import Word2VecConfig, word2vec_train
+
+        # "sun"/"moon" share contexts; "cat"/"dog" share different ones
+        docs = []
+        for _ in range(30):
+            docs.append(["bright", "sun", "sky"])
+            docs.append(["bright", "moon", "sky"])
+            docs.append(["furry", "cat", "pet"])
+            docs.append(["furry", "dog", "pet"])
+        m = word2vec_train(
+            docs, Word2VecConfig(dim=16, window=2, steps=400, batch_size=128,
+                                 seed=0))
+        sims = dict(m.similar("sun", num=len(m.vocab)))
+        assert sims["moon"] > sims["cat"]
+        assert sims["moon"] > sims["dog"]
+
+
+def vocab_inv(vocab, idx):
+    return next(t for t, i in vocab.items() if i == idx)
