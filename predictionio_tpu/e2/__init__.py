@@ -1,0 +1,25 @@
+"""e2 — engine helpers independent of DASE.
+
+Parity with the reference's `e2/` subproject (SURVEY.md §2.3 [U]:
+«e2.engine.CategoricalNaiveBayes», «e2.engine.MarkovChain»,
+«e2.evaluation.CrossValidation»). Pure in-memory helpers templates can use
+without the workflow runtime.
+"""
+
+from predictionio_tpu.e2.engine import (
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    MarkovChain,
+    MarkovChainModel,
+)
+from predictionio_tpu.e2.evaluation import cross_validation_splits
+
+__all__ = [
+    "LabeledPoint",
+    "CategoricalNaiveBayes",
+    "CategoricalNaiveBayesModel",
+    "MarkovChain",
+    "MarkovChainModel",
+    "cross_validation_splits",
+]

@@ -1,0 +1,171 @@
+"""e2.engine — Categorical Naive Bayes and Markov Chain helpers.
+
+Parity with «e2/src/main/scala/.../e2/engine/{CategoricalNaiveBayes,
+MarkovChain}.scala» (SURVEY.md §2.3 [U]). These are small, driver-side
+models in the reference (the RDD is only used to count); dict/ndarray
+counting is the honest equivalent — no device work to win here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter, defaultdict
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """«CategoricalNaiveBayes.LabeledPoint» [U]: a label + categorical
+    (string) feature values, one per feature slot."""
+
+    label: str
+    features: tuple
+
+    def __init__(self, label: str, features: Sequence[str]):
+        object.__setattr__(self, "label", label)
+        object.__setattr__(self, "features", tuple(features))
+
+
+@dataclasses.dataclass
+class CategoricalNaiveBayesModel:
+    """Log priors + per-(label, feature-slot) log likelihood tables.
+
+    `log_score` returns None when a feature value was never seen for the
+    label (the reference's behaviour) unless `default_likelihood` supplies
+    a fallback log-likelihood.
+    """
+
+    priors: dict  # label → log P(label)
+    likelihoods: dict  # label → [slot] → {value: log P(value | label, slot)}
+
+    def log_score(
+        self,
+        features: Sequence[str],
+        label: str,
+        default_likelihood=None,
+    ) -> Optional[float]:
+        if label not in self.priors:
+            return None
+        tables = self.likelihoods[label]
+        if len(features) != len(tables):
+            raise ValueError(
+                f"point has {len(features)} features, model has {len(tables)}"
+            )
+        score = self.priors[label]
+        for slot, value in enumerate(features):
+            table = tables[slot]
+            ll = table.get(value)
+            if ll is None:
+                if default_likelihood is None:
+                    return None
+                ll = default_likelihood(list(table.values()))
+            score += ll
+        return score
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Highest-scoring label; unseen feature values score one nat below
+        the label's minimum seen likelihood (strictly worse than anything
+        observed, but still finite so rare labels stay scorable)."""
+        best_label, best = None, -math.inf
+        for label in self.priors:
+            s = self.log_score(
+                features, label,
+                default_likelihood=lambda lls: (
+                    min(lls) - 1.0 if lls else -math.inf
+                ),
+            )
+            if s is not None and s > best:
+                best_label, best = label, s
+        if best_label is None:
+            raise ValueError("no label is scorable for these features")
+        return best_label
+
+
+class CategoricalNaiveBayes:
+    """«CategoricalNaiveBayes.train» [U]."""
+
+    @staticmethod
+    def train(points: Sequence[LabeledPoint]) -> CategoricalNaiveBayesModel:
+        if not points:
+            raise ValueError("CategoricalNaiveBayes.train: no points")
+        n_slots = len(points[0].features)
+        label_counts: Counter = Counter()
+        value_counts: dict = defaultdict(lambda: [Counter() for _ in range(n_slots)])
+        for p in points:
+            if len(p.features) != n_slots:
+                raise ValueError("inconsistent feature arity")
+            label_counts[p.label] += 1
+            for slot, v in enumerate(p.features):
+                value_counts[p.label][slot][v] += 1
+        total = sum(label_counts.values())
+        priors = {
+            label: math.log(c / total) for label, c in label_counts.items()
+        }
+        likelihoods = {
+            label: [
+                {
+                    v: math.log(c / label_counts[label])
+                    for v, c in value_counts[label][slot].items()
+                }
+                for slot in range(n_slots)
+            ]
+            for label in label_counts
+        }
+        return CategoricalNaiveBayesModel(priors=priors, likelihoods=likelihoods)
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    """Row-normalized first-order transition model («MarkovChain» [U])."""
+
+    transitions: np.ndarray  # [n, n] float32, rows sum to 1 (or 0 if unseen)
+    n: int
+
+    def transition_probs(self, state: int) -> np.ndarray:
+        return self.transitions[state]
+
+    def top_k(self, state: int, k: int) -> list[tuple[int, float]]:
+        row = self.transitions[state]
+        nz = np.nonzero(row)[0]
+        order = nz[np.argsort(-row[nz])][:k]
+        return [(int(i), float(row[i])) for i in order]
+
+
+class MarkovChain:
+    """«MarkovChain.train» [U]: counts → row-stochastic matrix."""
+
+    @staticmethod
+    def train(
+        transition_counts: np.ndarray, top_k: Optional[int] = None
+    ) -> MarkovChainModel:
+        """`transition_counts[i, j]` = observed i→j transitions. `top_k`
+        keeps only each row's k most frequent targets before normalizing
+        (the reference's sparsification knob)."""
+        c = np.asarray(transition_counts, dtype=np.float64)
+        if c.ndim != 2 or c.shape[0] != c.shape[1]:
+            raise ValueError("transition_counts must be square")
+        if top_k is not None and top_k < c.shape[1]:
+            kept = np.zeros_like(c)
+            for i in range(c.shape[0]):
+                idx = np.argpartition(-c[i], top_k - 1)[:top_k]
+                kept[i, idx] = c[i, idx]
+            c = kept
+        rows = c.sum(axis=1, keepdims=True)
+        probs = np.divide(c, rows, out=np.zeros_like(c), where=rows > 0)
+        return MarkovChainModel(
+            transitions=probs.astype(np.float32), n=c.shape[0]
+        )
+
+    @staticmethod
+    def train_from_sequences(
+        sequences: Sequence[Sequence[int]], n: int,
+        top_k: Optional[int] = None,
+    ) -> MarkovChainModel:
+        counts = np.zeros((n, n), dtype=np.float64)
+        for seq in sequences:
+            for a, b in zip(seq, seq[1:]):
+                counts[a, b] += 1
+        return MarkovChain.train(counts, top_k=top_k)

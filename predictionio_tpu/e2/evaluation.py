@@ -1,0 +1,39 @@
+"""e2.evaluation — cross-validation splitting.
+
+Parity with «e2/src/main/scala/.../e2/evaluation/CommonHelperFunctions ::
+CrossValidation» (SURVEY.md §2.3 [U]): split a dataset into k
+(training, testing) folds by index hash, the helper template DataSources
+use to implement `read_eval`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+D = TypeVar("D")
+TD = TypeVar("TD")
+Q = TypeVar("Q")
+A = TypeVar("A")
+
+
+def cross_validation_splits(
+    data: Sequence[D],
+    eval_k: int,
+    create_training: Callable[[list], TD],
+    to_query_actual: Callable[[D], tuple],
+) -> list[tuple]:
+    """Fold i tests on every i-th point (mod k), trains on the rest.
+
+    Returns [(training_data, [(query, actual), ...]), ...] — the exact
+    shape `DataSource.read_eval` must produce.
+    """
+    if eval_k < 2:
+        raise ValueError("eval_k must be >= 2")
+    folds = []
+    for fold in range(eval_k):
+        train = [d for i, d in enumerate(data) if i % eval_k != fold]
+        test = [d for i, d in enumerate(data) if i % eval_k == fold]
+        folds.append(
+            (create_training(train), [to_query_actual(d) for d in test])
+        )
+    return folds

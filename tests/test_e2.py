@@ -1,0 +1,106 @@
+"""e2 helper tests on tiny hand-computed datasets, mirroring the reference
+suites «CategoricalNaiveBayesTest», «MarkovChainTest»,
+«CrossValidationTest» (SURVEY.md §4.1 e2 row)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    cross_validation_splits,
+)
+
+
+class TestCategoricalNaiveBayes:
+    POINTS = [
+        LabeledPoint("spam", ["offer", "night"]),
+        LabeledPoint("spam", ["offer", "day"]),
+        LabeledPoint("spam", ["meet", "night"]),
+        LabeledPoint("ham", ["meet", "day"]),
+        LabeledPoint("ham", ["meet", "night"]),
+    ]
+
+    def test_priors_and_likelihoods(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        assert m.priors["spam"] == pytest.approx(math.log(3 / 5))
+        assert m.priors["ham"] == pytest.approx(math.log(2 / 5))
+        # P(offer | spam, slot0) = 2/3
+        assert m.likelihoods["spam"][0]["offer"] == pytest.approx(math.log(2 / 3))
+        assert m.likelihoods["ham"][0]["meet"] == pytest.approx(math.log(1.0))
+
+    def test_log_score_and_unseen_value(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        s = m.log_score(["offer", "night"], "spam")
+        assert s == pytest.approx(
+            math.log(3 / 5) + math.log(2 / 3) + math.log(2 / 3))
+        # "offer" never appears for ham → None without a default
+        assert m.log_score(["offer", "night"], "ham") is None
+        # with a default it scores
+        assert m.log_score(
+            ["offer", "night"], "ham",
+            default_likelihood=lambda lls: min(lls) - 1.0) is not None
+        # unknown label → None ; arity mismatch → error
+        assert m.log_score(["offer", "night"], "nope") is None
+        with pytest.raises(ValueError, match="features"):
+            m.log_score(["offer"], "spam")
+
+    def test_predict(self):
+        m = CategoricalNaiveBayes.train(self.POINTS)
+        assert m.predict(["offer", "day"]) == "spam"
+        assert m.predict(["meet", "day"]) == "ham"
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            CategoricalNaiveBayes.train([])
+
+
+class TestMarkovChain:
+    def test_row_normalization(self):
+        counts = np.array([[0, 2, 2], [1, 0, 0], [0, 0, 0]])
+        m = MarkovChain.train(counts)
+        np.testing.assert_allclose(m.transitions[0], [0, 0.5, 0.5])
+        np.testing.assert_allclose(m.transitions[1], [1.0, 0, 0])
+        np.testing.assert_allclose(m.transitions[2], [0, 0, 0])  # unseen row
+
+    def test_top_k_sparsification(self):
+        counts = np.array([[5, 3, 1], [0, 0, 0], [1, 1, 1]])
+        m = MarkovChain.train(counts, top_k=2)
+        # row 0 keeps targets 0 and 1: 5/8, 3/8
+        np.testing.assert_allclose(m.transitions[0], [5 / 8, 3 / 8, 0])
+        assert m.top_k(0, 2) == [(0, pytest.approx(5 / 8)),
+                                 (1, pytest.approx(3 / 8))]
+
+    def test_train_from_sequences(self):
+        m = MarkovChain.train_from_sequences([[0, 1, 2], [0, 1, 0]], n=3)
+        np.testing.assert_allclose(m.transitions[0], [0, 1.0, 0])
+        np.testing.assert_allclose(m.transitions[1], [0.5, 0, 0.5])
+
+    def test_non_square_raises(self):
+        with pytest.raises(ValueError, match="square"):
+            MarkovChain.train(np.zeros((2, 3)))
+
+
+class TestCrossValidation:
+    def test_fold_shapes_and_coverage(self):
+        data = list(range(10))
+        folds = cross_validation_splits(
+            data, 3,
+            create_training=lambda xs: xs,
+            to_query_actual=lambda d: (f"q{d}", f"a{d}"),
+        )
+        assert len(folds) == 3
+        all_test = []
+        for train, qa in folds:
+            test_ids = [int(q[1:]) for q, _ in qa]
+            all_test += test_ids
+            # train and test partition the data
+            assert sorted(train + test_ids) == data
+        assert sorted(all_test) == data  # every point tested exactly once
+
+    def test_k_too_small(self):
+        with pytest.raises(ValueError):
+            cross_validation_splits([1], 1, lambda x: x, lambda d: (d, d))
