@@ -111,6 +111,12 @@ class ALSConfig:
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 0
     dtype: str = "float32"
+    # Pallas fused gather+Gram kernel (ops/pallas_als.py). "off"/"auto":
+    # XLA gather+einsum path (measured at parity with the kernel on v5e at
+    # ML-20M-like density — auto stays conservative until the kernel wins);
+    # "on": force the kernel (TPU, rank % 128 == 0, factors fit VMEM);
+    # "interpret": kernel in interpreter mode on any backend (tests).
+    pallas: str = "auto"
 
 
 def _solve_buckets_device(
@@ -123,26 +129,45 @@ def _solve_buckets_device(
     fresh [out_rows, K] matrix. Pure jittable function of device arrays."""
     import jax.numpy as jnp
 
+    import jax
+
+    from predictionio_tpu.ops import pallas_als
+
     k = opposing.shape[-1]
     eye = jnp.eye(k, dtype=opposing.dtype)
     new = jnp.zeros((out_rows, k), dtype=opposing.dtype)
+
+    use_pallas = cfg.pallas in ("on", "interpret")
+    interpret = cfg.pallas == "interpret"
 
     if cfg.implicit:
         # global Gram over real (non-sentinel-pad) opposing rows
         gram = opposing.T @ opposing
 
     for rows, cols, vals, mask in buckets_dev:
-        y = opposing[cols]  # [R, C, K] gather
-        ym = y * mask[..., None]
-        if cfg.implicit:
-            conf = cfg.alpha * vals  # C - I, zero at padding
-            a = gram[None] + jnp.einsum("rck,rc,rcl->rkl", ym, conf, ym)
-            b = jnp.einsum("rck,rc->rk", ym, 1.0 + conf)
-            n = mask.sum(-1)
+        n = mask.sum(-1)
+        if use_pallas:
+            # fused gather + weighted Gram/RHS (see ops/pallas_als.py)
+            if cfg.implicit:
+                wa = cfg.alpha * vals
+                wb = (1.0 + cfg.alpha * vals) * mask
+            else:
+                wa = mask
+                wb = vals
+            a, b = pallas_als.gram_rhs(opposing, cols, wa, wb,
+                                       interpret=interpret)
+            if cfg.implicit:
+                a = a + gram[None]
         else:
-            a = jnp.einsum("rck,rcl->rkl", ym, y)
-            b = jnp.einsum("rck,rc->rk", ym, vals)
-            n = mask.sum(-1)
+            y = opposing[cols]  # [R, C, K] gather
+            ym = y * mask[..., None]
+            if cfg.implicit:
+                conf = cfg.alpha * vals  # C - I, zero at padding
+                a = gram[None] + jnp.einsum("rck,rc,rcl->rkl", ym, conf, ym)
+                b = jnp.einsum("rck,rc->rk", ym, 1.0 + conf)
+            else:
+                a = jnp.einsum("rck,rcl->rkl", ym, y)
+                b = jnp.einsum("rck,rc->rk", ym, vals)
         reg = cfg.reg * (n if cfg.weighted_reg else jnp.ones_like(n))
         a = a + reg[:, None, None] * eye[None]
         x = jnp.linalg.solve(a, b[..., None])[..., 0]
@@ -234,6 +259,12 @@ def als_train(
         mesh = make_mesh()
     n_data = mesh.shape.get(DATA_AXIS, 1)
     row_multiple = max(8, n_data)
+
+    if mesh.size > 1 and cfg.pallas != "off":
+        # the Pallas kernel is a single-device program; under a real mesh
+        # the buckets are sharded and GSPMD can't partition a pallas_call —
+        # stay on the XLA gather+einsum path (which it shards fine)
+        cfg = dataclasses.replace(cfg, pallas="off")
 
     user_buckets = bucket_ragged(user_idx, item_idx, ratings, n_users, row_multiple)
     item_buckets = bucket_ragged(item_idx, user_idx, ratings, n_items, row_multiple)

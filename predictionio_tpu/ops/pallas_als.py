@@ -1,0 +1,162 @@
+"""Pallas TPU kernel: fused gather + weighted Gram/RHS accumulation for ALS.
+
+The ALS half-epoch hot op (ops/als.py `_solve_buckets_device`) is, per row
+r with C rated columns:
+
+    A0[r] = Σ_c wa[r,c] · y_c y_cᵀ        (y_c = opposing[cols[r,c]])
+    b[r]  = Σ_c wb[r,c] · y_c
+
+The XLA formulation materializes the gathered [R, C, K] tensor in HBM
+before the einsums — 3× the traffic actually needed. This kernel fuses the
+gather with the accumulation: column ids ride in SMEM via scalar prefetch
+(«PrefetchScalarGridSpec», pallas_guide.md §12), each grid step keeps one
+row's [K, K] Gram in registers/VMEM, and each rated column is one dynamic
+row load + one MXU outer product (`dot_general` contracting the size-1
+dim). Weights unify the explicit/implicit modes (ops/als.py docstring):
+
+    explicit:  wa = mask,          wb = vals           (A = A0 + λI)
+    implicit:  wa = α·vals,        wb = (1+α·vals)·mask (A = A0 + YᵀY + λI)
+
+Constraints (see `pallas_applicable`): K a multiple of 128 lanes (rank-128
+is the headline benchmark config — BASELINE.json config 5), and the
+opposing factor matrix must fit in VMEM alongside scratch. Measured on
+v5e-1 at ML-20M-like density (20k users, 400k ratings, rank 128): parity
+with the XLA path (1.48 s vs 1.49 s per epoch) — the per-rating dynamic
+row loads dominate; row-blocked batched DMA is the known next step, so
+`ALSConfig.pallas="auto"` keeps the XLA path until the kernel wins.
+
+No reference counterpart: PredictionIO delegates this to Spark MLlib ALS's
+JNI BLAS (SURVEY.md §2.5 — the mandated "native equivalent" is exactly
+this kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+log = logging.getLogger(__name__)
+
+# opposing-factor bytes that may sit resident in VMEM (16 MB/core minus
+# room for scratch + double buffering)
+VMEM_OPPOSING_BUDGET = 10 * 1024 * 1024
+
+# scalar-prefetch entries (cols + wa + wb, 4 B each) per pallas_call; SMEM
+# is ~1 MB, keep the three arrays comfortably under half of it
+SMEM_ENTRY_BUDGET = 40_000
+
+
+def pallas_applicable(n_cols: int, rank: int) -> bool:
+    """Fast-path eligibility: lane-aligned rank and VMEM-resident factors."""
+    return rank % 128 == 0 and n_cols * rank * 4 <= VMEM_OPPOSING_BUDGET
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel(n_rows: int, cap: int, n_cols_pad: int, rank: int,
+                  interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(cols_smem, wa_smem, wb_smem, opposing_ref, a_out, b_out,
+               y_buf, yw_buf):
+        # weights ride in SMEM with the column ids: (1, cap) VMEM blocks
+        # would violate the TPU (8, 128) block-tiling rule, and they are
+        # consumed one scalar at a time anyway
+        r = pl.program_id(0)
+
+        # stage the row's gathered factors into VMEM scratch so the Gram
+        # is ONE [K, C] @ [C, K] MXU matmul instead of C outer products
+        def body(c, rhs):
+            col = cols_smem[r * cap + c]
+            y = opposing_ref[pl.ds(col, 1), :]  # [1, K] dynamic row load
+            wa = wa_smem[r * cap + c]
+            wb = wb_smem[r * cap + c]
+            y_buf[pl.ds(c, 1), :] = y
+            yw_buf[pl.ds(c, 1), :] = wa * y
+            return rhs + wb * y
+
+        rhs = jax.lax.fori_loop(
+            0, cap, body, jnp.zeros((1, rank), dtype=jnp.float32)
+        )
+        a_out[0] = jax.lax.dot_general(  # Σ_c wa·y yᵀ = (diag(wa)Y)ᵀ Y
+            yw_buf[:], y_buf[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        b_out[0] = rhs
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_rows,),
+        in_specs=[
+            # opposing resident in VMEM, same block every grid step
+            pl.BlockSpec((n_cols_pad, rank), lambda r, *s: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, rank, rank), lambda r, *s: (r, 0, 0)),
+            # b as [R, 1, rank] so the inner block is (1, rank) — lane-
+            # aligned and sublane-dim equal to the array's
+            pl.BlockSpec((1, 1, rank), lambda r, *s: (r, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cap, rank), jnp.float32),
+            pltpu.VMEM((cap, rank), jnp.float32),
+        ],
+    )
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n_rows, rank, rank), jnp.float32),
+            jax.ShapeDtypeStruct((n_rows, 1, rank), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+
+def gram_rhs(opposing, cols, wa, wb, interpret: bool = False):
+    """Fused Σ w·y yᵀ / Σ w·y over a padded bucket.
+
+    opposing: [n_cols, K] f32 (K % 128 == 0 unless interpret)
+    cols:     [R, C] int32 column ids (0 where padded — weight 0 kills it)
+    wa, wb:   [R, C] f32 accumulation weights
+    returns:  (A0 [R, K, K], b [R, K])
+    """
+    import jax.numpy as jnp
+
+    n_cols, rank = opposing.shape
+    n_rows, cap = cols.shape
+    # sublane-align the resident factor block
+    n_cols_pad = -(-n_cols // 8) * 8
+    if n_cols_pad != n_cols:
+        opposing = jnp.pad(opposing, ((0, n_cols_pad - n_cols), (0, 0)))
+    opposing = opposing.astype(jnp.float32)
+
+    # chunk rows so each call's scalar-prefetch (cols+wa+wb) fits in SMEM
+    rows_per_call = max(8, (SMEM_ENTRY_BUDGET // max(cap, 1)) // 8 * 8)
+    a_parts, b_parts = [], []
+    for start in range(0, n_rows, rows_per_call):
+        end = min(start + rows_per_call, n_rows)
+        r = end - start
+        r_pad = -(-r // 8) * 8
+        c_k = cols[start:end]
+        wa_k = wa[start:end]
+        wb_k = wb[start:end]
+        if r_pad != r:
+            c_k = jnp.pad(c_k, ((0, r_pad - r), (0, 0)))
+            wa_k = jnp.pad(wa_k, ((0, r_pad - r), (0, 0)))
+            wb_k = jnp.pad(wb_k, ((0, r_pad - r), (0, 0)))
+        run = _build_kernel(r_pad, cap, n_cols_pad, rank, interpret)
+        a0, b = run(
+            c_k.reshape(-1).astype(jnp.int32),
+            wa_k.reshape(-1).astype(jnp.float32),
+            wb_k.reshape(-1).astype(jnp.float32),
+            opposing,
+        )
+        a_parts.append(a0[:r])
+        b_parts.append(b.reshape(r_pad, rank)[:r])
+    if len(a_parts) == 1:
+        return a_parts[0], b_parts[0]
+    return jnp.concatenate(a_parts), jnp.concatenate(b_parts)

@@ -1,0 +1,101 @@
+"""Pallas fused gather+Gram kernel (ops/pallas_als.py), interpret mode on
+CPU: correctness against the XLA einsum formulation, and full ALS parity
+between the kernel and XLA paths."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.pallas_als import gram_rhs, pallas_applicable
+from predictionio_tpu.parallel.mesh import make_mesh
+
+
+def single_device_mesh():
+    return make_mesh({"data": 1, "model": 1}, devices=jax.devices()[:1])
+
+
+class TestGramRhsKernel:
+    @pytest.mark.parametrize("implicit_weights", [False, True])
+    def test_matches_einsum_reference(self, implicit_weights):
+        rng = np.random.default_rng(0)
+        n_cols, rank, n_rows, cap = 13, 128, 6, 4
+        opp = rng.normal(size=(n_cols, rank)).astype(np.float32)
+        cols = rng.integers(0, n_cols, size=(n_rows, cap)).astype(np.int32)
+        mask = (rng.random((n_rows, cap)) < 0.8).astype(np.float32)
+        vals = rng.random((n_rows, cap)).astype(np.float32) * mask
+        if implicit_weights:
+            wa, wb = 2.0 * vals, (1.0 + 2.0 * vals) * mask
+        else:
+            wa, wb = mask, vals
+        a0, b = gram_rhs(
+            jnp.asarray(opp), jnp.asarray(cols), jnp.asarray(wa),
+            jnp.asarray(wb), interpret=True,
+        )
+        y = opp[cols]
+        np.testing.assert_allclose(
+            np.asarray(a0), np.einsum("rck,rc,rcl->rkl", y, wa, y),
+            atol=1e-3, rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(b), np.einsum("rck,rc->rk", y, wb),
+            atol=1e-3, rtol=1e-4,
+        )
+
+    def test_non_sublane_aligned_opposing_rows(self):
+        """n_cols not a multiple of 8 pads internally."""
+        rng = np.random.default_rng(1)
+        opp = rng.normal(size=(5, 128)).astype(np.float32)
+        cols = np.array([[0, 4], [3, 3]], dtype=np.int32)
+        wa = np.ones((2, 2), dtype=np.float32)
+        wb = np.ones((2, 2), dtype=np.float32)
+        a0, b = gram_rhs(jnp.asarray(opp), jnp.asarray(cols),
+                         jnp.asarray(wa), jnp.asarray(wb), interpret=True)
+        y = opp[cols]
+        np.testing.assert_allclose(
+            np.asarray(b), y.sum(axis=1), atol=1e-4)
+
+    def test_applicability_gate(self):
+        assert pallas_applicable(n_cols=20_000, rank=128)
+        assert not pallas_applicable(n_cols=20_000, rank=64)  # lane-misaligned
+        assert not pallas_applicable(n_cols=100_000, rank=128)  # VMEM blow
+
+
+class TestALSKernelPath:
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_full_train_matches_xla_path(self, implicit):
+        rng = np.random.default_rng(2)
+        n_users, n_items, n = 24, 16, 200
+        u = rng.integers(0, n_users, n).astype(np.int32)
+        i = rng.integers(0, n_items, n).astype(np.int32)
+        r = (rng.random(n).astype(np.float32) * 4 + 1)
+        mesh = single_device_mesh()
+        base = dict(rank=128, iterations=3, reg=0.1, implicit=implicit,
+                    alpha=1.5, seed=0)
+        res_xla = als_train(u, i, r, n_users, n_items,
+                            ALSConfig(pallas="off", **base), mesh=mesh)
+        res_pal = als_train(u, i, r, n_users, n_items,
+                            ALSConfig(pallas="interpret", **base), mesh=mesh)
+        np.testing.assert_allclose(
+            res_pal.user_factors, res_xla.user_factors, atol=2e-2, rtol=1e-2)
+        np.testing.assert_allclose(
+            res_pal.item_factors, res_xla.item_factors, atol=2e-2, rtol=1e-2)
+
+    def test_multi_device_mesh_forces_xla_path(self):
+        """pallas='interpret' on a >1-device mesh must not crash (it is
+        downgraded to the sharded XLA path)."""
+        rng = np.random.default_rng(3)
+        n = 100
+        u = rng.integers(0, 16, n).astype(np.int32)
+        i = rng.integers(0, 8, n).astype(np.int32)
+        r = rng.random(n).astype(np.float32) + 0.5
+        res = als_train(
+            u, i, r, 16, 8,
+            ALSConfig(rank=8, iterations=2, pallas="interpret", seed=0),
+            mesh=make_mesh(),  # all 8 virtual CPU devices
+        )
+        assert res.user_factors.shape == (16, 8)
+        assert np.isfinite(res.user_factors).all()
