@@ -5,6 +5,21 @@ communication backend (SURVEY.md §2.7): XLA collectives over ICI/DCN under
 `jit`/`shard_map`, with `jax.distributed` as the multi-host control plane.
 """
 
+from predictionio_tpu.parallel.collectives import (
+    all_gather_rows,
+    all_reduce_sum,
+    all_to_all_rows,
+    reduce_scatter_rows,
+    ring_exchange,
+    ring_mapreduce_rows,
+)
+from predictionio_tpu.parallel.distributed import (
+    global_mesh,
+    initialize_from_env,
+    make_global_array,
+    parse_mesh_shape,
+    process_row_range,
+)
 from predictionio_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -21,4 +36,15 @@ __all__ = [
     "named_sharding",
     "replicated",
     "host_shard",
+    "all_reduce_sum",
+    "all_gather_rows",
+    "reduce_scatter_rows",
+    "all_to_all_rows",
+    "ring_exchange",
+    "ring_mapreduce_rows",
+    "initialize_from_env",
+    "global_mesh",
+    "make_global_array",
+    "parse_mesh_shape",
+    "process_row_range",
 ]

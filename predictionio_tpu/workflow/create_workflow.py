@@ -47,6 +47,9 @@ def run_train(
     skip_sanity_check: bool = False,
     verbose: int = 0,
 ):
+    from predictionio_tpu.parallel.distributed import initialize_from_env
+
+    initialize_from_env()  # multi-host bootstrap when PIO_COORDINATOR_* set
     variant = read_engine_json(engine_json)
     engine = get_engine(variant.engine_factory)
     engine_params = extract_engine_params(engine, variant)

@@ -1,0 +1,109 @@
+"""Collectives + multi-host helpers on the 8-virtual-device CPU mesh —
+real SPMD semantics, not local[n] make-believe (SURVEY.md §4.2 note)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from predictionio_tpu.parallel.collectives import (
+    all_gather_rows,
+    all_reduce_sum,
+    all_to_all_rows,
+    reduce_scatter_rows,
+    ring_exchange,
+    ring_mapreduce_rows,
+)
+from predictionio_tpu.parallel.distributed import (
+    make_global_array,
+    parse_mesh_shape,
+    process_row_range,
+)
+from predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    named_sharding,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh({DATA_AXIS: 8, MODEL_AXIS: 1})
+
+
+@pytest.fixture(scope="module")
+def mesh_model4():
+    return make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self, mesh8):
+        x = np.arange(16, dtype=np.float32)
+        xs = jax.device_put(x, named_sharding(mesh8, DATA_AXIS))
+        out = all_reduce_sum(mesh8, xs)
+        # psum over shards of a [16] array sharded by 8: each shard [2]
+        # sums elementwise with the others → [2] replicated
+        expected = x.reshape(8, 2).sum(axis=0)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_all_gather_rows(self, mesh8):
+        x = np.arange(32, dtype=np.float32).reshape(16, 2)
+        xs = jax.device_put(x, named_sharding(mesh8, DATA_AXIS, None))
+        out = all_gather_rows(mesh8, xs)
+        np.testing.assert_allclose(np.asarray(out), x)
+        assert out.sharding.is_fully_replicated
+
+    def test_reduce_scatter_rows(self, mesh8):
+        x = np.ones((16, 4), dtype=np.float32)
+        xr = jax.device_put(x, named_sharding(mesh8))  # replicated
+        out = reduce_scatter_rows(mesh8, xr)
+        # every device contributed the same [16,4]; psum_scatter sums the
+        # 8 copies and leaves each device rows 2i..2i+1 → all values 8
+        np.testing.assert_allclose(np.asarray(out), 8.0 * x)
+
+    def test_all_to_all_rows_is_involution(self, mesh8):
+        x = np.arange(64, dtype=np.float32).reshape(64, 1)
+        xs = jax.device_put(x, named_sharding(mesh8, DATA_AXIS, None))
+        once = all_to_all_rows(mesh8, xs)
+        twice = all_to_all_rows(mesh8, once)
+        # exchanging chunk (d, b) → (b, d) twice is the identity
+        np.testing.assert_allclose(np.asarray(twice), x)
+        assert not np.allclose(np.asarray(once), x)  # it did move data
+
+    def test_ring_exchange_rotates_blocks(self, mesh_model4):
+        x = np.repeat(np.arange(4, dtype=np.float32), 2).reshape(8, 1)
+        xs = jax.device_put(x, named_sharding(mesh_model4, MODEL_AXIS, None))
+        out = ring_exchange(mesh_model4, xs, MODEL_AXIS)
+        # device d's block (value d) lands on device d+1 mod 4
+        expected = np.repeat([3, 0, 1, 2], 2).astype(np.float32).reshape(8, 1)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_ring_mapreduce_sums_all_blocks(self, mesh_model4):
+        x = np.repeat(np.arange(4, dtype=np.float32), 2).reshape(8, 1)
+        xs = jax.device_put(x, named_sharding(mesh_model4, MODEL_AXIS, None))
+        out = ring_mapreduce_rows(
+            mesh_model4, lambda block, i: block, xs, MODEL_AXIS)
+        # every device sees every block once → each accumulates 0+1+2+3
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 6.0))
+
+
+class TestDistributedHelpers:
+    def test_parse_mesh_shape(self):
+        assert parse_mesh_shape("data=16,model=4") == {"data": 16, "model": 4}
+        assert parse_mesh_shape(" data=2 ") == {"data": 2}
+        with pytest.raises(ValueError):
+            parse_mesh_shape("data:16")
+        with pytest.raises(ValueError):
+            parse_mesh_shape("")
+
+    def test_process_row_range_single_process(self):
+        assert process_row_range(100) == (0, 100)
+
+    def test_make_global_array_places_row_sharded(self, mesh8):
+        x = np.arange(32, dtype=np.float32).reshape(16, 2)
+        arr = make_global_array(mesh8, x)
+        np.testing.assert_allclose(np.asarray(arr), x)
+        # row-sharded over 8 devices → each shard holds 2 rows
+        shard_shapes = {s.data.shape for s in arr.addressable_shards}
+        assert shard_shapes == {(2, 2)}
