@@ -26,6 +26,8 @@ class WorkflowContext:
         batch: str = "",
         verbose: int = 0,
         storage: Optional[Any] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1,
     ):
         """Args:
         mesh_shape: axis name → size, e.g. ``{"data": 4, "model": 2}``.
@@ -34,13 +36,26 @@ class WorkflowContext:
         batch: human-readable run label (the reference's `--batch`).
         verbose: debug verbosity (the reference's WorkflowParams.verbose).
         storage: Storage registry override (defaults to the process one).
+        checkpoint_dir: when set, algorithms checkpoint trainer state here
+            every `checkpoint_every` epochs and resume from the latest
+            step on re-run (SURVEY.md §5 'Checkpoint / resume').
         """
         self.mesh_shape = mesh_shape
         self.seed = seed
         self.batch = batch
         self.verbose = verbose
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
         self._storage = storage
         self._mesh: Optional["jax.sharding.Mesh"] = None
+
+    def algorithm_checkpoint_dir(self, algo_name: str) -> Optional[str]:
+        """Per-algorithm checkpoint subdirectory (None when disabled)."""
+        if not self.checkpoint_dir:
+            return None
+        import os
+
+        return os.path.join(self.checkpoint_dir, algo_name)
 
     @property
     def storage(self):

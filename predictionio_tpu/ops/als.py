@@ -194,12 +194,14 @@ def _predict_sq_err(u_factors, i_factors, buckets_dev):
 
 @functools.lru_cache(maxsize=64)
 def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
-                    compute_rmse: bool):
-    """The full training run as ONE jitted program: `lax.scan` over
-    iterations, so a train is a single dispatch with no host round trips
-    (under `jit` everything is traced once and compiled — SURVEY.md §7.1's
-    'no data-dependent Python control flow' rule applied to the ALS loop).
-    RMSE history is accumulated on-device and read back once."""
+                    compute_rmse: bool, n_steps: int):
+    """`n_steps` iterations of training as ONE jitted program: `lax.scan`
+    over iterations, so a train is a single dispatch with no host round
+    trips (under `jit` everything is traced once and compiled — SURVEY.md
+    §7.1's 'no data-dependent Python control flow' rule applied to the ALS
+    loop). RMSE history is accumulated on-device and read back once. With
+    checkpointing, `n_steps` is the checkpoint interval and the host loop
+    re-dispatches between saves (same compiled program each chunk)."""
     import jax
     import jax.numpy as jnp
 
@@ -216,7 +218,7 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
             return (user_f, item_f), rmse
 
         (user_f, item_f), rmses = jax.lax.scan(
-            body, (user_factors0, item_factors0), xs=None, length=cfg.iterations
+            body, (user_factors0, item_factors0), xs=None, length=n_steps
         )
         return user_f, item_f, rmses
 
@@ -229,7 +231,8 @@ class ALSResult:
     item_factors: np.ndarray  # [n_items, K]
     rmse_history: list[float]
     epoch_times: list[float] = dataclasses.field(default_factory=list)
-    # wall seconds per iteration, synced (first entry includes compile)
+    # wall seconds per iteration *executed in this call* (includes compile;
+    # empty when a checkpointed run was already complete and fully resumed)
 
 
 def als_train(
@@ -241,6 +244,9 @@ def als_train(
     cfg: ALSConfig,
     mesh=None,
     compute_rmse: bool = False,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
+    resume: bool = True,
 ) -> ALSResult:
     """Train ALS factors from COO ratings.
 
@@ -248,6 +254,13 @@ def als_train(
     Bucket rows are sharded over the `data` axis; factor matrices are
     replicated. This is SURVEY.md §2.6 strategy 2 (MLlib's block-parallel
     ALS) re-expressed for ICI.
+
+    checkpoint_dir: when set, factors are checkpointed every
+    `checkpoint_every` iterations (SURVEY.md §5 'Checkpoint / resume') and
+    an interrupted run resumes from the latest saved step (resume=True).
+    Checkpointing chunks the single-dispatch scan into
+    `checkpoint_every`-sized dispatches; with it off the whole run stays
+    one dispatch.
     """
     import jax
     import jax.numpy as jnp
@@ -302,20 +315,81 @@ def als_train(
 
     import time
 
-    # One dispatch for the whole run: the iteration loop is a lax.scan
-    # inside a single jitted program, so there are no per-epoch host round
-    # trips (this TPU sits behind a tunnel; a sync per epoch would dwarf
-    # the compute at quickstart scale). Epoch time = wall / iterations.
-    train = _get_train_loop(n_users, n_items, cfg, compute_rmse)
+    checkpoint_every = max(1, checkpoint_every)
+    start_iter = 0
+    rmse_history: list[float] = []
+    manager = None
+    if checkpoint_dir:
+        import hashlib
+
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        # fingerprint the training data + solver config: a checkpoint only
+        # resumes the *same* run. New ratings (nightly retrain into the
+        # same dir) or a changed rank/reg/seed must retrain from scratch,
+        # not return yesterday's completed factors.
+        fingerprint = hashlib.blake2b(
+            np.ascontiguousarray(user_idx).tobytes()
+            + np.ascontiguousarray(item_idx).tobytes()
+            + np.ascontiguousarray(ratings).tobytes()
+            + repr((n_users, n_items, cfg.rank, cfg.reg, cfg.weighted_reg,
+                    cfg.implicit, cfg.alpha, cfg.seed, cfg.dtype)).encode(),
+            digest_size=8,
+        ).hexdigest()
+        manager = CheckpointManager(checkpoint_dir)
+        latest = manager.latest_step() if resume else None
+        if latest is not None:
+            tree, meta = manager.restore(latest)
+            uf = tree.get("user_factors") if isinstance(tree, dict) else None
+            vf = tree.get("item_factors") if isinstance(tree, dict) else None
+            if (meta.get("fingerprint") == fingerprint
+                    and uf is not None and vf is not None
+                    and uf.shape == (n_users, cfg.rank)
+                    and vf.shape == (n_items, cfg.rank)):
+                user_factors = jax.device_put(uf, rep)
+                item_factors = jax.device_put(vf, rep)
+                start_iter = min(latest, cfg.iterations)
+                rmse_history = list(meta.get("rmse_history", []))[:start_iter]
+                log.info("als_train: resumed from checkpoint step %d", latest)
+            else:
+                log.warning(
+                    "als_train: checkpoint at %s is from different data/"
+                    "config (or a foreign tree) — training from scratch",
+                    checkpoint_dir)
+        if not compute_rmse:
+            rmse_history = []
+
+    # One dispatch for the whole run (or per checkpoint chunk): the
+    # iteration loop is a lax.scan inside a single jitted program, so
+    # there are no per-epoch host round trips (this TPU sits behind a
+    # tunnel; a sync per epoch would dwarf the compute at quickstart
+    # scale). Epoch time = wall / iterations.
     t_start = time.perf_counter()
-    user_factors, item_factors, rmses = train(item_factors, user_factors,
-                                              ub_dev, ib_dev)
-    # a scalar readback is the reliable execution fence on this platform
-    # (block_until_ready can return early behind the axon tunnel)
-    float(item_factors[0, 0])
+    done = start_iter
+    while done < cfg.iterations:
+        n_steps = (min(checkpoint_every, cfg.iterations - done)
+                   if manager else cfg.iterations - done)
+        train = _get_train_loop(n_users, n_items, cfg, compute_rmse, n_steps)
+        user_factors, item_factors, rmses = train(item_factors, user_factors,
+                                                  ub_dev, ib_dev)
+        # a scalar readback is the reliable execution fence on this platform
+        # (block_until_ready can return early behind the axon tunnel)
+        float(item_factors[0, 0])
+        done += n_steps
+        if compute_rmse:
+            rmse_history.extend(float(x) for x in np.asarray(rmses))
+        if manager:
+            manager.save(
+                done,
+                {"user_factors": np.asarray(user_factors),
+                 "item_factors": np.asarray(item_factors)},
+                metadata={"rmse_history": rmse_history,
+                          "iterations": cfg.iterations, "rank": cfg.rank,
+                          "fingerprint": fingerprint},
+            )
     wall = time.perf_counter() - t_start
-    epoch_times = [wall / max(cfg.iterations, 1)] * cfg.iterations
-    rmse_history = [float(x) for x in np.asarray(rmses)] if compute_rmse else []
+    executed = cfg.iterations - start_iter
+    epoch_times = [wall / executed] * executed if executed > 0 else []
     if compute_rmse and rmse_history:
         log.info("als_train: rmse %.4f → %.4f over %d iters",
                  rmse_history[0], rmse_history[-1], cfg.iterations)

@@ -194,6 +194,8 @@ class ALSAlgorithm(Algorithm):
             pd.user_idx, pd.item_idx, pd.ratings,
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
             cfg=cfg, mesh=ctx.mesh, compute_rmse=p.computeRMSE,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
+            checkpoint_every=ctx.checkpoint_every,
         )
         seen: dict[int, list] = {}
         for u, i in zip(pd.user_idx, pd.item_idx):

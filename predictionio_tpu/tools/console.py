@@ -151,6 +151,8 @@ def cmd_train(args) -> int:
             mesh=args.mesh,
             skip_sanity_check=args.skip_sanity_check,
             verbose=args.verbose,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
         )
     except FileNotFoundError as e:
         print(f"Cannot read engine variant: {e}", file=sys.stderr)
@@ -361,6 +363,11 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--engine-version", default="1")
     add_run_args(train)
     train.add_argument("--skip-sanity-check", action="store_true")
+    train.add_argument("--checkpoint-dir", default=None,
+                       help="checkpoint trainer state here every "
+                            "--checkpoint-every epochs; re-running train "
+                            "resumes from the latest step")
+    train.add_argument("--checkpoint-every", type=int, default=1)
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval")

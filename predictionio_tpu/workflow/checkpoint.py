@@ -1,0 +1,142 @@
+"""Per-epoch checkpoint/resume for training runs.
+
+The reference has no mid-training checkpointing — MLlib ALS only truncates
+RDD lineage («sc.setCheckpointDir», SURVEY.md §5 'Checkpoint / resume'
+[U]); recovery is whole-model persistence after train. JAX has no lineage
+to recompute from, so the rebuild provides the stronger contract SURVEY.md
+§5 prescribes: factor matrices / opt state checkpointed every N epochs,
+`pio train --checkpoint-dir` resumable after interruption, while `deploy`
+keeps the reference's latest-COMPLETED-EngineInstance contract.
+
+Format: one directory per step holding `arrays.npz` (the numpy pytree
+leaves) + `meta.json` (tree structure + user metadata). Writes go to a
+temp dir then `os.replace` — a crash mid-write never corrupts the latest
+complete step, which is the same atomicity story orbax's finalized-commit
+protocol gives (orbax itself is deliberately not used: its async layout
+churns across versions, and these checkpoints are small host-side numpy
+state, not sharded jax.Arrays).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _flatten(tree: Any, prefix: str = "") -> tuple[dict, Any]:
+    """Flatten a (dict|list|scalar|ndarray) pytree → ({path: ndarray}, spec).
+
+    The spec mirrors the tree with leaf positions replaced by their path
+    string, so restore can rebuild the exact structure.
+    """
+    if isinstance(tree, dict):
+        arrays: dict = {}
+        spec = {}
+        for k in sorted(tree):
+            sub_arrays, sub_spec = _flatten(tree[k], f"{prefix}{k}/")
+            arrays.update(sub_arrays)
+            spec[k] = sub_spec
+        return arrays, {"__dict__": spec}
+    if isinstance(tree, (list, tuple)):
+        arrays = {}
+        spec_items = []
+        for idx, item in enumerate(tree):
+            sub_arrays, sub_spec = _flatten(item, f"{prefix}{idx}/")
+            arrays.update(sub_arrays)
+            spec_items.append(sub_spec)
+        return arrays, {"__list__": spec_items, "__tuple__": isinstance(tree, tuple)}
+    path = prefix.rstrip("/") or "value"
+    return {path: np.asarray(tree)}, {"__leaf__": path}
+
+
+def _unflatten(spec: Any, arrays: dict) -> Any:
+    if "__dict__" in spec:
+        return {k: _unflatten(v, arrays) for k, v in spec["__dict__"].items()}
+    if "__list__" in spec:
+        items = [_unflatten(v, arrays) for v in spec["__list__"]]
+        return tuple(items) if spec.get("__tuple__") else items
+    return arrays[spec["__leaf__"]]
+
+
+class CheckpointManager:
+    """Save/restore numpy pytrees keyed by integer step.
+
+    API shape follows orbax's CheckpointManager (`save`, `restore`,
+    `latest_step`, `all_steps`) so a swap to orbax for multi-host sharded
+    state is a drop-in later.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.keep = max(1, keep)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(os.path.join(self.directory, name, "meta.json")):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree: Any, metadata: Optional[dict] = None) -> str:
+        arrays, spec = _flatten(tree)
+        tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
+        final = self._step_dir(step)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, "spec": spec,
+                           "metadata": metadata or {}}, f)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        log.info("checkpoint: saved step %d → %s", step, final)
+        return final
+
+    def restore(self, step: Optional[int] = None) -> tuple[Any, dict]:
+        """→ (tree, metadata). step=None restores the latest."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"No checkpoints under {self.directory}")
+        d = self._step_dir(step)
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        with np.load(os.path.join(d, "arrays.npz")) as z:
+            arrays = {k: z[k] for k in z.files}
+        return _unflatten(meta["spec"], arrays), meta.get("metadata", {})
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for step in steps[: -self.keep]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)

@@ -46,6 +46,8 @@ def run_train(
     mesh: Optional[str] = None,
     skip_sanity_check: bool = False,
     verbose: int = 0,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 1,
 ):
     from predictionio_tpu.parallel.distributed import initialize_from_env
 
@@ -54,7 +56,8 @@ def run_train(
     engine = get_engine(variant.engine_factory)
     engine_params = extract_engine_params(engine, variant)
     ctx = WorkflowContext(
-        mesh_shape=parse_mesh_spec(mesh), seed=seed, batch=batch, verbose=verbose
+        mesh_shape=parse_mesh_spec(mesh), seed=seed, batch=batch, verbose=verbose,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
     )
     return CoreWorkflow.run_train(
         engine,

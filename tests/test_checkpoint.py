@@ -1,0 +1,148 @@
+"""Checkpoint/resume: manager round-trips, ALS per-epoch checkpointing,
+and resume-after-interruption equivalence (SURVEY.md §5 'Checkpoint /
+resume' — the rebuild's stronger contract vs the reference's
+whole-model-after-train persistence)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.workflow.checkpoint import CheckpointManager
+from tests.test_als import synth_ratings
+
+
+class TestCheckpointManager:
+    def test_round_trip_nested_tree(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        tree = {
+            "factors": {"user": np.arange(6, dtype=np.float32).reshape(2, 3),
+                        "item": np.ones((3, 3))},
+            "history": [np.float32(1.5), np.float32(0.7)],
+            "step_count": np.int64(2),
+        }
+        cm.save(2, tree, metadata={"note": "hello"})
+        restored, meta = cm.restore()
+        assert meta["note"] == "hello"
+        np.testing.assert_array_equal(restored["factors"]["user"],
+                                      tree["factors"]["user"])
+        np.testing.assert_array_equal(restored["factors"]["item"],
+                                      tree["factors"]["item"])
+        np.testing.assert_allclose([float(x) for x in restored["history"]],
+                                   [1.5, 0.7], rtol=1e-6)
+        assert int(restored["step_count"]) == 2
+
+    def test_latest_and_gc(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path), keep=2)
+        for step in (1, 2, 3, 4):
+            cm.save(step, {"x": np.full((2,), step, dtype=np.float32)})
+        assert cm.latest_step() == 4
+        assert cm.all_steps() == [3, 4]  # keep=2 garbage-collects the rest
+        restored, _ = cm.restore(3)
+        assert restored["x"][0] == 3.0
+
+    def test_restore_empty_raises(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            cm.restore()
+
+    def test_tuple_and_scalar_leaves(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(1, {"t": (np.zeros(2), np.ones(2))})
+        restored, _ = cm.restore(1)
+        assert isinstance(restored["t"], tuple)
+        np.testing.assert_array_equal(restored["t"][1], np.ones(2))
+
+
+class TestALSCheckpointResume:
+    def test_checkpointed_matches_single_dispatch(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=3)
+        cfg = ALSConfig(rank=4, iterations=4, reg=0.05, seed=7)
+        base = als_train(ui, ii, r, 30, 20, cfg)
+        ckpt = als_train(ui, ii, r, 30, 20, cfg,
+                         checkpoint_dir=str(tmp_path), checkpoint_every=2)
+        np.testing.assert_allclose(base.user_factors, ckpt.user_factors,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(base.item_factors, ckpt.item_factors,
+                                   rtol=1e-4, atol=1e-5)
+        cm = CheckpointManager(str(tmp_path))
+        assert cm.latest_step() == 4
+
+    def test_resume_continues_from_latest(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=4)
+        full_cfg = ALSConfig(rank=4, iterations=6, reg=0.05, seed=9)
+        # "interrupted" run: only 3 of 6 iterations, checkpointed
+        partial = als_train(ui, ii, r, 30, 20,
+                            ALSConfig(rank=4, iterations=3, reg=0.05, seed=9),
+                            checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        assert CheckpointManager(str(tmp_path)).latest_step() == 3
+        # re-run asking for the full 6: must resume at step 3, not restart
+        resumed = als_train(ui, ii, r, 30, 20, full_cfg,
+                            checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        uninterrupted = als_train(ui, ii, r, 30, 20, full_cfg)
+        np.testing.assert_allclose(resumed.user_factors,
+                                   uninterrupted.user_factors,
+                                   rtol=1e-4, atol=1e-5)
+        assert CheckpointManager(str(tmp_path)).latest_step() == 6
+        # the resumed run only paid for the remaining epochs
+        assert len(resumed.epoch_times) == 3
+
+    def test_resume_rmse_history_concatenates(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=5)
+        als_train(ui, ii, r, 30, 20,
+                  ALSConfig(rank=4, iterations=2, reg=0.05, seed=1),
+                  checkpoint_dir=str(tmp_path), compute_rmse=True)
+        resumed = als_train(ui, ii, r, 30, 20,
+                            ALSConfig(rank=4, iterations=5, reg=0.05, seed=1),
+                            checkpoint_dir=str(tmp_path), compute_rmse=True)
+        assert len(resumed.rmse_history) == 5
+        # converging: later rmse no worse than the first
+        assert resumed.rmse_history[-1] <= resumed.rmse_history[0] + 1e-6
+
+    def test_changed_data_retrains_from_scratch(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=8)
+        cfg = ALSConfig(rank=4, iterations=2, reg=0.05, seed=3)
+        stale = als_train(ui, ii, r, 30, 20, cfg, checkpoint_dir=str(tmp_path))
+        # nightly retrain with new ratings into the same dir: the completed
+        # checkpoint must NOT be returned as the new model
+        r2 = r.copy()
+        r2[0] += 2.0
+        fresh = als_train(ui, ii, r2, 30, 20, cfg, checkpoint_dir=str(tmp_path))
+        direct = als_train(ui, ii, r2, 30, 20, cfg)
+        np.testing.assert_allclose(fresh.user_factors, direct.user_factors,
+                                   rtol=1e-4, atol=1e-5)
+        assert not np.allclose(fresh.user_factors, stale.user_factors)
+        assert len(fresh.epoch_times) == 2
+
+    def test_fully_resumed_run_returns_model_without_training(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=9)
+        cfg = ALSConfig(rank=4, iterations=2, reg=0.05, seed=4)
+        first = als_train(ui, ii, r, 30, 20, cfg, checkpoint_dir=str(tmp_path))
+        again = als_train(ui, ii, r, 30, 20, cfg, checkpoint_dir=str(tmp_path))
+        np.testing.assert_allclose(first.user_factors, again.user_factors)
+        assert again.epoch_times == []  # no iterations executed
+
+    def test_checkpoint_every_zero_does_not_hang(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=10)
+        out = als_train(ui, ii, r, 30, 20,
+                        ALSConfig(rank=4, iterations=2, reg=0.05, seed=5),
+                        checkpoint_dir=str(tmp_path), checkpoint_every=0)
+        assert np.isfinite(out.user_factors).all()
+
+    def test_mismatched_shapes_ignored(self, tmp_path):
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=6)
+        als_train(ui, ii, r, 30, 20, ALSConfig(rank=4, iterations=1, seed=2),
+                  checkpoint_dir=str(tmp_path))
+        # different rank: stale checkpoint must not be loaded
+        out = als_train(ui, ii, r, 30, 20, ALSConfig(rank=6, iterations=2, seed=2),
+                        checkpoint_dir=str(tmp_path))
+        assert out.user_factors.shape == (30, 6)
+
+
+class TestWorkflowCheckpointWiring:
+    def test_context_algorithm_dir(self, tmp_path):
+        from predictionio_tpu.controller.context import WorkflowContext
+
+        ctx = WorkflowContext(checkpoint_dir=str(tmp_path))
+        d = ctx.algorithm_checkpoint_dir("als")
+        assert d is not None and d.endswith("als")
+        assert WorkflowContext().algorithm_checkpoint_dir("als") is None
