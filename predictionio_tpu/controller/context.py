@@ -28,6 +28,7 @@ class WorkflowContext:
         storage: Optional[Any] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
+        metrics: Optional[Any] = None,
     ):
         """Args:
         mesh_shape: axis name → size, e.g. ``{"data": 4, "model": 2}``.
@@ -39,6 +40,8 @@ class WorkflowContext:
         checkpoint_dir: when set, algorithms checkpoint trainer state here
             every `checkpoint_every` epochs and resume from the latest
             step on re-run (SURVEY.md §5 'Checkpoint / resume').
+        metrics: a `utils.profiling.MetricsLogger` for per-epoch metric
+            emission (default: log-only).
         """
         self.mesh_shape = mesh_shape
         self.seed = seed
@@ -46,8 +49,17 @@ class WorkflowContext:
         self.verbose = verbose
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        self._metrics = metrics
         self._storage = storage
         self._mesh: Optional["jax.sharding.Mesh"] = None
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            from predictionio_tpu.utils.profiling import NullMetricsLogger
+
+            self._metrics = NullMetricsLogger()
+        return self._metrics
 
     def algorithm_checkpoint_dir(self, algo_name: str) -> Optional[str]:
         """Per-algorithm checkpoint subdirectory (None when disabled)."""

@@ -233,6 +233,8 @@ class ALSResult:
     epoch_times: list[float] = dataclasses.field(default_factory=list)
     # wall seconds per iteration *executed in this call* (includes compile;
     # empty when a checkpointed run was already complete and fully resumed)
+    start_epoch: int = 0
+    # first epoch executed in this call (>0 when resumed from a checkpoint)
 
 
 def als_train(
@@ -337,25 +339,33 @@ def als_train(
             digest_size=8,
         ).hexdigest()
         manager = CheckpointManager(checkpoint_dir)
-        latest = manager.latest_step() if resume else None
-        if latest is not None:
-            tree, meta = manager.restore(latest)
-            uf = tree.get("user_factors") if isinstance(tree, dict) else None
-            vf = tree.get("item_factors") if isinstance(tree, dict) else None
-            if (meta.get("fingerprint") == fingerprint
-                    and uf is not None and vf is not None
-                    and uf.shape == (n_users, cfg.rank)
-                    and vf.shape == (n_items, cfg.rank)):
-                user_factors = jax.device_put(uf, rep)
-                item_factors = jax.device_put(vf, rep)
-                start_iter = min(latest, cfg.iterations)
-                rmse_history = list(meta.get("rmse_history", []))[:start_iter]
-                log.info("als_train: resumed from checkpoint step %d", latest)
-            else:
-                log.warning(
-                    "als_train: checkpoint at %s is from different data/"
-                    "config (or a foreign tree) — training from scratch",
-                    checkpoint_dir)
+        # resume from the largest saved step that (a) doesn't overshoot the
+        # requested iteration count and (b) fingerprints as this same run;
+        # then purge every other step so leftovers from a previous run
+        # can't shadow this run's saves (keep_only docstring).
+        restore_step = None
+        if resume:
+            usable = [s for s in manager.all_steps() if s <= cfg.iterations]
+            if usable:
+                tree, meta = manager.restore(usable[-1])
+                uf = tree.get("user_factors") if isinstance(tree, dict) else None
+                vf = tree.get("item_factors") if isinstance(tree, dict) else None
+                if (meta.get("fingerprint") == fingerprint
+                        and uf is not None and vf is not None
+                        and uf.shape == (n_users, cfg.rank)
+                        and vf.shape == (n_items, cfg.rank)):
+                    user_factors = jax.device_put(uf, rep)
+                    item_factors = jax.device_put(vf, rep)
+                    restore_step = start_iter = usable[-1]
+                    rmse_history = list(meta.get("rmse_history", []))[:start_iter]
+                    log.info("als_train: resumed from checkpoint step %d",
+                             restore_step)
+                else:
+                    log.warning(
+                        "als_train: checkpoint at %s is from different data/"
+                        "config (or a foreign tree) — training from scratch",
+                        checkpoint_dir)
+        manager.keep_only(restore_step)
         if not compute_rmse:
             rmse_history = []
 
@@ -369,7 +379,11 @@ def als_train(
     while done < cfg.iterations:
         n_steps = (min(checkpoint_every, cfg.iterations - done)
                    if manager else cfg.iterations - done)
-        train = _get_train_loop(n_users, n_items, cfg, compute_rmse, n_steps)
+        # cache key excludes cfg.iterations (the traced program only sees
+        # n_steps) so runs differing in iteration count share the compile
+        train = _get_train_loop(n_users, n_items,
+                                dataclasses.replace(cfg, iterations=0),
+                                compute_rmse, n_steps)
         user_factors, item_factors, rmses = train(item_factors, user_factors,
                                                   ub_dev, ib_dev)
         # a scalar readback is the reliable execution fence on this platform
@@ -399,4 +413,5 @@ def als_train(
         item_factors=np.asarray(item_factors),
         rmse_history=rmse_history,
         epoch_times=epoch_times,
+        start_epoch=start_iter,
     )

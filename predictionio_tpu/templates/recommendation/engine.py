@@ -197,6 +197,14 @@ class ALSAlgorithm(Algorithm):
             checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
             checkpoint_every=ctx.checkpoint_every,
         )
+        # epoch_times covers only epochs executed this call (a resumed run
+        # skips the first start_epoch epochs); rmse_history covers all
+        for off, t in enumerate(result.epoch_times):
+            step = result.start_epoch + off + 1
+            rec = {"epoch_time_s": t}
+            if result.rmse_history and step <= len(result.rmse_history):
+                rec["rmse"] = result.rmse_history[step - 1]
+            ctx.metrics.emit("train/als", step=step, **rec)
         seen: dict[int, list] = {}
         for u, i in zip(pd.user_idx, pd.item_idx):
             seen.setdefault(int(u), []).append(int(i))

@@ -153,6 +153,9 @@ def cmd_train(args) -> int:
             verbose=args.verbose,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            profile_dir=args.profile_dir,
+            metrics_file=args.metrics_file,
+            debug_nans=args.debug_nans,
         )
     except FileNotFoundError as e:
         print(f"Cannot read engine variant: {e}", file=sys.stderr)
@@ -368,6 +371,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "--checkpoint-every epochs; re-running train "
                             "resumes from the latest step")
     train.add_argument("--checkpoint-every", type=int, default=1)
+    train.add_argument("--profile-dir", default=None,
+                       help="capture a jax.profiler trace here "
+                            "(TensorBoard/Perfetto layout)")
+    train.add_argument("--metrics-file", default=None,
+                       help="append per-epoch metrics as JSON lines here")
+    train.add_argument("--debug-nans", action="store_true",
+                       help="recompile with NaN detection (slow)")
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval")

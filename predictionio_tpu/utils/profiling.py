@@ -1,0 +1,119 @@
+"""Tracing, per-epoch metrics, and numeric-debug flags.
+
+The reference has no profiling of its own — it inherits the Spark web UI
+(stages/tasks at :4040) and log4j verbosity, with
+`WorkflowParams.verbose` gating debug materialization (SURVEY.md §5
+'Tracing / profiling' [U]). The TPU rebuild's equivalents:
+
+- `maybe_trace(profile_dir)`: a `jax.profiler.trace` capture viewable in
+  TensorBoard / Perfetto — the XLA analogue of the Spark stage timeline.
+  Enabled by `pio train --profile-dir`.
+- `MetricsLogger`: structured per-epoch metric emission (loss/RMSE, step
+  time, MAP@10) to stdout logging + a JSON-lines file — the rebuild's
+  replacement for eyeballing Spark stage durations.
+- `set_debug_flags`: `jax_debug_nans` (SURVEY.md §5 'Race detection':
+  functional purity already gives the memory-model story; NaN checking is
+  the numeric-sanitizer analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Any, Optional, TextIO
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: Optional[str]):
+    """Capture a device/host trace into `profile_dir` when set, else no-op.
+
+    The capture is written in TensorBoard's profile layout
+    (`plugins/profile/<run>/...`), loadable with `tensorboard --logdir`
+    or Perfetto.
+    """
+    if not profile_dir:
+        yield None
+        return
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    log.info("profiling: tracing to %s", profile_dir)
+    with jax.profiler.trace(profile_dir):
+        yield profile_dir
+
+
+def annotate(name: str):
+    """Named span that shows up on the trace timeline (use around DASE
+    stages: read/prepare/train/serve)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def set_debug_flags(nan_check: bool = False) -> None:
+    """Numeric sanitizers for the train loop. `nan_check` recompiles jitted
+    programs with NaN detection (slow; debugging only)."""
+    if nan_check:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+        log.info("profiling: jax_debug_nans enabled")
+
+
+class MetricsLogger:
+    """Per-epoch structured metrics → stdout log + optional JSON-lines file.
+
+    One record per `emit` call:
+        {"ts": ..., "run": "...", "stage": "train", "step": 3,
+         "rmse": 0.81, "epoch_time_s": 0.011}
+    """
+
+    def __init__(self, path: Optional[str] = None, run: str = ""):
+        self.run = run
+        self._path = path
+        self._fh: Optional[TextIO] = None
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def emit(self, stage: str, step: Optional[int] = None,
+             **metrics: Any) -> dict:
+        record: dict[str, Any] = {"ts": time.time(), "stage": stage}
+        if self.run:
+            record["run"] = self.run
+        if step is not None:
+            record["step"] = step
+        record.update(metrics)
+        pretty = " ".join(
+            f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items())
+        log.info("metrics[%s]%s %s", stage,
+                 f" step={step}" if step is not None else "", pretty)
+        if self._fh:
+            json.dump(record, self._fh)
+            self._fh.write("\n")
+        return record
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NullMetricsLogger(MetricsLogger):
+    """Emits to the python log only (no file); the default on a context."""
+
+    def __init__(self):
+        super().__init__(path=None)

@@ -140,3 +140,15 @@ class CheckpointManager:
         steps = self.all_steps()
         for step in steps[: -self.keep]:
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    def keep_only(self, step: Optional[int]) -> None:
+        """Delete every saved step except `step` (None = delete all).
+
+        Called at run start once the resume point is decided: stale steps
+        from a previous run with different data/config/iteration-count
+        must not shadow the new run's saves (the retention GC keeps the
+        *highest* steps, so leftovers above the new run's range would
+        immediately garbage-collect its fresh saves)."""
+        for s in self.all_steps():
+            if s != step:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)

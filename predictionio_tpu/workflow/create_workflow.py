@@ -48,25 +48,37 @@ def run_train(
     verbose: int = 0,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
+    profile_dir: Optional[str] = None,
+    metrics_file: Optional[str] = None,
+    debug_nans: bool = False,
 ):
     from predictionio_tpu.parallel.distributed import initialize_from_env
+    from predictionio_tpu.utils.profiling import (
+        MetricsLogger,
+        maybe_trace,
+        set_debug_flags,
+    )
 
     initialize_from_env()  # multi-host bootstrap when PIO_COORDINATOR_* set
+    set_debug_flags(nan_check=debug_nans)
     variant = read_engine_json(engine_json)
     engine = get_engine(variant.engine_factory)
     engine_params = extract_engine_params(engine, variant)
-    ctx = WorkflowContext(
-        mesh_shape=parse_mesh_spec(mesh), seed=seed, batch=batch, verbose=verbose,
-        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-    )
-    return CoreWorkflow.run_train(
-        engine,
-        engine_params,
-        variant,
-        ctx,
-        engine_version=engine_version,
-        sanity_check=not skip_sanity_check,
-    )
+    with MetricsLogger(metrics_file, run=batch or variant.id) as metrics:
+        ctx = WorkflowContext(
+            mesh_shape=parse_mesh_spec(mesh), seed=seed, batch=batch,
+            verbose=verbose, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, metrics=metrics,
+        )
+        with maybe_trace(profile_dir):
+            return CoreWorkflow.run_train(
+                engine,
+                engine_params,
+                variant,
+                ctx,
+                engine_version=engine_version,
+                sanity_check=not skip_sanity_check,
+            )
 
 
 def run_evaluation(

@@ -128,6 +128,53 @@ class TestALSCheckpointResume:
                         checkpoint_dir=str(tmp_path), checkpoint_every=0)
         assert np.isfinite(out.user_factors).all()
 
+    def test_stale_higher_steps_purged_on_data_change(self, tmp_path):
+        # a previous 6-iter run's leftovers must not shadow a new shorter
+        # run's saves (the retention GC keeps the HIGHEST steps)
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=11)
+        als_train(ui, ii, r, 30, 20,
+                  ALSConfig(rank=4, iterations=6, reg=0.05, seed=6),
+                  checkpoint_dir=str(tmp_path))
+        r2 = r.copy()
+        r2[0] += 1.0
+        als_train(ui, ii, r2, 30, 20,
+                  ALSConfig(rank=4, iterations=3, reg=0.05, seed=6),
+                  checkpoint_dir=str(tmp_path))
+        cm = CheckpointManager(str(tmp_path))
+        assert cm.all_steps() == [1, 2, 3]  # old 4..6 gone, new saves kept
+        # an interrupted re-run of the new config can actually resume
+        resumed = als_train(ui, ii, r2, 30, 20,
+                            ALSConfig(rank=4, iterations=3, reg=0.05, seed=6),
+                            checkpoint_dir=str(tmp_path))
+        assert resumed.epoch_times == []
+
+    def test_fewer_iterations_than_checkpoint_retrains_to_target(self, tmp_path):
+        # completed 6-iter checkpoint; asking for 3 must NOT return the
+        # over-trained 6-iter factors
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=12)
+        als_train(ui, ii, r, 30, 20,
+                  ALSConfig(rank=4, iterations=6, reg=0.05, seed=7),
+                  checkpoint_dir=str(tmp_path), checkpoint_every=2)
+        shorter = als_train(ui, ii, r, 30, 20,
+                            ALSConfig(rank=4, iterations=3, reg=0.05, seed=7),
+                            checkpoint_dir=str(tmp_path), checkpoint_every=2)
+        direct = als_train(ui, ii, r, 30, 20,
+                           ALSConfig(rank=4, iterations=3, reg=0.05, seed=7))
+        np.testing.assert_allclose(shorter.user_factors, direct.user_factors,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_resumed_metric_steps_continue_numbering(self, tmp_path):
+        # start_epoch lets callers label resumed epochs correctly
+        ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=13)
+        als_train(ui, ii, r, 30, 20,
+                  ALSConfig(rank=4, iterations=2, reg=0.05, seed=8),
+                  checkpoint_dir=str(tmp_path))
+        resumed = als_train(ui, ii, r, 30, 20,
+                            ALSConfig(rank=4, iterations=5, reg=0.05, seed=8),
+                            checkpoint_dir=str(tmp_path))
+        assert resumed.start_epoch == 2
+        assert len(resumed.epoch_times) == 3
+
     def test_mismatched_shapes_ignored(self, tmp_path):
         ui, ii, r, _ = synth_ratings(n_users=30, n_items=20, seed=6)
         als_train(ui, ii, r, 30, 20, ALSConfig(rank=4, iterations=1, seed=2),
