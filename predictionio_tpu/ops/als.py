@@ -340,9 +340,12 @@ def als_train(
         ).hexdigest()
         manager = CheckpointManager(checkpoint_dir)
         # resume from the largest saved step that (a) doesn't overshoot the
-        # requested iteration count and (b) fingerprints as this same run;
-        # then purge every other step so leftovers from a previous run
-        # can't shadow this run's saves (keep_only docstring).
+        # requested iteration count and (b) fingerprints as this same run.
+        # Other steps are stale; they're purged right before this run's
+        # FIRST save (not at start: deleting eagerly would open a window —
+        # from run start until the first new save — in which a crash
+        # leaves no checkpoint at all; stale steps left in place would
+        # shadow the new saves under the keep-highest retention GC).
         restore_step = None
         if resume:
             usable = [s for s in manager.all_steps() if s <= cfg.iterations]
@@ -365,9 +368,13 @@ def als_train(
                         "als_train: checkpoint at %s is from different data/"
                         "config (or a foreign tree) — training from scratch",
                         checkpoint_dir)
-        manager.keep_only(restore_step)
         if not compute_rmse:
             rmse_history = []
+        elif len(rmse_history) < start_iter:
+            # resumed from a run that didn't record RMSE: mark the missing
+            # prefix so indices stay aligned with absolute epoch numbers
+            rmse_history = ([float("nan")] * (start_iter - len(rmse_history))
+                            + rmse_history)
 
     # One dispatch for the whole run (or per checkpoint chunk): the
     # iteration loop is a lax.scan inside a single jitted program, so
@@ -376,6 +383,7 @@ def als_train(
     # scale). Epoch time = wall / iterations.
     t_start = time.perf_counter()
     done = start_iter
+    first_save_done = False
     while done < cfg.iterations:
         n_steps = (min(checkpoint_every, cfg.iterations - done)
                    if manager else cfg.iterations - done)
@@ -393,6 +401,9 @@ def als_train(
         if compute_rmse:
             rmse_history.extend(float(x) for x in np.asarray(rmses))
         if manager:
+            if not first_save_done:
+                manager.keep_only(restore_step)
+                first_save_done = True
             manager.save(
                 done,
                 {"user_factors": np.asarray(user_factors),

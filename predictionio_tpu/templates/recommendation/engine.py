@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 from typing import Any, Optional
 
 import numpy as np
@@ -203,7 +204,9 @@ class ALSAlgorithm(Algorithm):
             step = result.start_epoch + off + 1
             rec = {"epoch_time_s": t}
             if result.rmse_history and step <= len(result.rmse_history):
-                rec["rmse"] = result.rmse_history[step - 1]
+                rmse = result.rmse_history[step - 1]
+                if not math.isnan(rmse):  # NaN = epoch predates RMSE tracking
+                    rec["rmse"] = rmse
             ctx.metrics.emit("train/als", step=step, **rec)
         seen: dict[int, list] = {}
         for u, i in zip(pd.user_idx, pd.item_idx):

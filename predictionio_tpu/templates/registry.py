@@ -1,0 +1,178 @@
+"""Template registry + scaffolding.
+
+The reference distributes templates as separate git repos fetched by
+`pio template get <repo> <dir>` (0.9.x «tools/.../console/Template.scala»
+[U]), each carrying `engine.json`, `template.json`, and the DASE sources.
+Here the DASE code ships inside the package, so "getting" a template
+scaffolds a user directory with its `engine.json` (reference shape),
+`template.json` metadata, and a quickstart README — `pio build/train/
+deploy` then run against that directory unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import predictionio_tpu
+
+
+@dataclasses.dataclass(frozen=True)
+class TemplateInfo:
+    name: str
+    description: str
+    engine_factory: str
+    engine_json: dict  # default engine.json body (appName filled at get-time)
+    sample_query: dict
+
+
+BUILTIN_TEMPLATES: dict[str, TemplateInfo] = {
+    t.name: t
+    for t in [
+        TemplateInfo(
+            name="recommendation",
+            description="Personalized item recommendation via mesh-sharded ALS",
+            engine_factory=(
+                "predictionio_tpu.templates.recommendation.RecommendationEngine"),
+            engine_json={
+                "datasource": {"params": {
+                    "appName": "MyApp", "eventNames": ["rate", "buy"]}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 10, "numIterations": 10, "lambda": 0.01,
+                    "seed": 3}}],
+            },
+            sample_query={"user": "1", "num": 4},
+        ),
+        TemplateInfo(
+            name="similarproduct",
+            description="Items similar to those a user likes (item-item "
+                        "cosine from implicit ALS factors)",
+            engine_factory=(
+                "predictionio_tpu.templates.similarproduct.SimilarProductEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 10, "numIterations": 10, "lambda": 0.01,
+                    "seed": 3}}],
+            },
+            sample_query={"items": ["i1"], "num": 4},
+        ),
+        TemplateInfo(
+            name="classification",
+            description="Attribute classification (NaiveBayes / logistic "
+                        "regression on $set entity properties)",
+            engine_factory=(
+                "predictionio_tpu.templates.classification.ClassificationEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+            },
+            sample_query={"attr0": 2.0, "attr1": 0.0, "attr2": 0.0},
+        ),
+        TemplateInfo(
+            name="ecommerce",
+            description="E-commerce recommendation (ALS + serve-time business "
+                        "rules: seen/unavailable filters, category boosts)",
+            engine_factory=(
+                "predictionio_tpu.templates.ecommerce.ECommerceEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "ecomm", "params": {
+                    "appName": "MyApp", "rank": 10, "numIterations": 20,
+                    "lambda": 0.01, "seed": 3, "unseenOnly": True,
+                    "seenEvents": ["buy", "view"],
+                    "similarEvents": ["view"]}}],
+            },
+            sample_query={"user": "u1", "num": 4},
+        ),
+        TemplateInfo(
+            name="textclassification",
+            description="Text classification (tf-idf + NaiveBayes/LogReg, "
+                        "Word2Vec variant)",
+            engine_factory=("predictionio_tpu.templates.textclassification."
+                            "TextClassificationEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "nb", "params": {"lambda": 0.25}}],
+            },
+            sample_query={"text": "a great product"},
+        ),
+    ]
+}
+
+
+def get_template(name: str) -> TemplateInfo:
+    try:
+        return BUILTIN_TEMPLATES[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown template {name!r}; available: "
+            f"{', '.join(sorted(BUILTIN_TEMPLATES))}") from None
+
+
+def scaffold(name: str, directory: str, app_name: Optional[str] = None,
+             engine_id: Optional[str] = None) -> str:
+    """Write engine.json + template.json + README.md into `directory`.
+
+    Returns the directory. Refuses to overwrite any of those three files
+    if already present (mirrors `pio template get` refusing a non-empty
+    target).
+    """
+    info = get_template(name)
+    directory = os.path.abspath(directory)
+    clobber = [f for f in ("engine.json", "template.json", "README.md")
+               if os.path.exists(os.path.join(directory, f))]
+    if clobber:
+        raise FileExistsError(
+            f"{directory} already contains {', '.join(clobber)}; refusing "
+            "to overwrite")
+    os.makedirs(directory, exist_ok=True)
+    engine_path = os.path.join(directory, "engine.json")
+
+    engine = {
+        "id": engine_id or name,
+        "description": info.description,
+        "engineFactory": info.engine_factory,
+    }
+    body = json.loads(json.dumps(info.engine_json))  # deep copy
+    if app_name:
+        def fill(node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "appName":
+                        node[k] = app_name
+                    else:
+                        fill(v)
+            elif isinstance(node, list):
+                for v in node:
+                    fill(v)
+
+        fill(body)  # every appName (datasource + serve-time algo params)
+    engine.update(body)
+    with open(engine_path, "w") as f:
+        json.dump(engine, f, indent=2)
+        f.write("\n")
+
+    # reference template.json shape: minimum pio version compat metadata
+    with open(os.path.join(directory, "template.json"), "w") as f:
+        json.dump({"pio": {"version": {"min": predictionio_tpu.__version__}},
+                   "name": info.name, "description": info.description}, f,
+                  indent=2)
+        f.write("\n")
+
+    with open(os.path.join(directory, "README.md"), "w") as f:
+        f.write(
+            f"# {info.name} engine\n\n{info.description}\n\n"
+            "## Quickstart\n\n"
+            "```sh\n"
+            f"pio-tpu app new {app_name or 'MyApp'}\n"
+            "pio-tpu eventserver &   # ingest events on :7070\n"
+            "pio-tpu build\n"
+            "pio-tpu train\n"
+            "pio-tpu deploy &        # queries on :8000\n"
+            "curl -s -X POST localhost:8000/queries.json "
+            f"-d '{json.dumps(info.sample_query)}'\n"
+            "```\n")
+    return directory

@@ -303,6 +303,85 @@ def cmd_dashboard(args) -> int:
                         "Dashboard", args.ip, args.port)
 
 
+def cmd_template(args) -> int:
+    """`pio template {list,get}` (0.9.x «console/Template.scala» [U]).
+    Templates are built-in packages; `get` scaffolds a user dir."""
+    from predictionio_tpu.templates.registry import (
+        BUILTIN_TEMPLATES,
+        scaffold,
+    )
+
+    if args.template_command == "list":
+        for name, info in sorted(BUILTIN_TEMPLATES.items()):
+            print(f"  {name:20s} {info.description}")
+        return 0
+    if args.template_command == "get":
+        try:
+            directory = scaffold(args.name, args.directory,
+                                 app_name=args.app_name)
+        except (KeyError, FileExistsError) as e:
+            print(e.args[0] if e.args else str(e), file=sys.stderr)
+            return 1
+        print(f"Engine template {args.name!r} created at {directory}")
+        print("Edit engine.json, then: pio-tpu build && pio-tpu train "
+              "&& pio-tpu deploy")
+        return 0
+    return 1
+
+
+def cmd_new(args) -> int:
+    """`pio new <dir>`: scaffold a template (shorthand for template get)."""
+    args.template_command = "get"
+    args.name = args.template
+    return cmd_template(args)
+
+
+def cmd_run(args) -> int:
+    """`pio run <module[:callable]>` («tools/Runner.scala :: runOnSpark»
+    [U]): run a user entry point in-process (the rebuild has no
+    spark-submit; in-process IS the deployment model). The multi-host
+    bootstrap runs first, as it does for `train`."""
+    import importlib
+
+    from predictionio_tpu.parallel.distributed import initialize_from_env
+
+    initialize_from_env()  # no-op unless PIO_COORDINATOR_* env is set
+    target = args.target
+    module_name, _, attr = target.partition(":")
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as e:
+        print(f"Cannot import {module_name!r}: {e}", file=sys.stderr)
+        return 1
+    if attr:
+        fn = getattr(module, attr, None)
+        if fn is None:
+            print(f"{module_name} has no attribute {attr!r}", file=sys.stderr)
+            return 1
+        result = fn(*args.args)
+    elif hasattr(module, "main"):
+        result = module.main(args.args)
+    else:
+        print(f"{module_name} has no main(); use {module_name}:<callable>",
+              file=sys.stderr)
+        return 1
+    return result if isinstance(result, int) else 0
+
+
+def cmd_upgrade(args) -> int:
+    """`pio upgrade` [U]. Upstream migrated storage between versions; the
+    rebuild's storage schema is version-stable so far, so this verifies
+    connectivity and reports the version."""
+    import predictionio_tpu
+    from predictionio_tpu.storage import Storage
+
+    results = Storage.get().verify_all_data_objects()
+    ok = all(results.values())
+    print(f"predictionio-tpu {predictionio_tpu.__version__}: storage "
+          + ("is up to date." if ok else "has FAILURES — run `pio-tpu status`."))
+    return 0 if ok else 1
+
+
 def cmd_adminserver(args) -> int:
     from predictionio_tpu.tools.admin import AdminServer
 
@@ -424,6 +503,28 @@ def build_parser() -> argparse.ArgumentParser:
     adm.add_argument("--ip", default="0.0.0.0")
     adm.add_argument("--port", type=int, default=7071)
     adm.set_defaults(func=cmd_adminserver)
+
+    tpl = sub.add_parser("template")
+    tpl_sub = tpl.add_subparsers(dest="template_command", required=True)
+    tpl_sub.add_parser("list")
+    tpl_get = tpl_sub.add_parser("get")
+    tpl_get.add_argument("name")
+    tpl_get.add_argument("directory")
+    tpl_get.add_argument("--app-name", default=None)
+    tpl.set_defaults(func=cmd_template)
+
+    new = sub.add_parser("new")
+    new.add_argument("directory")
+    new.add_argument("--template", default="recommendation")
+    new.add_argument("--app-name", default=None)
+    new.set_defaults(func=cmd_new)
+
+    run = sub.add_parser("run")
+    run.add_argument("target", help="module or module:callable to execute")
+    run.add_argument("args", nargs="*")
+    run.set_defaults(func=cmd_run)
+
+    sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)
 
     return p
 

@@ -1,0 +1,84 @@
+"""Template registry + scaffolding + new CLI verbs (`template`, `new`,
+`run`, `upgrade`) — SURVEY.md §2.3 console parity."""
+
+import json
+import os
+
+import pytest
+
+from predictionio_tpu.templates.registry import (
+    BUILTIN_TEMPLATES,
+    get_template,
+    scaffold,
+)
+from predictionio_tpu.tools.console import main as console_main
+from predictionio_tpu.workflow.workflow_utils import (
+    extract_engine_params,
+    get_engine,
+    read_engine_json,
+)
+
+
+class TestRegistry:
+    def test_all_five_reference_templates_present(self):
+        assert set(BUILTIN_TEMPLATES) == {
+            "recommendation", "similarproduct", "classification",
+            "ecommerce", "textclassification",
+        }
+
+    def test_unknown_template_raises(self):
+        with pytest.raises(KeyError):
+            get_template("nope")
+
+    @pytest.mark.parametrize("name", sorted(BUILTIN_TEMPLATES))
+    def test_scaffold_builds_cleanly(self, name, tmp_path):
+        """Every scaffolded engine.json must resolve its factory and
+        extract params — i.e. `pio build` passes out of the box."""
+        d = scaffold(name, str(tmp_path / name), app_name="ScaffApp")
+        variant = read_engine_json(os.path.join(d, "engine.json"))
+        engine = get_engine(variant.engine_factory)
+        extract_engine_params(engine, variant)  # raises on mismatch
+        meta = json.load(open(os.path.join(d, "template.json")))
+        assert meta["name"] == name and "pio" in meta
+        assert os.path.exists(os.path.join(d, "README.md"))
+
+    def test_scaffold_fills_app_name_everywhere(self, tmp_path):
+        d = scaffold("ecommerce", str(tmp_path / "e"), app_name="Shop")
+        engine = json.load(open(os.path.join(d, "engine.json")))
+        assert engine["datasource"]["params"]["appName"] == "Shop"
+        assert engine["algorithms"][0]["params"]["appName"] == "Shop"
+
+    def test_scaffold_refuses_overwrite(self, tmp_path):
+        scaffold("recommendation", str(tmp_path))
+        with pytest.raises(FileExistsError):
+            scaffold("classification", str(tmp_path))
+
+
+class TestConsoleVerbs:
+    def test_template_list(self, capsys):
+        assert console_main(["template", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "recommendation" in out and "textclassification" in out
+
+    def test_template_get_and_new(self, tmp_path, capsys):
+        assert console_main(["template", "get", "classification",
+                             str(tmp_path / "c"), "--app-name", "A"]) == 0
+        assert os.path.exists(tmp_path / "c" / "engine.json")
+        assert console_main(["new", str(tmp_path / "n"),
+                             "--template", "similarproduct"]) == 0
+        engine = json.load(open(tmp_path / "n" / "engine.json"))
+        assert "similarproduct" in engine["engineFactory"]
+
+    def test_template_get_unknown_fails(self, tmp_path, capsys):
+        assert console_main(["template", "get", "nope", str(tmp_path)]) == 1
+        assert "Unknown template" in capsys.readouterr().err
+
+    def test_run_callable(self, capsys):
+        assert console_main(["run", "json:dumps", "hi"]) == 0
+
+    def test_run_bad_module_fails(self, capsys):
+        assert console_main(["run", "no_such_module_xyz"]) == 1
+
+    def test_upgrade(self, memory_storage, capsys):
+        assert console_main(["upgrade"]) == 0
+        assert "up to date" in capsys.readouterr().out
