@@ -412,6 +412,10 @@ def als_train(
                           "iterations": cfg.iterations, "rank": cfg.rank,
                           "fingerprint": fingerprint},
             )
+    if manager and not first_save_done:
+        # fully-resumed run (no new saves): still purge stale steps now —
+        # the restore point is on disk, so there's no crash window here
+        manager.keep_only(restore_step)
     wall = time.perf_counter() - t_start
     executed = cfg.iterations - start_iter
     epoch_times = [wall / executed] * executed if executed > 0 else []

@@ -1,0 +1,213 @@
+"""Python client SDK — the rebuild's L7 (SURVEY.md §1).
+
+The reference keeps REST client SDKs in separate repos
+(`PredictionIO/PredictionIO-Python-SDK` et al. — SURVEY.md §1 'L7 Client
+SDKs' [U]); the rebuild ships one in-tree. API surface follows that SDK:
+
+    from predictionio_tpu.sdk import EventClient, EngineClient
+    ec = EventClient(access_key=K, url="http://localhost:7070")
+    ec.create_event(event="rate", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i1",
+                    properties={"rating": 5})
+    ec.set_user("u2", properties={"plan": "pro"})
+    eng = EngineClient(url="http://localhost:8000")
+    eng.send_query({"user": "u1", "num": 4})
+
+Stdlib urllib only (SDKs must not drag server deps); raises
+`NotFoundError` on 404 and `PredictionIOError` (with status + server
+message) on any other non-2xx.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from datetime import datetime, timezone
+from typing import Any, Optional, Sequence, Union
+
+
+class PredictionIOError(Exception):
+    """Non-2xx server response; `.status` and `.message` carry details."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFoundError(PredictionIOError):
+    def __init__(self, message: str = "Not Found"):
+        super().__init__(404, message)
+
+
+def _format_time(t: Union[None, str, datetime]) -> Optional[str]:
+    if t is None or isinstance(t, str):
+        return t
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return t.isoformat()
+
+
+class _BaseClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str,
+                 query: Optional[dict] = None,
+                 body: Optional[Any] = None) -> Any:
+        q = {k: v for k, v in (query or {}).items() if v is not None}
+        url = self.url + path
+        if q:
+            url += "?" + urllib.parse.urlencode(q)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("message", detail)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            if e.code == 404:
+                raise NotFoundError(detail) from None
+            raise PredictionIOError(e.code, detail) from None
+        return json.loads(payload) if payload else None
+
+
+class EventClient(_BaseClient):
+    """Client for the event server (:7070)."""
+
+    def __init__(self, access_key: str, url: str = "http://localhost:7070",
+                 channel: Optional[str] = None, timeout: float = 10.0):
+        super().__init__(url, timeout)
+        self.access_key = access_key
+        self.channel = channel
+
+    def _auth(self, extra: Optional[dict] = None) -> dict:
+        q = {"accessKey": self.access_key, "channel": self.channel}
+        q.update(extra or {})
+        return q
+
+    # -- core event API ----------------------------------------------------
+
+    def create_event(self, event: str, entity_type: str, entity_id: str,
+                     target_entity_type: Optional[str] = None,
+                     target_entity_id: Optional[str] = None,
+                     properties: Optional[dict] = None,
+                     event_time: Union[None, str, datetime] = None) -> str:
+        """POST /events.json → eventId."""
+        body: dict[str, Any] = {
+            "event": event,
+            "entityType": entity_type,
+            "entityId": entity_id,
+        }
+        if target_entity_type:
+            body["targetEntityType"] = target_entity_type
+        if target_entity_id:
+            body["targetEntityId"] = target_entity_id
+        if properties:
+            body["properties"] = properties
+        if event_time:
+            body["eventTime"] = _format_time(event_time)
+        out = self._request("POST", "/events.json", self._auth(), body)
+        return out["eventId"]
+
+    def create_batch_events(self, events: Sequence[dict]) -> list[dict]:
+        """POST /batch/events.json (≤50 events) → per-event results."""
+        return self._request("POST", "/batch/events.json", self._auth(),
+                             list(events))
+
+    def get_event(self, event_id: str) -> dict:
+        return self._request(
+            "GET", f"/events/{urllib.parse.quote(event_id)}.json", self._auth())
+
+    def delete_event(self, event_id: str) -> None:
+        self._request(
+            "DELETE", f"/events/{urllib.parse.quote(event_id)}.json",
+            self._auth())
+
+    def find_events(self, start_time=None, until_time=None,
+                    entity_type: Optional[str] = None,
+                    entity_id: Optional[str] = None,
+                    event: Optional[str] = None,
+                    target_entity_type: Optional[str] = None,
+                    target_entity_id: Optional[str] = None,
+                    limit: Optional[int] = None,
+                    reversed: bool = False) -> list[dict]:
+        """GET /events.json with the reference's filter params."""
+        return self._request("GET", "/events.json", self._auth({
+            "startTime": _format_time(start_time),
+            "untilTime": _format_time(until_time),
+            "entityType": entity_type,
+            "entityId": entity_id,
+            "event": event,
+            "targetEntityType": target_entity_type,
+            "targetEntityId": target_entity_id,
+            "limit": limit,
+            "reversed": "true" if reversed else None,
+        }))
+
+    def get_status(self) -> dict:
+        return self._request("GET", "/")
+
+    def get_stats(self) -> dict:
+        """GET /stats.json (server must run with --stats)."""
+        return self._request("GET", "/stats.json", self._auth())
+
+    # -- entity-property conveniences (official SDK surface) ---------------
+
+    def set_user(self, uid: str, properties: Optional[dict] = None,
+                 event_time=None) -> str:
+        return self.create_event("$set", "user", uid,
+                                 properties=properties or {},
+                                 event_time=event_time)
+
+    def unset_user(self, uid: str, properties: dict, event_time=None) -> str:
+        return self.create_event("$unset", "user", uid,
+                                 properties=properties, event_time=event_time)
+
+    def delete_user(self, uid: str, event_time=None) -> str:
+        return self.create_event("$delete", "user", uid,
+                                 event_time=event_time)
+
+    def set_item(self, iid: str, properties: Optional[dict] = None,
+                 event_time=None) -> str:
+        return self.create_event("$set", "item", iid,
+                                 properties=properties or {},
+                                 event_time=event_time)
+
+    def unset_item(self, iid: str, properties: dict, event_time=None) -> str:
+        return self.create_event("$unset", "item", iid,
+                                 properties=properties, event_time=event_time)
+
+    def delete_item(self, iid: str, event_time=None) -> str:
+        return self.create_event("$delete", "item", iid,
+                                 event_time=event_time)
+
+    def record_user_action_on_item(self, action: str, uid: str, iid: str,
+                                   properties: Optional[dict] = None,
+                                   event_time=None) -> str:
+        return self.create_event(action, "user", uid,
+                                 target_entity_type="item",
+                                 target_entity_id=iid,
+                                 properties=properties,
+                                 event_time=event_time)
+
+
+class EngineClient(_BaseClient):
+    """Client for a deployed engine's prediction server (:8000)."""
+
+    def __init__(self, url: str = "http://localhost:8000",
+                 timeout: float = 10.0):
+        super().__init__(url, timeout)
+
+    def send_query(self, data: dict) -> dict:
+        """POST /queries.json → PredictedResult."""
+        return self._request("POST", "/queries.json", body=data)

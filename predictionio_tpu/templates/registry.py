@@ -116,9 +116,9 @@ def scaffold(name: str, directory: str, app_name: Optional[str] = None,
              engine_id: Optional[str] = None) -> str:
     """Write engine.json + template.json + README.md into `directory`.
 
-    Returns the directory. Refuses to overwrite any of those three files
-    if already present (mirrors `pio template get` refusing a non-empty
-    target).
+    Returns the directory. Refuses if any of those three files already
+    exists there (other directory contents are left alone and don't
+    block scaffolding).
     """
     info = get_template(name)
     directory = os.path.abspath(directory)

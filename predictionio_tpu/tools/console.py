@@ -521,7 +521,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     run = sub.add_parser("run")
     run.add_argument("target", help="module or module:callable to execute")
-    run.add_argument("args", nargs="*")
+    run.add_argument("args", nargs=argparse.REMAINDER,
+                     help="arguments forwarded verbatim to the target")
     run.set_defaults(func=cmd_run)
 
     sub.add_parser("upgrade").set_defaults(func=cmd_upgrade)

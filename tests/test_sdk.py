@@ -1,0 +1,112 @@
+"""Python SDK (L7) against in-process event + prediction servers —
+mirrors how the reference's separate-repo Python SDK drives the REST
+contract (SURVEY.md §1 L7, §4.2 quickstart_test flow)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.sdk import (
+    EngineClient,
+    EventClient,
+    NotFoundError,
+    PredictionIOError,
+)
+from predictionio_tpu.storage.base import AccessKey, App, Channel
+
+
+@pytest.fixture()
+def event_client(memory_storage):
+    app_id = memory_storage.meta_apps().insert(App(id=0, name="SdkApp"))
+    key = AccessKey.generate(app_id)
+    memory_storage.meta_access_keys().insert(key)
+    memory_storage.meta_channels().insert(
+        Channel(id=0, name="ch1", app_id=app_id))
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+                      memory_storage)
+    srv.start()
+    yield EventClient(access_key=key.key,
+                      url=f"http://127.0.0.1:{srv.port}")
+    srv.shutdown()
+
+
+class TestEventClient:
+    def test_create_get_delete_roundtrip(self, event_client):
+        eid = event_client.create_event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties={"rating": 5})
+        got = event_client.get_event(eid)
+        assert got["event"] == "rate" and got["entityId"] == "u1"
+        event_client.delete_event(eid)
+        with pytest.raises(NotFoundError):
+            event_client.get_event(eid)
+
+    def test_find_events_filters(self, event_client):
+        for i in range(3):
+            event_client.record_user_action_on_item("view", "u1", f"i{i}")
+        event_client.record_user_action_on_item("buy", "u1", "i0")
+        views = event_client.find_events(event="view")
+        assert len(views) == 3
+        assert all(e["event"] == "view" for e in views)
+        one = event_client.find_events(limit=1)
+        assert len(one) == 1
+
+    def test_batch(self, event_client):
+        results = event_client.create_batch_events([
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "properties": {"rating": 3}},
+            {"event": "rate", "entityType": "user", "entityId": "u2",
+             "targetEntityType": "item", "targetEntityId": "i2",
+             "properties": {"rating": 4}},
+        ])
+        assert len(results) == 2
+        assert all(r["status"] == 201 for r in results)
+
+    def test_entity_property_conveniences(self, event_client):
+        event_client.set_user("u9", properties={"plan": "pro"})
+        event_client.unset_user("u9", properties={"plan": None})
+        event_client.delete_user("u9")
+        event_client.set_item("i9", properties={"categories": ["a"]})
+        event_client.delete_item("i9")
+        events = event_client.find_events(entity_id="u9")
+        assert {e["event"] for e in events} == {"$set", "$unset", "$delete"}
+
+    def test_bad_key_raises(self, event_client):
+        bad = EventClient(access_key="nope", url=event_client.url)
+        with pytest.raises(PredictionIOError) as ei:
+            bad.create_event(event="x", entity_type="user", entity_id="u")
+        assert ei.value.status == 401
+
+    def test_status_and_stats(self, event_client):
+        assert event_client.get_status()["status"] == "alive"
+        event_client.set_user("u1")
+        stats = event_client.get_stats()
+        assert stats  # per-app counts present
+
+
+class TestEngineClient:
+    def test_send_query_against_deployed_engine(self, memory_storage):
+        # train a tiny recommendation model through the real workflow,
+        # deploy in-process, query via the SDK (quickstart_test.py shape)
+        from predictionio_tpu.workflow.create_server import (
+            PredictionServer,
+            ServerConfig,
+        )
+        from tests.test_prediction_server import train_once
+        from tests.test_recommendation_template import ingest_ratings
+
+        ingest_ratings(memory_storage)
+        train_once(memory_storage)
+        server = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                         engine_variant="rec-test"),
+            memory_storage)
+        server.start()
+        try:
+            client = EngineClient(url=f"http://127.0.0.1:{server.port}")
+            result = client.send_query({"user": "u1", "num": 3})
+            assert "itemScores" in result
+        finally:
+            server.shutdown()
