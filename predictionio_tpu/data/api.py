@@ -28,7 +28,7 @@ import time
 from collections import Counter
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from predictionio_tpu.utils.http import HttpService
 
@@ -39,6 +39,7 @@ from predictionio_tpu.data.events import (
     validate_event,
 )
 from predictionio_tpu.data.webhooks import get_connector
+from predictionio_tpu.plugins import PluginRejection
 from predictionio_tpu.storage.registry import Storage
 
 BATCH_LIMIT = 50  # reference rejects >50 events per batch POST [U]
@@ -82,6 +83,7 @@ class _EventHandler(BaseHTTPRequestHandler):
     # injected by create_event_server
     storage: Storage
     stats: Optional[Stats]
+    plugins = None  # Optional[PluginRegistry]
 
     def log_message(self, fmt, *args):  # silence default stderr chatter
         pass
@@ -139,6 +141,10 @@ class _EventHandler(BaseHTTPRequestHandler):
             raise EventValidationError(
                 f"event {event.event!r} is not allowed by this access key"
             )
+        if self.plugins is not None:
+            # blockers raise PluginRejection (403 at the route); sniffer
+            # failures are swallowed inside the registry
+            self.plugins.on_event(d, app_id, channel_id)
         try:
             eid = self.storage.l_events().insert(event, app_id, channel_id)
         except sqlite3.IntegrityError as e:
@@ -180,7 +186,7 @@ class _EventHandler(BaseHTTPRequestHandler):
             return self._send_json(200, [e.to_dict() for e in events])
 
         if path.startswith("/events/") and path.endswith(".json"):
-            eid = path[len("/events/") : -len(".json")]
+            eid = unquote(path[len("/events/") : -len(".json")])
             event = self.storage.l_events().get(eid, app_id, channel_id)
             if event is None:
                 return self._send_json(404, {"message": "Not Found"})
@@ -210,6 +216,10 @@ class _EventHandler(BaseHTTPRequestHandler):
             try:
                 d = json.loads(body or b"{}")
                 eid = self._insert_event(d, access_key, app_id, channel_id)
+            except PluginRejection as e:
+                if self.stats:
+                    self.stats.update(app_id, "<blocked>", 403)
+                return self._send_json(403, {"message": str(e)})
             except (EventValidationError, json.JSONDecodeError, ValueError) as e:
                 if self.stats:
                     self.stats.update(app_id, "<invalid>", 400)
@@ -234,6 +244,10 @@ class _EventHandler(BaseHTTPRequestHandler):
                 try:
                     eid = self._insert_event(d, access_key, app_id, channel_id)
                     results.append({"status": 201, "eventId": eid})
+                except PluginRejection as e:
+                    if self.stats:
+                        self.stats.update(app_id, "<blocked>", 403)
+                    results.append({"status": 403, "message": str(e)})
                 except (EventValidationError, ValueError) as e:
                     results.append({"status": 400, "message": str(e)})
             return self._send_json(200, results)
@@ -255,6 +269,10 @@ class _EventHandler(BaseHTTPRequestHandler):
                     raise ValueError("webhook payload must be a JSON object")
                 event_dict = connector.to_event_dict(payload)
                 eid = self._insert_event(event_dict, access_key, app_id, channel_id)
+            except PluginRejection as e:
+                if self.stats:
+                    self.stats.update(app_id, "<blocked>", 403)
+                return self._send_json(403, {"message": str(e)})
             except (EventValidationError, json.JSONDecodeError, ValueError, KeyError) as e:
                 return self._send_json(400, {"message": str(e)})
             return self._send_json(201, {"eventId": eid})
@@ -270,7 +288,7 @@ class _EventHandler(BaseHTTPRequestHandler):
             return self._send_json(401, {"message": "Invalid accessKey."})
         _, app_id, channel_id = auth
         if path.startswith("/events/") and path.endswith(".json"):
-            eid = path[len("/events/") : -len(".json")]
+            eid = unquote(path[len("/events/") : -len(".json")])
             ok = self.storage.l_events().delete(eid, app_id, channel_id)
             if ok:
                 return self._send_json(200, {"message": "Found"})
@@ -282,15 +300,20 @@ class EventServer(HttpService):
     """Owns the HTTP server thread; `create_event_server` is the reference's
     factory spelling."""
 
-    def __init__(self, config: EventServerConfig, storage: Optional[Storage] = None):
+    def __init__(self, config: EventServerConfig, storage: Optional[Storage] = None,
+                 plugins=None):
+        from predictionio_tpu.plugins import load_plugins_from_env
+
         self.config = config
         self.storage = storage or Storage.get()
         self.stats = Stats() if config.stats else None
+        self.plugins = plugins if plugins is not None else load_plugins_from_env()
 
         handler = type(
             "BoundEventHandler",
             (_EventHandler,),
-            {"storage": self.storage, "stats": self.stats},
+            {"storage": self.storage, "stats": self.stats,
+             "plugins": self.plugins},
         )
         super().__init__(config.ip, config.port, handler)
 

@@ -412,9 +412,11 @@ def als_train(
                           "iterations": cfg.iterations, "rank": cfg.rank,
                           "fingerprint": fingerprint},
             )
-    if manager and not first_save_done:
+    if manager and not first_save_done and restore_step is not None:
         # fully-resumed run (no new saves): still purge stale steps now —
-        # the restore point is on disk, so there's no crash window here
+        # the restore point is on disk, so there's no crash window here.
+        # (restore_step=None with no saves means a degenerate run, e.g.
+        # iterations=0 — leave the directory untouched.)
         manager.keep_only(restore_step)
     wall = time.perf_counter() - t_start
     executed = cfg.iterations - start_iter

@@ -1,0 +1,141 @@
+"""Plugin SPI for the event server and the prediction server.
+
+Parity with the reference's service-provider hooks («data/.../api/
+EventServerPlugin.scala» and the engine-server plugin SPI, SURVEY.md §5
+'Metrics / logging' [U]): custom sinks/gates discovered at server start
+and invoked on the hot paths.
+
+Two plugin families, each with the reference's two roles:
+
+- `EventServerPlugin` — called on every accepted ingest.
+  * INPUT_BLOCKER: may veto an event by raising `PluginRejection`
+    (client sees 403 with the plugin's message).
+  * INPUT_SNIFFER: observes; exceptions are logged, never surfaced.
+- `EngineServerPlugin` — called on every query.
+  * OUTPUT_BLOCKER: may transform the prediction (returns the result to
+    serve) or veto with `PluginRejection`.
+  * OUTPUT_SNIFFER: observes (query, prediction); failures logged.
+
+Discovery: explicit `register(...)` in code, or the `PIO_PLUGINS` env
+var — a comma-separated list of `module:ClassName` loaded by
+`load_plugins_from_env()` at server construction (the rebuild's stand-in
+for the reference's classpath scan).
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+EVENT_SERVER_PLUGINS_ENV = "PIO_PLUGINS"
+
+
+class PluginRejection(Exception):
+    """Raised by a blocker plugin to veto an event or a prediction."""
+
+
+class EventServerPlugin(abc.ABC):
+    INPUT_BLOCKER = "inputblocker"
+    INPUT_SNIFFER = "inputsniffer"
+
+    plugin_name: str = ""
+    plugin_type: str = INPUT_SNIFFER
+
+    @abc.abstractmethod
+    def process(self, event: dict, app_id: int,
+                channel_id: Optional[int]) -> None:
+        """Inspect one incoming event (wire-format dict). Blockers raise
+        `PluginRejection` to refuse it."""
+
+
+class EngineServerPlugin(abc.ABC):
+    OUTPUT_BLOCKER = "outputblocker"
+    OUTPUT_SNIFFER = "outputsniffer"
+
+    plugin_name: str = ""
+    plugin_type: str = OUTPUT_SNIFFER
+
+    @abc.abstractmethod
+    def process(self, query: dict, prediction: Any,
+                instance_id: str) -> Any:
+        """Inspect one (query, prediction). Blockers return the (possibly
+        transformed) prediction to serve, or raise `PluginRejection`;
+        sniffer return values are ignored."""
+
+
+class PluginRegistry:
+    """Holds the plugins wired into one server instance."""
+
+    def __init__(self):
+        self.event_plugins: list[EventServerPlugin] = []
+        self.engine_plugins: list[EngineServerPlugin] = []
+
+    def register(self, plugin) -> None:
+        if isinstance(plugin, EventServerPlugin):
+            self.event_plugins.append(plugin)
+        elif isinstance(plugin, EngineServerPlugin):
+            self.engine_plugins.append(plugin)
+        else:
+            raise TypeError(
+                f"{type(plugin).__name__} is neither an EventServerPlugin "
+                "nor an EngineServerPlugin")
+        log.info("plugins: registered %s (%s)",
+                 plugin.plugin_name or type(plugin).__name__,
+                 plugin.plugin_type)
+
+    # -- hot-path hooks ----------------------------------------------------
+
+    def on_event(self, event: dict, app_id: int,
+                 channel_id: Optional[int]) -> None:
+        """Run event plugins. Propagates `PluginRejection` from blockers;
+        swallows (logs) everything else."""
+        for p in self.event_plugins:
+            try:
+                p.process(event, app_id, channel_id)
+            except PluginRejection:
+                if p.plugin_type == EventServerPlugin.INPUT_BLOCKER:
+                    raise
+                log.warning("plugins: sniffer %s raised PluginRejection "
+                            "(ignored; not a blocker)",
+                            type(p).__name__)
+            except Exception:
+                log.exception("plugins: %s failed on event", type(p).__name__)
+
+    def on_prediction(self, query: dict, prediction: Any,
+                      instance_id: str) -> Any:
+        """Run engine plugins; blockers may replace the prediction."""
+        for p in self.engine_plugins:
+            try:
+                out = p.process(query, prediction, instance_id)
+                if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
+                    prediction = out
+            except PluginRejection:
+                if p.plugin_type == EngineServerPlugin.OUTPUT_BLOCKER:
+                    raise
+                log.warning("plugins: sniffer %s raised PluginRejection "
+                            "(ignored; not a blocker)", type(p).__name__)
+            except Exception:
+                log.exception("plugins: %s failed on prediction",
+                              type(p).__name__)
+        return prediction
+
+
+def load_plugins_from_env(registry: Optional[PluginRegistry] = None,
+                          env: Optional[str] = None) -> PluginRegistry:
+    """Instantiate plugins named in `PIO_PLUGINS` (module:Class,...)."""
+    registry = registry or PluginRegistry()
+    spec = env if env is not None else os.environ.get(
+        EVENT_SERVER_PLUGINS_ENV, "")
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        module_name, _, cls_name = item.partition(":")
+        try:
+            cls = getattr(importlib.import_module(module_name), cls_name)
+            registry.register(cls())
+        except Exception:
+            log.exception("plugins: cannot load %r", item)
+    return registry

@@ -24,6 +24,7 @@ import threading
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
 
+from predictionio_tpu.plugins import PluginRejection
 from predictionio_tpu.utils.http import HttpService
 
 from predictionio_tpu.storage.base import EngineInstance
@@ -106,9 +107,14 @@ def load_served_state(
 
 
 class PredictionServer(HttpService):
-    def __init__(self, config: ServerConfig, storage: Optional[Storage] = None):
+    def __init__(self, config: ServerConfig, storage: Optional[Storage] = None,
+                 plugins=None):
+        from predictionio_tpu.plugins import load_plugins_from_env
+
         self.config = config
         self.storage = storage or Storage.get()
+        self.plugins = (plugins if plugins is not None
+                        else load_plugins_from_env())
         self._state = load_served_state(self.storage, config)
         self._state_lock = threading.Lock()
         server = self
@@ -153,6 +159,10 @@ class PredictionServer(HttpService):
                             state.engine_params, state.models, query,
                             components=state.components,
                         )
+                        result = server.plugins.on_prediction(
+                            query, result, state.instance.id)
+                    except PluginRejection as e:
+                        return self._send(403, {"message": str(e)})
                     except Exception as e:
                         log.warning("Query failed: %s", e)
                         return self._send(400, {"message": str(e)})

@@ -1,0 +1,151 @@
+"""Plugin SPI: event-server input blockers/sniffers, engine-server output
+blockers/sniffers, env discovery (SURVEY.md §5 plugin hooks)."""
+
+import pytest
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.plugins import (
+    EngineServerPlugin,
+    EventServerPlugin,
+    PluginRegistry,
+    PluginRejection,
+    load_plugins_from_env,
+)
+from predictionio_tpu.sdk import EventClient, PredictionIOError
+from predictionio_tpu.storage.base import AccessKey, App
+
+
+class RejectBots(EventServerPlugin):
+    plugin_name = "reject-bots"
+    plugin_type = EventServerPlugin.INPUT_BLOCKER
+
+    def process(self, event, app_id, channel_id):
+        if event.get("entityId", "").startswith("bot-"):
+            raise PluginRejection("bots are not welcome")
+
+
+class CountingSniffer(EventServerPlugin):
+    plugin_type = EventServerPlugin.INPUT_SNIFFER
+
+    def __init__(self):
+        self.seen = []
+
+    def process(self, event, app_id, channel_id):
+        self.seen.append(event["event"])
+
+
+class CrashySniffer(EventServerPlugin):
+    plugin_type = EventServerPlugin.INPUT_SNIFFER
+
+    def process(self, event, app_id, channel_id):
+        raise RuntimeError("boom")
+
+
+class CapResults(EngineServerPlugin):
+    plugin_type = EngineServerPlugin.OUTPUT_BLOCKER
+
+    def process(self, query, prediction, instance_id):
+        scores = prediction.get("itemScores", [])
+        return {"itemScores": scores[:1]}
+
+
+class PredictionSniffer(EngineServerPlugin):
+    plugin_type = EngineServerPlugin.OUTPUT_SNIFFER
+
+    def __init__(self):
+        self.count = 0
+
+    def process(self, query, prediction, instance_id):
+        self.count += 1
+        return "ignored-return"
+
+
+@pytest.fixture()
+def served(memory_storage):
+    app_id = memory_storage.meta_apps().insert(App(id=0, name="PlugApp"))
+    key = AccessKey.generate(app_id)
+    memory_storage.meta_access_keys().insert(key)
+    registry = PluginRegistry()
+    sniffer = CountingSniffer()
+    registry.register(RejectBots())
+    registry.register(sniffer)
+    registry.register(CrashySniffer())
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                      memory_storage, plugins=registry)
+    srv.start()
+    yield EventClient(access_key=key.key,
+                      url=f"http://127.0.0.1:{srv.port}"), sniffer
+    srv.shutdown()
+
+
+class TestEventServerPlugins:
+    def test_blocker_rejects_with_403(self, served):
+        client, _ = served
+        with pytest.raises(PredictionIOError) as ei:
+            client.set_user("bot-1")
+        assert ei.value.status == 403 and "bots" in ei.value.message
+
+    def test_sniffer_sees_accepted_events(self, served):
+        client, sniffer = served
+        client.set_user("human-1")
+        client.record_user_action_on_item("view", "human-1", "i1")
+        assert sniffer.seen == ["$set", "view"]
+
+    def test_crashy_sniffer_does_not_break_ingest(self, served):
+        client, _ = served
+        eid = client.set_user("human-2")  # CrashySniffer raised, but logged
+        assert client.get_event(eid)["entityId"] == "human-2"
+
+    def test_batch_blocker_per_event_status(self, served):
+        client, _ = served
+        results = client.create_batch_events([
+            {"event": "$set", "entityType": "user", "entityId": "bot-9"},
+            {"event": "$set", "entityType": "user", "entityId": "ok"},
+        ])
+        assert [r["status"] for r in results] == [403, 201]
+
+
+class TestEngineServerPlugins:
+    def test_output_blocker_and_sniffer(self, memory_storage):
+        from predictionio_tpu.workflow.create_server import (
+            PredictionServer,
+            ServerConfig,
+        )
+        from predictionio_tpu.sdk import EngineClient
+        from tests.test_prediction_server import train_once
+        from tests.test_recommendation_template import ingest_ratings
+
+        ingest_ratings(memory_storage)
+        train_once(memory_storage)
+        registry = PluginRegistry()
+        sniffer = PredictionSniffer()
+        registry.register(CapResults())
+        registry.register(sniffer)
+        server = PredictionServer(
+            ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                         engine_variant="rec-test"),
+            memory_storage, plugins=registry)
+        server.start()
+        try:
+            client = EngineClient(url=f"http://127.0.0.1:{server.port}")
+            result = client.send_query({"user": "u1", "num": 5})
+            assert len(result["itemScores"]) <= 1  # capped by blocker
+            assert sniffer.count == 1  # sniffer ran, return value ignored
+        finally:
+            server.shutdown()
+
+
+class TestDiscovery:
+    def test_load_from_env_string(self):
+        registry = load_plugins_from_env(
+            env="tests.test_plugins:RejectBots, tests.test_plugins:CapResults")
+        assert len(registry.event_plugins) == 1
+        assert len(registry.engine_plugins) == 1
+
+    def test_bad_spec_logged_not_raised(self):
+        registry = load_plugins_from_env(env="no.such.module:Nope")
+        assert registry.event_plugins == [] and registry.engine_plugins == []
+
+    def test_register_rejects_non_plugin(self):
+        with pytest.raises(TypeError):
+            PluginRegistry().register(object())

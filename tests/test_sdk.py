@@ -2,7 +2,6 @@
 mirrors how the reference's separate-repo Python SDK drives the REST
 contract (SURVEY.md §1 L7, §4.2 quickstart_test flow)."""
 
-import numpy as np
 import pytest
 
 from predictionio_tpu.data.api import EventServer, EventServerConfig
