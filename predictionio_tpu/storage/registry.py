@@ -4,9 +4,11 @@ Parity with «data/.../data/storage/Storage.scala :: Storage» (SURVEY.md §2.2
 [U]): the reference parses ``PIO_STORAGE_REPOSITORIES_{METADATA,MODELDATA,
 EVENTDATA}_{NAME,SOURCE}`` and ``PIO_STORAGE_SOURCES_<SRC>_{TYPE,...}`` from
 `pio-env.sh` and reflectively loads backend clients. We keep the same env
-contract with backend types ``sqlite`` (PATH) and ``memory``; the repository
-split lets metadata/events/models live in different sources, exactly like the
-reference's HBase-events + ES-metadata + localfs-models deployments.
+contract with backend types ``sqlite`` (PATH = db file), ``memory``, and
+``localfs`` (PATH = model-blob dir, models-only); `register_backend` adds
+custom types. The repository split lets metadata/events/models live in
+different sources, exactly like the reference's HBase-events + ES-metadata
++ localfs-models deployments.
 """
 
 from __future__ import annotations
@@ -22,11 +24,41 @@ from predictionio_tpu.storage.sqlite import SQLiteBackend
 _REPOSITORIES = ("METADATA", "MODELDATA", "EVENTDATA")
 
 
+def _make_sqlite(source: "SourceConfig") -> base.StorageBackend:
+    os.makedirs(os.path.dirname(source.path) or ".", exist_ok=True)
+    return SQLiteBackend(source.path)
+
+
+def _make_memory(source: "SourceConfig") -> base.StorageBackend:
+    return SQLiteBackend(":memory:")
+
+
+def _make_localfs(source: "SourceConfig") -> base.StorageBackend:
+    from predictionio_tpu.storage.localfs import LocalFSBackend
+
+    return LocalFSBackend(source.path)
+
+
+# type name → factory(SourceConfig) — the reflective-client-load analogue
+# of the reference's Storage.scala; third-party backends register here
+BACKEND_TYPES: dict = {
+    "sqlite": _make_sqlite,
+    "memory": _make_memory,
+    "localfs": _make_localfs,
+}
+
+
+def register_backend(type_name: str, factory) -> None:
+    """Register a custom storage backend type (factory: SourceConfig →
+    StorageBackend). Mirrors the reference's pluggable backend loading."""
+    BACKEND_TYPES[type_name] = factory
+
+
 @dataclasses.dataclass
 class SourceConfig:
     name: str
-    type: str  # "sqlite" | "memory"
-    path: str = ""  # sqlite file path
+    type: str  # a BACKEND_TYPES key: "sqlite" | "memory" | "localfs" | custom
+    path: str = ""  # sqlite db file / localfs model dir
 
 
 @dataclasses.dataclass
@@ -47,13 +79,14 @@ class StorageConfig:
         def source_for(repo: str) -> SourceConfig:
             src = env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "PIO_DEFAULT")
             stype = env.get(f"PIO_STORAGE_SOURCES_{src}_TYPE", "sqlite")
-            spath = env.get(
-                f"PIO_STORAGE_SOURCES_{src}_PATH", os.path.join(default_path, "pio.db")
-            )
-            if stype not in ("sqlite", "memory"):
+            default = (os.path.join(default_path, "models")
+                       if stype == "localfs"
+                       else os.path.join(default_path, "pio.db"))
+            spath = env.get(f"PIO_STORAGE_SOURCES_{src}_PATH", default)
+            if stype not in BACKEND_TYPES:
                 raise ValueError(
                     f"Unsupported storage source type {stype!r} for {src} "
-                    "(supported: sqlite, memory)"
+                    f"(supported: {', '.join(sorted(BACKEND_TYPES))})"
                 )
             return SourceConfig(name=src, type=stype, path=spath)
 
@@ -92,15 +125,23 @@ class Storage:
             cls._instance = storage
 
     def _backend(self, source: SourceConfig) -> base.StorageBackend:
-        key = (source.type, source.path if source.type == "sqlite" else source.name)
+        # sqlite sources sharing a db file share one backend (path in the
+        # key); distinct custom sources stay distinct even on a shared
+        # path (name in the key); memory sources are per-name by design
+        key = (source.type, source.name, source.path)
+        if source.type == "sqlite":
+            key = (source.type, "", source.path)
         with self._lock:
             backend = self._backends.get(key)
             if backend is None:
-                if source.type == "memory":
-                    backend = SQLiteBackend(":memory:")
-                else:
-                    os.makedirs(os.path.dirname(source.path) or ".", exist_ok=True)
-                    backend = SQLiteBackend(source.path)
+                try:
+                    factory = BACKEND_TYPES[source.type]
+                except KeyError:
+                    raise ValueError(
+                        f"Unsupported storage source type {source.type!r} "
+                        f"(supported: {', '.join(sorted(BACKEND_TYPES))})"
+                    ) from None
+                backend = factory(source)
                 self._backends[key] = backend
             return backend
 

@@ -1,0 +1,150 @@
+"""LocalFS model store + pluggable backend registry (SURVEY.md §2.2
+'Storage registry' env contract, 'LocalFS/HDFS/S3 model stores')."""
+
+import os
+
+import pytest
+
+from predictionio_tpu.storage.base import Model
+from predictionio_tpu.storage.localfs import LocalFSBackend, LocalFSModels
+from predictionio_tpu.storage.registry import (
+    BACKEND_TYPES,
+    SourceConfig,
+    Storage,
+    StorageConfig,
+    register_backend,
+)
+
+
+class TestLocalFSModels:
+    def test_round_trip_and_delete(self, tmp_path):
+        store = LocalFSModels(str(tmp_path))
+        store.insert(Model(id="abc123", models=b"\x00\x01factors"))
+        got = store.get("abc123")
+        assert got is not None and got.models == b"\x00\x01factors"
+        assert store.delete("abc123") is True
+        assert store.get("abc123") is None
+        assert store.delete("abc123") is False
+
+    def test_overwrite(self, tmp_path):
+        store = LocalFSModels(str(tmp_path))
+        store.insert(Model(id="m", models=b"v1"))
+        store.insert(Model(id="m", models=b"v2"))
+        assert store.get("m").models == b"v2"
+
+    def test_rejects_path_escape(self, tmp_path):
+        store = LocalFSModels(str(tmp_path))
+        for bad in ("../evil", "a/b", "a\\b", ""):
+            with pytest.raises(ValueError):
+                store.get(bad)
+
+    def test_non_models_repos_fail_fast(self, tmp_path):
+        backend = LocalFSBackend(str(tmp_path))
+        with pytest.raises(NotImplementedError):
+            backend.apps()
+        with pytest.raises(NotImplementedError):
+            backend.events()
+
+
+class TestEnvWiring:
+    def test_mixed_sources_from_env(self, tmp_path):
+        """Reference-style deployment: metadata+events in sqlite, model
+        blobs on the filesystem — via the PIO_STORAGE_* env contract."""
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PGLIKE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PGLIKE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+            "PIO_STORAGE_SOURCES_PGLIKE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_PGLIKE_PATH": str(tmp_path / "meta.db"),
+            "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_LOCALFS_PATH": str(tmp_path / "models"),
+        }
+        storage = Storage(StorageConfig.from_env(env))
+        try:
+            storage.model_data_models().insert(Model(id="x1", models=b"blob"))
+            assert os.path.exists(tmp_path / "models" / "x1.model")
+            assert storage.model_data_models().get("x1").models == b"blob"
+            # metadata landed in sqlite, not localfs
+            from predictionio_tpu.storage.base import App
+
+            storage.meta_apps().insert(App(id=0, name="EnvApp"))
+            assert storage.meta_apps().get_by_name("EnvApp") is not None
+            assert all(storage.verify_all_data_objects().values())
+        finally:
+            storage.close()
+
+    def test_localfs_default_path_uses_basedir(self, tmp_path):
+        env = {
+            "PIO_FS_BASEDIR": str(tmp_path),
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LFS",
+            "PIO_STORAGE_SOURCES_LFS_TYPE": "localfs",
+        }
+        cfg = StorageConfig.from_env(env)
+        assert cfg.modeldata.path == str(tmp_path / "models")
+
+    def test_unknown_type_rejected(self):
+        env = {"PIO_STORAGE_SOURCES_PIO_DEFAULT_TYPE": "hbase"}
+        with pytest.raises(ValueError, match="hbase"):
+            StorageConfig.from_env(env)
+
+
+class TestPluggableBackends:
+    def test_register_custom_backend(self, tmp_path):
+        calls = []
+
+        def factory(source):
+            calls.append(source.name)
+            return LocalFSBackend(source.path)
+
+        register_backend("mycloud", factory)
+        try:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MC",
+                "PIO_STORAGE_SOURCES_MC_TYPE": "mycloud",
+                "PIO_STORAGE_SOURCES_MC_PATH": str(tmp_path),
+            }
+            storage = Storage(StorageConfig.from_env(env))
+            storage.model_data_models().insert(Model(id="c", models=b"z"))
+            assert calls == ["MC"]
+            storage.close()
+        finally:
+            BACKEND_TYPES.pop("mycloud", None)
+
+
+class TestTrainDeployOnLocalFS:
+    def test_model_blob_lands_on_filesystem(self, tmp_path):
+        """End-to-end: train stores the serialized model via localfs; the
+        prediction server deploys from it."""
+        from predictionio_tpu.sdk import EngineClient
+        from predictionio_tpu.workflow.create_server import (
+            PredictionServer,
+            ServerConfig,
+        )
+        from tests.test_prediction_server import train_once
+        from tests.test_recommendation_template import ingest_ratings
+
+        env = {
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+            "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_LOCALFS_PATH": str(tmp_path / "models"),
+            "PIO_STORAGE_SOURCES_PIO_DEFAULT_TYPE": "memory",
+        }
+        storage = Storage(StorageConfig.from_env(env))
+        Storage.reset(storage)
+        try:
+            ingest_ratings(storage)
+            instance = train_once(storage)
+            blob_file = tmp_path / "models" / f"{instance.id}.model"
+            assert blob_file.exists() and blob_file.stat().st_size > 0
+            server = PredictionServer(
+                ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                             engine_variant="rec-test"), storage)
+            server.start()
+            try:
+                client = EngineClient(url=f"http://127.0.0.1:{server.port}")
+                assert "itemScores" in client.send_query({"user": "u1", "num": 2})
+            finally:
+                server.shutdown()
+        finally:
+            storage.close()
+            Storage.reset(None)
