@@ -1,0 +1,153 @@
+"""ctypes bindings for the native host-side data loader (pio_native.cpp).
+
+The shared library is built on demand with g++ (no third-party deps —
+pybind11 isn't assumed; plain C ABI + ctypes). Build artifacts land in
+`$PIO_FS_BASEDIR/native/` (or ~/.pio_tpu/native), keyed by a source hash
+so edits rebuild automatically. If no toolchain is available the callers
+fall back to the numpy implementation; `PIO_NATIVE=0` forces the
+fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pio_native.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build_dir() -> str:
+    base = os.environ.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_tpu"))
+    return os.path.join(base, "native")
+
+
+def _compile() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.blake2b(src, digest_size=8).hexdigest()
+    out_dir = _build_dir()
+    so_path = os.path.join(out_dir, f"pio_native_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(out_dir, exist_ok=True)
+    tmp = so_path + f".build.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+    except (subprocess.SubprocessError, OSError) as e:
+        detail = getattr(e, "stderr", b"")
+        log.warning("native: build failed (%s)%s — using numpy fallback",
+                    e, b": " + detail[:500] if detail else "")
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return None
+    log.info("native: built %s", so_path)
+    return so_path
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (disabled / no toolchain)."""
+    global _lib, _lib_failed
+    if os.environ.get("PIO_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        so_path = _compile()
+        if so_path is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            log.warning("native: cannot load %s: %s", so_path, e)
+            _lib_failed = True
+            return None
+        i64, i32p, i64p, f32p = (ctypes.c_int64,
+                                 np.ctypeslib.ndpointer(np.int32),
+                                 np.ctypeslib.ndpointer(np.int64),
+                                 np.ctypeslib.ndpointer(np.float32))
+        lib.pio_plan_buckets.restype = i64
+        lib.pio_plan_buckets.argtypes = [
+            i32p, i64, ctypes.c_int32, i64, i64, i64, i64p, i64p]
+        lib.pio_fill_buckets.restype = i64
+        lib.pio_fill_buckets.argtypes = [
+            i32p, i32p, f32p, i64, ctypes.c_int32, i64, i64, i64, i64,
+            i64p, i64p, i32p, i32p, f32p, f32p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
+                         vals: np.ndarray, n_rows: int,
+                         row_multiple: int = 8,
+                         max_cap: Optional[int] = None,
+                         min_cap: int = 8):
+    """COO → padded buckets via the C++ loader; output matches
+    ops.als.bucket_ragged bit for bit. Returns None when the native
+    library is unavailable (caller falls back to numpy)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    cols = np.ascontiguousarray(cols, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.float32)
+    n = len(rows)
+    mc = 0 if max_cap is None else int(max_cap)
+    caps = np.zeros(63, dtype=np.int64)
+    rpads = np.zeros(63, dtype=np.int64)
+    nb = lib.pio_plan_buckets(rows, n, n_rows, row_multiple, mc, min_cap,
+                              caps, rpads)
+    if nb < 0:
+        # out-of-range row ids: defer to the numpy path so behavior is
+        # identical with and without a toolchain
+        log.warning("native: row ids outside [0, n_rows) — numpy fallback")
+        return None
+    caps, rpads = caps[:nb], rpads[:nb]
+    total_rows = int(rpads.sum())
+    total_elems = int((rpads * caps).sum())
+    rows_out = np.empty(total_rows, dtype=np.int32)
+    cols_out = np.empty(total_elems, dtype=np.int32)
+    vals_out = np.empty(total_elems, dtype=np.float32)
+    mask_out = np.empty(total_elems, dtype=np.float32)
+    rc = lib.pio_fill_buckets(rows, cols, vals, n, n_rows, row_multiple,
+                              mc, min_cap, nb, caps, rpads,
+                              rows_out, cols_out, vals_out, mask_out)
+    if rc != 0:
+        log.warning("native: fill/plan disagreement (rc=%d) — fallback", rc)
+        return None
+
+    from predictionio_tpu.ops.als import Bucket
+
+    buckets = []
+    ro = eo = 0
+    for b in range(nb):
+        rpad, cap = int(rpads[b]), int(caps[b])
+        shape = (rpad, cap)
+        buckets.append(Bucket(
+            rows=rows_out[ro:ro + rpad],
+            cols=cols_out[eo:eo + rpad * cap].reshape(shape),
+            vals=vals_out[eo:eo + rpad * cap].reshape(shape),
+            mask=mask_out[eo:eo + rpad * cap].reshape(shape),
+        ))
+        ro += rpad
+        eo += rpad * cap
+    return buckets

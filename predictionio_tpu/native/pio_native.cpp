@@ -1,0 +1,181 @@
+// Native host-side data loader for predictionio_tpu.
+//
+// The reference delegates its hot host paths to the JVM/Spark (RDD
+// shuffles, HBase scans — SURVEY.md §2.5: its only native code lives in
+// dependencies like netlib/netty). The TPU rebuild's equivalent hot host
+// path is the ragged-COO → padded-dense-bucket transform that feeds the
+// device (ops/als.py::bucket_ragged): O(nnz) work per train that was a
+// Python loop. This file implements it in C++ behind a two-phase C ABI
+// (plan → caller allocates numpy buffers → fill), bound via ctypes
+// (predictionio_tpu/native/__init__.py) with the numpy implementation as
+// fallback. Output is bit-identical to the Python path:
+//   - buckets ordered by ascending capacity (power-of-two, >= min_cap)
+//   - rows within a bucket ordered by ascending row id
+//   - entries within a row in original (stable) order, truncated to
+//     max_cap keeping the first entries
+//   - row count padded to a multiple of row_multiple with sentinel
+//     row id == n_rows and zeroed cols/vals/mask
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py; no deps).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+int64_t pow2_cap(int64_t count, int64_t min_cap, int64_t max_cap) {
+    int64_t c = count < 1 ? 1 : count;
+    int64_t cap = 1;
+    while (cap < c) cap <<= 1;
+    if (cap < min_cap) cap = min_cap;
+    if (max_cap > 0 && cap > max_cap) cap = max_cap;
+    return cap;
+}
+
+// caps are powers of two in [min_cap, 2^62]: index by trailing-zero count
+constexpr int kMaxCapSlots = 63;
+
+struct Plan {
+    std::vector<int64_t> counts;        // per row id, truncated to max_cap
+    std::vector<int64_t> caps;          // distinct caps ascending
+    std::vector<int64_t> rpads;         // padded row count per bucket
+    std::vector<int64_t> nrows_real;    // real rows per bucket
+};
+
+// returns false if any row id is outside [0, n_rows) — the caller then
+// falls back to the numpy path rather than silently dropping entries
+// (keeps behavior identical with and without a toolchain)
+bool build_plan(const int32_t* rows, int64_t n, int32_t n_rows,
+                int64_t row_multiple, int64_t max_cap, int64_t min_cap,
+                Plan& plan) {
+    plan.counts.assign(static_cast<size_t>(n_rows) + 1, 0);
+    for (int64_t k = 0; k < n; ++k) {
+        int32_t r = rows[k];
+        if (r < 0 || r >= n_rows) return false;
+        plan.counts[r] += 1;
+    }
+    int64_t rows_per_cap[kMaxCapSlots] = {0};
+    for (int32_t r = 0; r < n_rows; ++r) {
+        if (plan.counts[r] == 0) continue;
+        if (max_cap > 0 && plan.counts[r] > max_cap) plan.counts[r] = max_cap;
+        int64_t cap = pow2_cap(plan.counts[r], min_cap, max_cap);
+        int slot = 0;
+        while ((int64_t(1) << slot) < cap) ++slot;
+        rows_per_cap[slot] += 1;
+    }
+    plan.caps.clear();
+    plan.rpads.clear();
+    plan.nrows_real.clear();
+    for (int slot = 0; slot < kMaxCapSlots; ++slot) {
+        if (rows_per_cap[slot] == 0) continue;
+        int64_t r = rows_per_cap[slot];
+        int64_t rm = row_multiple > 0 ? row_multiple : 1;
+        int64_t rpad = ((r + rm - 1) / rm) * rm;
+        int64_t cap = int64_t(1) << slot;
+        // a non-power-of-two max_cap clamps the top bucket's width (the
+        // Python path's caps = min(pow2, max_cap))
+        if (max_cap > 0 && cap > max_cap) cap = max_cap;
+        plan.caps.push_back(cap);
+        plan.rpads.push_back(rpad);
+        plan.nrows_real.push_back(r);
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Phase 1: returns the number of buckets (or -1 on out-of-range row ids);
+// writes per-bucket capacity and padded row count into out_caps/out_rpads
+// (each sized >= 63).
+int64_t pio_plan_buckets(const int32_t* rows, int64_t n, int32_t n_rows,
+                         int64_t row_multiple, int64_t max_cap,
+                         int64_t min_cap, int64_t* out_caps,
+                         int64_t* out_rpads) {
+    Plan plan;
+    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, plan))
+        return -1;
+    for (size_t b = 0; b < plan.caps.size(); ++b) {
+        out_caps[b] = plan.caps[b];
+        out_rpads[b] = plan.rpads[b];
+    }
+    return static_cast<int64_t>(plan.caps.size());
+}
+
+// Phase 2: fill caller-allocated flat buffers.
+//   rows_out: [sum(rpads)] int32
+//   cols_out/vals_out/mask_out: [sum(rpads[b] * caps[b])]
+// Layout: buckets in ascending-cap order, concatenated.
+// Returns 0 on success, -1 if the derived plan disagrees with the
+// caller's buffer layout (caller bug).
+int64_t pio_fill_buckets(const int32_t* rows, const int32_t* cols,
+                         const float* vals, int64_t n, int32_t n_rows,
+                         int64_t row_multiple, int64_t max_cap,
+                         int64_t min_cap, int64_t n_buckets,
+                         const int64_t* caps, const int64_t* rpads,
+                         int32_t* rows_out, int32_t* cols_out,
+                         float* vals_out, float* mask_out) {
+    Plan plan;
+    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, plan))
+        return -1;
+    if (static_cast<int64_t>(plan.caps.size()) != n_buckets) return -1;
+    for (int64_t b = 0; b < n_buckets; ++b) {
+        if (plan.caps[b] != caps[b] || plan.rpads[b] != rpads[b]) return -1;
+    }
+
+    // bucket index per cap slot + flat offsets
+    int64_t bucket_of_slot[kMaxCapSlots];
+    for (int s = 0; s < kMaxCapSlots; ++s) bucket_of_slot[s] = -1;
+    std::vector<int64_t> row_off(n_buckets), elem_off(n_buckets);
+    int64_t ro = 0, eo = 0;
+    for (int64_t b = 0; b < n_buckets; ++b) {
+        int slot = 0;
+        while ((int64_t(1) << slot) < caps[b]) ++slot;
+        bucket_of_slot[slot] = b;
+        row_off[b] = ro;
+        elem_off[b] = eo;
+        ro += rpads[b];
+        eo += rpads[b] * caps[b];
+    }
+
+    // sentinel-fill rows_out; zero the element buffers
+    for (int64_t i = 0; i < ro; ++i) rows_out[i] = n_rows;
+    std::memset(cols_out, 0, static_cast<size_t>(eo) * sizeof(int32_t));
+    std::memset(vals_out, 0, static_cast<size_t>(eo) * sizeof(float));
+    std::memset(mask_out, 0, static_cast<size_t>(eo) * sizeof(float));
+
+    // slot of each real row within its bucket: ascending row id order
+    std::vector<int64_t> row_slot(static_cast<size_t>(n_rows), -1);
+    std::vector<int64_t> next_slot(n_buckets, 0);
+    std::vector<int64_t> row_bucket(static_cast<size_t>(n_rows), -1);
+    for (int32_t r = 0; r < n_rows; ++r) {
+        if (plan.counts[r] == 0) continue;
+        int64_t cap = pow2_cap(plan.counts[r], min_cap, max_cap);
+        int slot = 0;
+        while ((int64_t(1) << slot) < cap) ++slot;
+        int64_t b = bucket_of_slot[slot];
+        if (b < 0) return -1;
+        row_bucket[r] = b;
+        row_slot[r] = next_slot[b]++;
+        rows_out[row_off[b] + row_slot[r]] = r;
+    }
+
+    // scatter entries in original order (stable), truncating at count cap
+    std::vector<int64_t> filled(static_cast<size_t>(n_rows), 0);
+    for (int64_t k = 0; k < n; ++k) {
+        int32_t r = rows[k];
+        if (r < 0 || r >= n_rows) continue;
+        if (filled[r] >= plan.counts[r]) continue;  // max_cap truncation
+        int64_t b = row_bucket[r];
+        int64_t idx = elem_off[b] + row_slot[r] * caps[b] + filled[r];
+        cols_out[idx] = cols[k];
+        vals_out[idx] = vals[k];
+        mask_out[idx] = 1.0f;
+        filled[r] += 1;
+    }
+    return 0;
+}
+
+}  // extern "C"

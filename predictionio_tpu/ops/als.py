@@ -66,7 +66,17 @@ def bucket_ragged(
     `row_multiple` pads each bucket's row count (use mesh data-axis size ×
     8 so shards stay tile-aligned). `max_cap` truncates pathological rows
     (keeping the most recent entries is the caller's job; default no cap).
+
+    The hot path runs in the native C++ loader (native/pio_native.cpp,
+    bit-identical output) when a toolchain is available; PIO_NATIVE=0 or
+    a failed build falls back to this numpy implementation.
     """
+    from predictionio_tpu import native as _native
+
+    nb = _native.bucket_ragged_native(rows, cols, vals, n_rows,
+                                      row_multiple, max_cap, MIN_CAP)
+    if nb is not None:
+        return nb
     rows = np.asarray(rows, dtype=np.int32)
     cols = np.asarray(cols, dtype=np.int32)
     vals = np.asarray(vals, dtype=np.float32)

@@ -35,11 +35,17 @@ class LocalFSModels(base.Models):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         # sweep temp files orphaned by a hard-killed writer (mkstemp done,
-        # os.replace never reached)
+        # os.replace never reached). Age-gated: another live process may be
+        # mid-write in this same directory (train writes, deploy reads)
+        import time
+
+        cutoff = time.time() - 3600
         for name in os.listdir(self.directory):
             if name.endswith(".tmp"):
+                p = os.path.join(self.directory, name)
                 try:
-                    os.unlink(os.path.join(self.directory, name))
+                    if os.path.getmtime(p) < cutoff:
+                        os.unlink(p)
                 except OSError:
                     pass
 
@@ -59,6 +65,8 @@ class LocalFSModels(base.Models):
             os.fchmod(fd, 0o666 & ~_current_umask())
             with os.fdopen(fd, "wb") as f:
                 f.write(model.models)
+                f.flush()
+                os.fsync(f.fileno())  # rename must land on durable data
             os.replace(tmp, path)
         except BaseException:
             if os.path.exists(tmp):

@@ -109,7 +109,7 @@ class Storage:
 
     def __init__(self, config: Optional[StorageConfig] = None):
         self.config = config or StorageConfig.from_env()
-        self._backends: dict[tuple[str, str], base.StorageBackend] = {}
+        self._backends: dict[tuple[str, str, str], base.StorageBackend] = {}
 
     # -- singleton wiring (CLI / servers); tests construct directly --------
     @classmethod
