@@ -111,6 +111,8 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
     cols = np.ascontiguousarray(cols, dtype=np.int32)
     vals = np.ascontiguousarray(vals, dtype=np.float32)
     n = len(rows)
+    if max_cap is not None and max_cap < 1:
+        return None  # degenerate cap: numpy path defines the semantics
     mc = 0 if max_cap is None else int(max_cap)
     caps = np.zeros(63, dtype=np.int64)
     rpads = np.zeros(63, dtype=np.int64)

@@ -1,0 +1,150 @@
+"""Sequence-parallel attention: ring attention + Ulysses (all-to-all).
+
+The reference has no sequence dimension (SURVEY.md §5 'Long-context':
+PredictionIO predates transformers; its only big-tensor shard is MLlib's
+block-partitioned ALS interaction matrix). The rebuild still ships
+long-context sequence parallelism as first-class infrastructure, because
+a TPU-native framework's scale story is shaped by it:
+
+- `ring_attention`: queries/keys/values sharded over the mesh sequence
+  axis; K/V blocks rotate around the ring via `ppermute` while each step
+  folds one block into a numerically-stable online softmax (the
+  flash/ring-attention recurrence). Peak memory per device is O(S/n · d)
+  and the ICI traffic overlaps with the per-block matmuls.
+- `ulysses_attention`: `all_to_all` re-shards seq → heads, computes
+  full-sequence attention locally per head group, and all_to_alls back —
+  cheaper collective volume when heads % n_shards == 0.
+
+Both are exact (not approximations) and match `dense_attention` to float
+tolerance; causal masking uses global positions so it is shard-layout
+invariant. Shapes: [batch, heads, seq, head_dim], seq sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps fully-masked rows
+# (causal ring blocks entirely in the future) NaN-free after softmax
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Reference single-device attention. q,k,v: [B, H, S, D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _online_block(o, m, l, q, k_blk, v_blk, q_pos, kv_pos, causal):
+    """Fold one K/V block into the running (o, m, l) softmax state."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) / math.sqrt(q.shape[-1])
+    if causal:
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [Sq, Skv]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Sq]
+    m_new = jnp.maximum(m, m_blk)
+    # rescale old accumulators, then add this block's contribution
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = DATA_AXIS,
+                   causal: bool = False):
+    """Exact attention with seq sharded over `axis`; K/V ring-rotate.
+
+    q, k, v: [B, H, S, D] jax arrays (global view); S % mesh.shape[axis]
+    == 0. Returns [B, H, S, D] sharded like q.
+    """
+    n = mesh.shape[axis]
+    seq = q.shape[2]
+    if seq % n != 0:
+        raise ValueError(f"seq {seq} not divisible by {axis}={n}")
+    blk = seq // n
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec)
+    def run(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis)
+        q_pos = idx * blk + jnp.arange(blk)
+        o = jnp.zeros_like(q_blk)
+        m = jnp.full(q_blk.shape[:-1], _NEG_INF, dtype=q_blk.dtype)
+        l = jnp.zeros(q_blk.shape[:-1], dtype=q_blk.dtype)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_cur, v_cur = k_blk, v_blk
+        for step in range(n):  # static ring walk, unrolled under jit
+            src = (idx - step) % n  # whose block we currently hold
+            kv_pos = src * blk + jnp.arange(blk)
+            o, m, l = _online_block(o, m, l, q_blk, k_cur, v_cur,
+                                    q_pos, kv_pos, causal)
+            if step + 1 < n:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    return run(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = DATA_AXIS,
+                      causal: bool = False):
+    """Exact attention via all-to-all head/seq re-sharding (DeepSpeed-
+    Ulysses style). Requires H % n == 0 and S % n == 0."""
+    n = mesh.shape[axis]
+    b, h, seq, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by {axis}={n}")
+    if seq % n != 0:
+        raise ValueError(f"seq {seq} not divisible by {axis}={n}")
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec)
+    def run(q_blk, k_blk, v_blk):
+        # [B, H, S/n, D] → all_to_all → [B, H/n, S, D]: full sequence,
+        # head-group local
+        def to_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = to_heads(q_blk), to_heads(k_blk), to_heads(v_blk)
+        out = dense_attention(qh, kh, vh, causal=causal)
+        return to_seq(out)
+
+    return run(q, k, v)
+
+
+def sequence_sharded_attention(q, k, v, mesh: Mesh, axis: str = DATA_AXIS,
+                               causal: bool = False,
+                               method: Optional[str] = None):
+    """Pick the sequence-parallel strategy: 'ring', 'ulysses', or None =
+    ulysses when heads divide evenly (lower collective volume), else
+    ring."""
+    n = mesh.shape[axis]
+    if method is None:
+        method = "ulysses" if q.shape[1] % n == 0 else "ring"
+    if method == "ring":
+        return ring_attention(q, k, v, mesh, axis, causal)
+    if method == "ulysses":
+        return ulysses_attention(q, k, v, mesh, axis, causal)
+    raise ValueError(f"Unknown method {method!r} (ring | ulysses)")

@@ -68,6 +68,13 @@ class LocalFSModels(base.Models):
                 f.flush()
                 os.fsync(f.fileno())  # rename must land on durable data
             os.replace(tmp, path)
+            # fsync the directory too, else the rename itself can be lost
+            # on power failure
+            dirfd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

@@ -55,6 +55,7 @@ class TestNativeBucketize:
         rows, cols, vals = synth(4000, 50, 100, 3, zipf=True)
         py = _python_buckets(rows, cols, vals, 50, max_cap=16)
         nat = native.bucket_ragged_native(rows, cols, vals, 50, 8, 16)
+        assert len(py) == len(nat)
         for pb, nb in zip(py, nat):
             np.testing.assert_array_equal(pb.cols, nb.cols)
             np.testing.assert_array_equal(pb.vals, nb.vals)
@@ -63,6 +64,7 @@ class TestNativeBucketize:
         rows, cols, vals = synth(3000, 40, 60, 4, zipf=True)
         py = _python_buckets(rows, cols, vals, 40, max_cap=100)
         nat = native.bucket_ragged_native(rows, cols, vals, 40, 8, 100)
+        assert len(py) == len(nat)
         assert [b.cap for b in py] == [b.cap for b in nat]
         for pb, nb in zip(py, nat):
             np.testing.assert_array_equal(pb.mask, nb.mask)
