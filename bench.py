@@ -42,8 +42,11 @@ def main():
     from predictionio_tpu.ops.als import ALSConfig, als_train
 
     ui, ii, r = synth_ml100k()
-    # warm-up: compiles the fused training loop
-    warm = ALSConfig(rank=RANK, iterations=100, reg=0.05, seed=0)
+    # warm-up: compiles the fused training loop. bf16 gather feeds the MXU
+    # its native dtype (f32 accumulation; RMSE trajectory identical to f32
+    # to 4 decimals — BASELINE.md round-1 measurement)
+    warm = ALSConfig(rank=RANK, iterations=100, reg=0.05, seed=0,
+                     compute_dtype="bfloat16", solver="chol")
     als_train(ui, ii, r, N_USERS, N_ITEMS, warm)
     # timed: same config reuses the compiled executable; 100 iterations in
     # one on-device scan amortizes dispatch, timing fenced by scalar read

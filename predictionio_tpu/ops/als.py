@@ -121,6 +121,17 @@ class ALSConfig:
     alpha: float = 1.0  # implicit confidence scale
     seed: int = 0
     dtype: str = "float32"
+    # Gram/RHS einsum input precision: "bfloat16" feeds the MXU its native
+    # dtype (f32 accumulation via preferred_element_type keeps the normal
+    # equations well-conditioned); "float32" for bit-stable results.
+    compute_dtype: str = "float32"
+    # normal-equation solver: "chol" (Cholesky; A is SPD by construction —
+    # λ>0 — and two triangular solves beat LU by ~30% on v5e), "lu"
+    # (jnp.linalg.solve), or "cg" (batched conjugate gradient, pure XLA
+    # einsum matvecs — no Cholesky/LU custom-call, which the v5e profile
+    # shows dominating rank-64 epochs; exact in exchange for cg_iters)
+    solver: str = "chol"
+    cg_iters: int = 0  # 0 = auto: rank//2 clamped to [8, 32]
     # Pallas fused gather+Gram kernel (ops/pallas_als.py). "off"/"auto":
     # XLA gather+einsum path (measured at parity with the kernel on v5e at
     # ML-20M-like density — auto stays conservative until the kernel wins);
@@ -149,10 +160,44 @@ def _solve_buckets_device(
 
     use_pallas = cfg.pallas in ("on", "interpret")
     interpret = cfg.pallas == "interpret"
+    cdtype = jnp.dtype(cfg.compute_dtype)
+    f32 = jnp.float32
+
+    def solve_spd(a, b):
+        if cfg.solver == "chol":
+            chol = jnp.linalg.cholesky(a)
+            y1 = jax.lax.linalg.triangular_solve(
+                chol, b[..., None], left_side=True, lower=True)
+            return jax.lax.linalg.triangular_solve(
+                chol, y1, left_side=True, lower=True,
+                transpose_a=True)[..., 0]
+        if cfg.solver == "cg":
+            iters = cfg.cg_iters or max(8, min(32, k // 2))
+            # Jacobi-preconditioned CG: all matvecs, MXU/VPU-only
+            dinv = 1.0 / jnp.maximum(
+                jnp.diagonal(a, axis1=-2, axis2=-1), 1e-12)
+            x = jnp.zeros_like(b)
+            r = b
+            z = dinv * r
+            p = z
+            rz = jnp.sum(r * z, -1)
+            for _ in range(iters):
+                ap = jnp.einsum("rkl,rl->rk", a, p)
+                alpha = rz / jnp.maximum(jnp.sum(p * ap, -1), 1e-30)
+                x = x + alpha[:, None] * p
+                r = r - alpha[:, None] * ap
+                z = dinv * r
+                rz_new = jnp.sum(r * z, -1)
+                p = z + (rz_new / jnp.maximum(rz, 1e-30))[:, None] * p
+                rz = rz_new
+            return x
+        return jnp.linalg.solve(a, b[..., None])[..., 0]
 
     if cfg.implicit:
         # global Gram over real (non-sentinel-pad) opposing rows
-        gram = opposing.T @ opposing
+        op_c = opposing.astype(cdtype)
+        gram = jnp.einsum("ck,cl->kl", op_c, op_c,
+                          preferred_element_type=f32).astype(opposing.dtype)
 
     for rows, cols, vals, mask in buckets_dev:
         n = mask.sum(-1)
@@ -170,17 +215,26 @@ def _solve_buckets_device(
                 a = a + gram[None]
         else:
             y = opposing[cols]  # [R, C, K] gather
-            ym = y * mask[..., None]
+            ym = (y * mask[..., None]).astype(cdtype)
+            yc = y.astype(cdtype)
             if cfg.implicit:
                 conf = cfg.alpha * vals  # C - I, zero at padding
-                a = gram[None] + jnp.einsum("rck,rc,rcl->rkl", ym, conf, ym)
-                b = jnp.einsum("rck,rc->rk", ym, 1.0 + conf)
+                a = gram[None] + jnp.einsum(
+                    "rck,rc,rcl->rkl", ym, conf.astype(cdtype), ym,
+                    preferred_element_type=f32)
+                b = jnp.einsum("rck,rc->rk", ym,
+                               (1.0 + conf).astype(cdtype),
+                               preferred_element_type=f32)
             else:
-                a = jnp.einsum("rck,rcl->rkl", ym, y)
-                b = jnp.einsum("rck,rc->rk", ym, vals)
+                a = jnp.einsum("rck,rcl->rkl", ym, yc,
+                               preferred_element_type=f32)
+                b = jnp.einsum("rck,rc->rk", ym, vals.astype(cdtype),
+                               preferred_element_type=f32)
+        a = a.astype(opposing.dtype)
+        b = b.astype(opposing.dtype)
         reg = cfg.reg * (n if cfg.weighted_reg else jnp.ones_like(n))
         a = a + reg[:, None, None] * eye[None]
-        x = jnp.linalg.solve(a, b[..., None])[..., 0]
+        x = solve_spd(a, b)
         # sentinel row ids (== out_rows) fall outside and are dropped
         new = new.at[rows].set(x, mode="drop")
     return new

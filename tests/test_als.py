@@ -23,6 +23,27 @@ def synth_ratings(n_users=60, n_items=40, rank=3, density=0.3, seed=0, noise=0.0
     return ui.astype(np.int32), ii.astype(np.int32), r.astype(np.float32), full
 
 
+class TestSolvers:
+    """chol / lu / cg must all drive ALS to the same solution quality."""
+
+    @pytest.mark.parametrize("solver", ["lu", "chol", "cg"])
+    def test_solver_converges_to_same_rmse(self, solver):
+        ui, ii, r, _ = synth_ratings(n_users=50, n_items=35, seed=2)
+        cfg = ALSConfig(rank=6, iterations=8, reg=0.05, seed=3,
+                        solver=solver)
+        out = als_train(ui, ii, r, 50, 35, cfg, compute_rmse=True)
+        assert out.rmse_history[-1] < 0.05  # near-noiseless synth recovers
+
+    def test_cg_matches_chol_factors_closely(self):
+        ui, ii, r, _ = synth_ratings(n_users=40, n_items=30, seed=6)
+        base = ALSConfig(rank=4, iterations=3, reg=0.1, seed=1)
+        out_c = als_train(ui, ii, r, 40, 30, base)
+        out_g = als_train(ui, ii, r, 40, 30,
+                          dataclasses.replace(base, solver="cg", cg_iters=16))
+        np.testing.assert_allclose(out_g.user_factors, out_c.user_factors,
+                                   rtol=5e-3, atol=5e-4)
+
+
 class TestBucketing:
     def test_buckets_cover_all_entries(self):
         rng = np.random.default_rng(1)
