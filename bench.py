@@ -44,9 +44,12 @@ def main():
     ui, ii, r = synth_ml100k()
     # warm-up: compiles the fused training loop. bf16 gather feeds the MXU
     # its native dtype (f32 accumulation; RMSE trajectory identical to f32
-    # to 4 decimals — BASELINE.md round-1 measurement)
+    # to 4 decimals — BASELINE.md round-1 measurement). solver="auto"
+    # resolves to the Pallas Gauss-Jordan kernel on TPU (ops/
+    # pallas_solve.py — measured 7.3 → 4.5 ms/epoch vs the Cholesky
+    # custom-call at this config).
     warm = ALSConfig(rank=RANK, iterations=100, reg=0.05, seed=0,
-                     compute_dtype="bfloat16", solver="chol")
+                     compute_dtype="bfloat16", solver="auto")
     als_train(ui, ii, r, N_USERS, N_ITEMS, warm)
     # timed: same config reuses the compiled executable; 100 iterations in
     # one on-device scan amortizes dispatch, timing fenced by scalar read
