@@ -8,8 +8,12 @@ reference mount was empty at survey time).
 from predictionio_tpu.data.events import Event, EventValidationError, validate_event
 from predictionio_tpu.data.datamap import DataMap, PropertyMap, aggregate_properties
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.view import EventColumns, LBatchView, PBatchView
 
 __all__ = [
+    "EventColumns",
+    "LBatchView",
+    "PBatchView",
     "Event",
     "EventValidationError",
     "validate_event",

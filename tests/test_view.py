@@ -1,0 +1,112 @@
+"""Batch-view tests — parity with the reference's 0.9.x view layer
+(«data/.../data/view/{LBatchView,PBatchView}.scala» — SURVEY.md §2.2 [U]):
+windowed event snapshots, writeToPropsMap aggregation, per-entity ordered
+folds, and our columnar device-feed variant."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.data.view import LBatchView, PBatchView
+from predictionio_tpu.storage.base import App
+
+
+def ts(h, m=0):
+    return datetime(2026, 1, 1, h, m, 0, tzinfo=timezone.utc)
+
+
+def _seed(storage):
+    apps = storage.meta_apps()
+    app_id = apps.insert(App(id=0, name="ViewApp"))
+    events = storage.l_events()
+    rows = [
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"plan": "free", "age": 30}), event_time=ts(1)),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"plan": "pro"}), event_time=ts(2)),
+        Event(event="$unset", entity_type="user", entity_id="u1",
+              properties=DataMap({"age": None}), event_time=ts(3)),
+        Event(event="$set", entity_type="user", entity_id="u2",
+              properties=DataMap({"plan": "free", "age": 22}), event_time=ts(2)),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 4.0}), event_time=ts(4)),
+        Event(event="rate", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i2",
+              properties=DataMap({"rating": 3.0}), event_time=ts(5)),
+        Event(event="view", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              event_time=ts(6)),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              properties=DataMap({"rating": 5.0}), event_time=ts(7)),
+    ]
+    for e in rows:
+        events.insert(e, app_id)
+    return app_id
+
+
+class TestLBatchView:
+    def test_events_ordered_and_windowed(self, memory_storage):
+        _seed(memory_storage)
+        view = LBatchView("ViewApp")
+        assert [e.event_time for e in view.events] == sorted(
+            e.event_time for e in view.events
+        )
+        assert len(view.events) == 8
+        windowed = LBatchView("ViewApp", start_time=ts(4), until_time=ts(6))
+        assert [e.event for e in windowed.events] == ["rate", "rate"]
+
+    def test_aggregate_properties(self, memory_storage):
+        _seed(memory_storage)
+        props = LBatchView("ViewApp").aggregate_properties("user")
+        assert props["u1"].to_dict() == {"plan": "pro"}  # age $unset
+        assert props["u2"].to_dict() == {"plan": "free", "age": 22}
+
+    def test_aggregate_by_entity_ordered(self, memory_storage):
+        _seed(memory_storage)
+        view = LBatchView("ViewApp")
+        # last-rated-item per user: order matters (u1 rated i1 then i2)
+        last = view.aggregate_by_entity_ordered(
+            lambda e: e.event == "rate", None, lambda _, e: e.target_entity_id
+        )
+        assert last == {"u1": "i2", "u2": "i2"}
+        counts = view.aggregate_by_entity_ordered(
+            lambda e: e.event in ("rate", "view"), 0, lambda acc, _: acc + 1
+        )
+        assert counts == {"u1": 3, "u2": 1}
+
+
+class TestPBatchView:
+    def test_to_columns(self, memory_storage):
+        _seed(memory_storage)
+        cols = PBatchView("ViewApp").to_columns(value_key="rating")
+        # special events excluded; default event vocabulary is sorted
+        assert cols.event_names == ["rate", "view"]
+        assert len(cols) == 4
+        # decode back: the rate rows carry their ratings, the view row NaN
+        rate = cols.event_codes == cols.event_names.index("rate")
+        assert np.allclose(np.sort(cols.values[rate]), [3.0, 4.0, 5.0])
+        assert np.isnan(cols.values[~rate]).all()
+        users = cols.entity_bimap.from_index(cols.entity_ids)
+        items = cols.target_bimap.from_index(cols.target_ids)
+        assert set(zip(users, items, cols.event_names[0:1] * 4)) >= {
+            ("u1", "i1", "rate"), ("u2", "i2", "rate")
+        }
+        # rows keep time order
+        assert (np.diff(cols.times) >= 0).all()
+
+    def test_to_columns_subset_vocabulary(self, memory_storage):
+        _seed(memory_storage)
+        cols = PBatchView("ViewApp").to_columns(event_names=["view"])
+        assert len(cols) == 1 and cols.event_names == ["view"]
+        assert cols.entity_bimap.from_index(cols.entity_ids) == ["u1"]
+
+    def test_property_matrix(self, memory_storage):
+        _seed(memory_storage)
+        mat, bimap = PBatchView("ViewApp").property_matrix("user", ["age"])
+        assert mat.shape == (2, 1)
+        assert np.isnan(mat[bimap["u1"], 0])  # age was $unset
+        assert mat[bimap["u2"], 0] == 22.0
