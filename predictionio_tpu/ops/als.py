@@ -364,6 +364,9 @@ def als_train(
         use_gj = (mesh.size == 1 and pallas_solve.gj_applicable(cfg.rank)
                   and (on_tpu or cfg.pallas == "interpret"))
         cfg = dataclasses.replace(cfg, solver="gj" if use_gj else "chol")
+        log.info("als_train: solver='auto' resolved to %r (mesh.size=%d, "
+                 "backend=%s, rank=%d)", cfg.solver, mesh.size,
+                 jax.default_backend(), cfg.rank)
     elif cfg.solver == "gj":
         from predictionio_tpu.ops import pallas_solve
 

@@ -230,12 +230,8 @@ def cmd_deploy(args) -> int:
         print(f"Cannot bind {args.ip}:{args.port}: {e.strerror or e}", file=sys.stderr)
         return 1
     print(f"Engine instance {server.instance_id} deployed on "
-          f"{args.ip}:{server.port}")
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        server.shutdown()
-    return 0
+          f"{args.ip}:{server.port}", flush=True)
+    return _serve_until_signal(server)
 
 
 def cmd_batchpredict(args) -> int:
@@ -282,18 +278,39 @@ def cmd_export(args) -> int:
     return 0
 
 
+def _serve_until_signal(server) -> int:
+    """Block in serve_forever until SIGINT/SIGTERM, then shut down
+    gracefully: stop accepting, close storage (checkpoints SQLite WAL),
+    flush logs — the supervised-shutdown contract the reference gets from
+    its Akka actor system."""
+    import signal
+
+    def _terminate(signum, frame):
+        raise KeyboardInterrupt
+
+    prev = signal.signal(signal.SIGTERM, _terminate)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        server.shutdown()
+        from predictionio_tpu.storage import Storage
+
+        Storage.get().close()
+        sys.stdout.flush()
+    return 0
+
+
 def _run_service(make_server, what: str, ip: str, port: int) -> int:
     try:
         server = make_server()
     except OSError as e:
         print(f"Cannot bind {ip}:{port}: {e.strerror or e}", file=sys.stderr)
         return 1
-    print(f"{what} listening on {ip}:{server.port}")
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        server.shutdown()
-    return 0
+    print(f"{what} listening on {ip}:{server.port}", flush=True)
+    return _serve_until_signal(server)
 
 
 def cmd_dashboard(args) -> int:

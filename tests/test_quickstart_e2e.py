@@ -1,0 +1,214 @@
+"""End-to-end quickstart over real processes and sockets.
+
+Mirrors the reference's integration harness
+(«tests/pio_tests/scenarios/quickstart_test.py» — SURVEY.md §4.2 [U]):
+`pio app new` → `pio template get` → `pio eventserver` (subprocess, real
+port) → SDK imports rating events over HTTP → `pio build` → `pio train`
+(subprocess) → `pio deploy` (subprocess, real port) → HTTP query asserts —
+the whole loop through bin/pio exactly as a user runs it.
+"""
+
+import os
+import pathlib
+import re
+import subprocess
+import time
+
+import pytest
+
+from predictionio_tpu.sdk import EngineClient, EventClient
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PIO = str(REPO / "bin" / "pio")
+
+pytestmark = pytest.mark.e2e
+
+
+class PioRig:
+    """A scratch pio installation: tmp conf + sqlite store + subprocesses."""
+
+    def __init__(self, tmp_path):
+        self.conf = tmp_path / "conf"
+        self.conf.mkdir()
+        db = tmp_path / "pio.db"
+        (self.conf / "pio-env.sh").write_text(
+            "export PIO_STORAGE_SOURCES_PIO_SQLITE_TYPE=sqlite\n"
+            f"export PIO_STORAGE_SOURCES_PIO_SQLITE_PATH={db}\n"
+            "export PIO_STORAGE_REPOSITORIES_METADATA_SOURCE=PIO_SQLITE\n"
+            "export PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=PIO_SQLITE\n"
+            "export PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE=PIO_SQLITE\n"
+        )
+        self.env = dict(os.environ)
+        self.env.update(
+            PIO_CONF_DIR=str(self.conf),
+            JAX_PLATFORMS="cpu",
+        )
+        self.procs: list[subprocess.Popen] = []
+
+    def run(self, *args, cwd=None, check=True):
+        r = subprocess.run([PIO, *args], capture_output=True, text=True,
+                           env=self.env, cwd=cwd)
+        if check:
+            assert r.returncode == 0, f"pio {args} failed:\n{r.stdout}\n{r.stderr}"
+        return r
+
+    def serve(self, *args, ready_re, cwd=None, timeout=90.0):
+        """Start a pio service subprocess; return the port parsed from the
+        line matching `ready_re` (services print ':<port>' once bound)."""
+        import selectors
+
+        p = subprocess.Popen([PIO, *args], stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True,
+                             env=self.env, cwd=cwd)
+        self.procs.append(p)
+        sel = selectors.DefaultSelector()
+        sel.register(p.stdout, selectors.EVENT_READ)
+        deadline = time.monotonic() + timeout
+        lines = []
+        while time.monotonic() < deadline:
+            # select before readline so a wedged service can't block past
+            # the deadline
+            if not sel.select(timeout=min(1.0, deadline - time.monotonic())):
+                continue
+            line = p.stdout.readline()
+            if not line:
+                assert p.poll() is None, (
+                    f"service {args} exited rc={p.returncode}:\n" + "".join(lines))
+                time.sleep(0.05)
+                continue
+            lines.append(line)
+            m = re.search(ready_re, line)
+            if m:
+                return int(m.group(1))
+        raise AssertionError(f"service {args} never became ready:\n" + "".join(lines))
+
+    def teardown(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    r = PioRig(tmp_path)
+    yield r
+    r.teardown()
+
+
+def test_quickstart_recommendation(rig, tmp_path):
+    # 1. pio app new — parse the printed access key
+    out = rig.run("app", "new", "QuickApp").stdout
+    key = re.search(r"Access Key: (\S+)", out).group(1)
+    app_id = int(re.search(r"ID: (\d+)", out).group(1))
+    assert app_id >= 1
+    assert "QuickApp" in rig.run("app", "list").stdout
+
+    # 2. scaffold the Recommendation template into an engine dir
+    engine_dir = tmp_path / "MyRecommendation"
+    rig.run("template", "get", "recommendation", str(engine_dir),
+            "--app-name", "QuickApp")
+    assert (engine_dir / "engine.json").exists()
+    assert (engine_dir / "template.json").exists()
+
+    # 3. event server on a real socket
+    es_port = rig.serve("eventserver", "--ip", "127.0.0.1", "--port", "0",
+                        "--stats", ready_re=r"listening on 127\.0\.0\.1:(\d+)")
+    client = EventClient(access_key=key, url=f"http://127.0.0.1:{es_port}")
+
+    # 4. import ratings through the SDK (reference: data/import_eventserver.py):
+    #    10 users × deterministic subsets of 30 items
+    n_sent = 0
+    for u in range(1, 11):
+        for i in range(1, 31):
+            if (u * 7 + i * 3) % 4 == 0:
+                client.create_event(
+                    event="rate", entity_type="user", entity_id=str(u),
+                    target_entity_type="item", target_entity_id=str(i),
+                    properties={"rating": float((u + i) % 5 + 1)})
+                n_sent += 1
+    assert n_sent > 50
+    # REST read-back + stats contract
+    got = client.find_events(limit=-1)
+    assert len(got) == n_sent
+    stats = client.get_stats()
+    rated = [c for c in stats["counts"]
+             if c["event"] == "rate" and c["status"] == 201]
+    assert rated and rated[0]["count"] == n_sent
+
+    # 5. build (validate) then train in a subprocess, like spark-submit
+    rig.run("build", cwd=str(engine_dir))
+    out = rig.run("train", cwd=str(engine_dir)).stdout
+    assert "Training completed" in out
+
+    # 6. deploy on a real socket and query over HTTP
+    dp_port = rig.serve("deploy", "--ip", "127.0.0.1", "--port", "0",
+                        cwd=str(engine_dir),
+                        ready_re=r"deployed on 127\.0\.0\.1:(\d+)")
+    engine = EngineClient(url=f"http://127.0.0.1:{dp_port}")
+    result = engine.send_query({"user": "1", "num": 4})
+    assert len(result["itemScores"]) == 4
+    scores = [r["score"] for r in result["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+    # items are real item ids from the import
+    assert all(1 <= int(r["item"]) <= 30 for r in result["itemScores"])
+
+
+def test_eventserver_rest_conformance(rig):
+    """Subset of «eventserver_test.py» [U]: auth failures, batch endpoint,
+    channels, invalid-event validation — over a real socket."""
+    out = rig.run("app", "new", "ConfApp").stdout
+    key = re.search(r"Access Key: (\S+)", out).group(1)
+    rig.run("app", "channel-new", "ConfApp", "ch1")
+    port = rig.serve("eventserver", "--ip", "127.0.0.1", "--port", "0",
+                     ready_re=r"listening on 127\.0\.0\.1:(\d+)")
+    url = f"http://127.0.0.1:{port}"
+
+    import json
+    import urllib.error
+    import urllib.request
+
+    def post(path, body, expect_error=None):
+        req = urllib.request.Request(
+            url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            assert expect_error == e.code, f"{path}: unexpected {e.code}"
+            return e.code, None
+
+    # no access key → 401
+    post("/events.json", {"event": "a", "entityType": "t", "entityId": "1"},
+         expect_error=401)
+    # wrong access key → 401
+    post("/events.json?accessKey=wrong",
+         {"event": "a", "entityType": "t", "entityId": "1"}, expect_error=401)
+    # valid single event → 201
+    code, body = post(f"/events.json?accessKey={key}",
+                      {"event": "rate", "entityType": "user", "entityId": "u1"})
+    assert code == 201 and "eventId" in body
+    # invalid event (reserved prefix but not special) → 400
+    post(f"/events.json?accessKey={key}",
+         {"event": "$bogus", "entityType": "user", "entityId": "u1"},
+         expect_error=400)
+    # batch endpoint: per-row statuses
+    rows = [{"event": "view", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": str(i)}
+            for i in range(3)]
+    rows.append({"event": "bad"})  # missing entityType/Id → row-level 400
+    code, body = post(f"/batch/events.json?accessKey={key}", rows)
+    assert code == 200
+    assert [r["status"] for r in body] == [201, 201, 201, 400]
+    # channel routing: write into ch1, visible only there
+    client = EventClient(access_key=key, url=url, channel="ch1")
+    client.create_event(event="buy", entity_type="user", entity_id="u9")
+    assert len(client.find_events()) == 1
+    default_client = EventClient(access_key=key, url=url)
+    assert all(e["event"] != "buy" for e in default_client.find_events(limit=-1))
