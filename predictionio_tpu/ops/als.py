@@ -46,6 +46,11 @@ class Bucket:
     cols: np.ndarray  # [R, C] int32 — column ids, 0-padded
     vals: np.ndarray  # [R, C] float32 — values, 0-padded
     mask: np.ndarray  # [R, C] float32 — 1 where real
+    # [R] int32 segment map, only for buckets holding rows split by
+    # `bucket_ragged_split`: index into the split-row table for segment
+    # rows, == n_split (sentinel, dropped) for whole rows/padding. None
+    # for buckets with no segments.
+    segmap: Optional[np.ndarray] = None
 
     @property
     def cap(self) -> int:
@@ -109,6 +114,80 @@ def bucket_ragged(
     return buckets
 
 
+def bucket_ragged_split(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    row_multiple: int = 8,
+    split_cap: Optional[int] = None,
+) -> tuple[list[Bucket], np.ndarray]:
+    """`bucket_ragged`, but rows with more than `split_cap` entries are
+    **split into segments** instead of padding the whole matrix out to the
+    hottest row's capacity (SURVEY.md §7.3's padding-waste risk: one
+    pathological row would otherwise set the dense tile width for its
+    entire bucket — at ML-20M scale that is an OOM, not a slowdown).
+
+    Each segment becomes its own bucket row carrying the original row id
+    and a `segmap` entry pointing into the returned split-row table;
+    `_solve_buckets_device` sums the segments' partial normal equations
+    (A_r = Σ y_c y_cᵀ is associative over any partition of the row's
+    entries) before solving, so results are bit-comparable to the unsplit
+    math in f32 accumulation.
+
+    Returns (buckets, split_rows) where split_rows[u] is the original row
+    id of split-table slot u (empty array when nothing was split).
+    """
+    if split_cap is None or len(rows) == 0:
+        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple),
+                np.zeros(0, np.int32))
+    rows = np.asarray(rows, dtype=np.int32)
+    counts = np.bincount(rows, minlength=n_rows)
+    hot = np.nonzero(counts > split_cap)[0].astype(np.int32)
+    if hot.size == 0:
+        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple),
+                np.zeros(0, np.int32))
+
+    cols = np.asarray(cols, dtype=np.int32)
+    vals = np.asarray(vals, dtype=np.float32)
+    # rank of each entry within its row (stable order), so segments keep
+    # the caller's entry order
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    starts = np.concatenate(([0], np.cumsum(counts)))
+    rank = np.arange(len(rows_s), dtype=np.int64) - starts[rows_s]
+    seg = (rank // split_cap).astype(np.int64)
+
+    # pseudo-row numbering: hot row h's segment s → n_rows + base[h] + s
+    nseg = -(-counts[hot] // split_cap)
+    base = np.concatenate(([0], np.cumsum(nseg)))[:-1]
+    hot_slot = np.full(n_rows, -1, np.int64)
+    hot_slot[hot] = np.arange(hot.size)
+    is_hot = hot_slot[rows_s] >= 0
+    pseudo = n_rows + base[hot_slot[rows_s].clip(0)] + seg
+    rows2 = np.where(is_hot, pseudo, rows_s).astype(np.int32)
+    n_rows_eff = int(n_rows + nseg.sum())
+
+    buckets = bucket_ragged(rows2, cols_s, vals_s, n_rows_eff, row_multiple)
+
+    # map pseudo ids back: real row ids + segmap into the split table
+    pseudo_to_slot = np.repeat(hot_slot[hot], nseg).astype(np.int32)
+    for b in buckets:
+        is_pseudo = (b.rows >= n_rows) & (b.rows < n_rows_eff)
+        if not is_pseudo.any():
+            # plain bucket (padding sentinel n_rows_eff still needs fixing)
+            b.rows = np.where(b.rows >= n_rows, n_rows, b.rows).astype(np.int32)
+            continue
+        slot = np.where(
+            is_pseudo,
+            pseudo_to_slot[(b.rows - n_rows).clip(0, pseudo_to_slot.size - 1)],
+            hot.size).astype(np.int32)
+        real = np.where(is_pseudo, hot[slot.clip(0, hot.size - 1)], b.rows)
+        b.rows = np.where(real >= n_rows, n_rows, real).astype(np.int32)
+        b.segmap = slot
+    return buckets, hot
+
+
 @dataclasses.dataclass(frozen=True)
 class ALSConfig:
     """Frozen (hashable) so jitted solvers cache across als_train calls."""
@@ -140,6 +219,11 @@ class ALSConfig:
     #            ranks too large for gj/chol memory budgets
     solver: str = "auto"
     cg_iters: int = 0  # 0 = auto: rank//2 clamped to [8, 32]
+    # rows with more entries than this are split into segments whose
+    # partial normal equations are summed on device before solving
+    # (bucket_ragged_split): bounds the dense tile width a hot row can
+    # force on its bucket. Power of two; 0 disables splitting.
+    split_cap: int = 32768
     # Pallas fused gather+Gram kernel (ops/pallas_als.py). "off"/"auto":
     # XLA gather+einsum path (measured at parity with the kernel on v5e at
     # ML-20M-like density — auto stays conservative until the kernel wins);
@@ -148,14 +232,64 @@ class ALSConfig:
     pallas: str = "auto"
 
 
+# HBM budget for one bucket-chunk's [R, C, K] gathered-factor block; buckets
+# bigger than this are processed in row chunks via fori_loop so the gather
+# never materializes more than the budget (hot-row segments at ML-20M+ scale
+# would otherwise allocate tens of GB in one fusion)
+_CHUNK_BUDGET_BYTES = 1 << 30
+
+
+def _bucket_chunk_rows(r: int, c: int, k: int, row_multiple: int) -> int:
+    """Rows per chunk for a [r, c] bucket at rank k (== r when no chunking
+    is needed). Multiple of row_multiple so shards stay tile-aligned."""
+    per_row = c * k * 4
+    if r * per_row <= _CHUNK_BUDGET_BYTES:
+        return r
+    chunk = max(1, _CHUNK_BUDGET_BYTES // (per_row * row_multiple)) * row_multiple
+    return min(r, chunk)
+
+
+def _walk_bucket_chunks(arrays, cap: int, k: int, row_multiple: int, fn, carry):
+    """Fold `fn(sliced_arrays, carry) -> carry` over one bucket's rows.
+
+    Small buckets go through `fn` whole; oversized ones (per
+    `_bucket_chunk_rows`) are walked in row chunks under a fori_loop so the
+    [R, C, K] gathers inside `fn` never materialize past the budget.
+    `arrays` are per-row device arrays (None entries pass through as None);
+    put_buckets pads row counts to a chunk multiple with the SAME
+    (cap, k, row_multiple) arithmetic, which keeps the walk exact."""
+    import jax
+
+    r_total = arrays[0].shape[0]
+    chunk = _bucket_chunk_rows(r_total, cap, k, row_multiple)
+    if chunk >= r_total:
+        return fn(arrays, carry)
+
+    def body(i, c):
+        sliced = tuple(
+            None if a is None
+            else jax.lax.dynamic_slice_in_dim(a, i * chunk, chunk, 0)
+            for a in arrays)
+        return fn(sliced, c)
+
+    return jax.lax.fori_loop(0, r_total // chunk, body, carry)
+
+
 def _solve_buckets_device(
     opposing,  # [n_cols(+1 pad row), K] — gathered from
     out_rows: int,  # static: rows in the solved-for factor matrix
-    buckets_dev: Sequence[tuple],  # per bucket: (rows, cols, vals, mask)
+    buckets_dev: Sequence[tuple],  # per bucket: (rows, cols, vals, mask, segmap)
     cfg: ALSConfig,
+    split_rows=None,  # [U] int32 — row ids needing cross-segment combine
+    row_multiple: int = 8,
 ):
     """One half-epoch: solve every row's normal equations, scatter into a
-    fresh [out_rows, K] matrix. Pure jittable function of device arrays."""
+    fresh [out_rows, K] matrix. Pure jittable function of device arrays.
+
+    Rows split into segments (bucket_ragged_split) have their partial
+    (A, b, n) scatter-added into a [U, ...] accumulator keyed by segmap and
+    are solved once after the bucket loop; oversized buckets are walked in
+    row chunks under a fori_loop to bound live gather memory."""
     import jax.numpy as jnp
 
     import jax
@@ -163,8 +297,12 @@ def _solve_buckets_device(
     from predictionio_tpu.ops import pallas_als
 
     k = opposing.shape[-1]
-    eye = jnp.eye(k, dtype=opposing.dtype)
     new = jnp.zeros((out_rows, k), dtype=opposing.dtype)
+    n_split = 0 if split_rows is None else split_rows.shape[0]
+    if n_split:
+        acc_a = jnp.zeros((n_split, k, k), dtype=jnp.float32)
+        acc_b = jnp.zeros((n_split, k), dtype=jnp.float32)
+        acc_n = jnp.zeros((n_split,), dtype=jnp.float32)
 
     use_pallas = cfg.pallas in ("on", "interpret")
     interpret = cfg.pallas == "interpret"
@@ -207,71 +345,107 @@ def _solve_buckets_device(
         return jnp.linalg.solve(a, b[..., None])[..., 0]
 
     if cfg.implicit:
-        # global Gram over real (non-sentinel-pad) opposing rows
+        # global Gram over real (non-sentinel-pad) opposing rows (f32: it
+        # is summed into per-row partials that may accumulate across
+        # segments)
         op_c = opposing.astype(cdtype)
         gram = jnp.einsum("ck,cl->kl", op_c, op_c,
-                          preferred_element_type=f32).astype(opposing.dtype)
+                          preferred_element_type=f32)
 
-    for rows, cols, vals, mask in buckets_dev:
-        n = mask.sum(-1)
+    def partial_gram(cols_c, vals_c, mask_c):
+        """Raw per-row partial normal equations (no global Gram, no reg):
+        associative over any split of a row's entries, f32."""
         if use_pallas:
             # fused gather + weighted Gram/RHS (see ops/pallas_als.py)
             if cfg.implicit:
-                wa = cfg.alpha * vals
-                wb = (1.0 + cfg.alpha * vals) * mask
+                wa = cfg.alpha * vals_c
+                wb = (1.0 + cfg.alpha * vals_c) * mask_c
             else:
-                wa = mask
-                wb = vals
-            a, b = pallas_als.gram_rhs(opposing, cols, wa, wb,
+                wa = mask_c
+                wb = vals_c
+            a, b = pallas_als.gram_rhs(opposing, cols_c, wa, wb,
                                        interpret=interpret)
-            if cfg.implicit:
-                a = a + gram[None]
+            return a.astype(f32), b.astype(f32)
+        y = opposing[cols_c]  # [R, C, K] gather
+        ym = (y * mask_c[..., None]).astype(cdtype)
+        yc = y.astype(cdtype)
+        if cfg.implicit:
+            conf = cfg.alpha * vals_c  # C - I, zero at padding
+            a = jnp.einsum("rck,rc,rcl->rkl", ym, conf.astype(cdtype), ym,
+                           preferred_element_type=f32)
+            b = jnp.einsum("rck,rc->rk", ym, (1.0 + conf).astype(cdtype),
+                           preferred_element_type=f32)
         else:
-            y = opposing[cols]  # [R, C, K] gather
-            ym = (y * mask[..., None]).astype(cdtype)
-            yc = y.astype(cdtype)
-            if cfg.implicit:
-                conf = cfg.alpha * vals  # C - I, zero at padding
-                a = gram[None] + jnp.einsum(
-                    "rck,rc,rcl->rkl", ym, conf.astype(cdtype), ym,
-                    preferred_element_type=f32)
-                b = jnp.einsum("rck,rc->rk", ym,
-                               (1.0 + conf).astype(cdtype),
-                               preferred_element_type=f32)
-            else:
-                a = jnp.einsum("rck,rcl->rkl", ym, yc,
-                               preferred_element_type=f32)
-                b = jnp.einsum("rck,rc->rk", ym, vals.astype(cdtype),
-                               preferred_element_type=f32)
-        a = a.astype(opposing.dtype)
-        b = b.astype(opposing.dtype)
+            a = jnp.einsum("rck,rcl->rkl", ym, yc,
+                           preferred_element_type=f32)
+            b = jnp.einsum("rck,rc->rk", ym, vals_c.astype(cdtype),
+                           preferred_element_type=f32)
+        return a, b
+
+    def finalize(a, b, n):
+        """Partial (A, b, n) → solved factors (adds Gram/reg, f32 → dtype)."""
+        if cfg.implicit:
+            a = a + gram[None]
         reg = cfg.reg * (n if cfg.weighted_reg else jnp.ones_like(n))
-        a = a + reg[:, None, None] * eye[None]
-        x = solve_spd(a, b)
+        a = (a + reg[:, None, None] * jnp.eye(k, dtype=f32)[None])
+        return solve_spd(a.astype(opposing.dtype), b.astype(opposing.dtype))
+
+    def process(rows_c, cols_c, vals_c, mask_c, segmap_c, new, accs):
+        n = mask_c.sum(-1)
+        a, b = partial_gram(cols_c, vals_c, mask_c)
+        rows_eff = rows_c
+        if segmap_c is not None:
+            acc_a, acc_b, acc_n = accs
+            accs = (acc_a.at[segmap_c].add(a, mode="drop"),
+                    acc_b.at[segmap_c].add(b, mode="drop"),
+                    acc_n.at[segmap_c].add(n, mode="drop"))
+            # segment rows are combined+solved after the loop; drop their
+            # inline (partial) solutions from the scatter
+            rows_eff = jnp.where(segmap_c < n_split, out_rows, rows_c)
+        x = finalize(a, b, n)
         # sentinel row ids (== out_rows) fall outside and are dropped
-        new = new.at[rows].set(x, mode="drop")
+        new = new.at[rows_eff].set(x.astype(new.dtype), mode="drop")
+        return new, accs
+
+    accs = (acc_a, acc_b, acc_n) if n_split else ()
+    for bucket in buckets_dev:
+        cap = bucket[1].shape[1]
+        new, accs = _walk_bucket_chunks(
+            bucket, cap, k, row_multiple,
+            lambda sliced, carry: process(*sliced, *carry), (new, accs))
+
+    if n_split:
+        x_u = finalize(*accs)
+        new = new.at[split_rows].set(x_u.astype(new.dtype), mode="drop")
     return new
 
 
-def _predict_sq_err(u_factors, i_factors, buckets_dev):
+def _predict_sq_err(u_factors, i_factors, buckets_dev, row_multiple: int = 8):
     """Σ (uᵀv − r)² over all real entries (for RMSE history)."""
     import jax.numpy as jnp
 
+    def err_chunk(sliced, carry):
+        rows_c, cols_c, vals_c, mask_c, _segmap = sliced
+        total, count = carry
+        u = u_factors[rows_c.clip(0, u_factors.shape[0] - 1)]  # [R, K]
+        v = i_factors[cols_c]  # [R, C, K]
+        pred = jnp.einsum("rk,rck->rc", u, v)
+        err = (pred - vals_c) * mask_c
+        return total + jnp.sum(err * err), count + jnp.sum(mask_c)
+
+    k = u_factors.shape[-1]
     total = jnp.zeros((), dtype=jnp.float32)
     count = jnp.zeros((), dtype=jnp.float32)
-    for rows, cols, vals, mask in buckets_dev:
-        u = u_factors[rows.clip(0, u_factors.shape[0] - 1)]  # [R, K]
-        v = i_factors[cols]  # [R, C, K]
-        pred = jnp.einsum("rk,rck->rc", u, v)
-        err = (pred - vals) * mask
-        total = total + jnp.sum(err * err)
-        count = count + jnp.sum(mask)
+    for bucket in buckets_dev:
+        cap = bucket[1].shape[1]
+        total, count = _walk_bucket_chunks(bucket, cap, k, row_multiple,
+                                           err_chunk, (total, count))
     return total, count
 
 
 @functools.lru_cache(maxsize=64)
 def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
-                    compute_rmse: bool, n_steps: int):
+                    compute_rmse: bool, n_steps: int, row_multiple: int = 8):
     """`n_steps` iterations of training as ONE jitted program: `lax.scan`
     over iterations, so a train is a single dispatch with no host round
     trips (under `jit` everything is traced once and compiled — SURVEY.md
@@ -282,13 +456,16 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
     import jax
     import jax.numpy as jnp
 
-    def run(item_factors0, user_factors0, ub_dev, ib_dev):
+    def run(item_factors0, user_factors0, ub_dev, ib_dev, u_split, i_split):
         def body(carry, _):
             user_f, item_f = carry
-            user_f = _solve_buckets_device(item_f, n_users, ub_dev, cfg)
-            item_f = _solve_buckets_device(user_f, n_items, ib_dev, cfg)
+            user_f = _solve_buckets_device(item_f, n_users, ub_dev, cfg,
+                                           u_split, row_multiple)
+            item_f = _solve_buckets_device(user_f, n_items, ib_dev, cfg,
+                                           i_split, row_multiple)
             if compute_rmse:
-                total, count = _predict_sq_err(user_f, item_f, ub_dev)
+                total, count = _predict_sq_err(user_f, item_f, ub_dev,
+                                               row_multiple)
                 rmse = jnp.sqrt(jnp.maximum(total, 0.0) / jnp.maximum(count, 1.0))
             else:
                 rmse = jnp.zeros((), dtype=jnp.float32)
@@ -386,31 +563,55 @@ def als_train(
                         jax.default_backend())
             cfg = dataclasses.replace(cfg, solver="chol")
 
-    user_buckets = bucket_ragged(user_idx, item_idx, ratings, n_users, row_multiple)
-    item_buckets = bucket_ragged(item_idx, user_idx, ratings, n_items, row_multiple)
+    split_cap = cfg.split_cap if cfg.split_cap > 0 else None
+    user_buckets, u_split = bucket_ragged_split(
+        user_idx, item_idx, ratings, n_users, row_multiple, split_cap)
+    item_buckets, i_split = bucket_ragged_split(
+        item_idx, user_idx, ratings, n_items, row_multiple, split_cap)
     log.info(
-        "als_train: %d ratings, %d users (%d buckets, caps %s), %d items "
-        "(%d buckets, caps %s), rank %d, mesh %s",
+        "als_train: %d ratings, %d users (%d buckets, caps %s, %d split), "
+        "%d items (%d buckets, caps %s, %d split), rank %d, mesh %s",
         len(ratings), n_users, len(user_buckets),
-        [b.cap for b in user_buckets], n_items, len(item_buckets),
-        [b.cap for b in item_buckets], cfg.rank, dict(mesh.shape),
+        [b.cap for b in user_buckets], len(u_split), n_items,
+        len(item_buckets), [b.cap for b in item_buckets], len(i_split),
+        cfg.rank, dict(mesh.shape),
     )
 
     dtype = jnp.dtype(cfg.dtype)
     row_shard = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
 
-    def put_buckets(buckets: list[Bucket]):
+    def put_buckets(buckets: list[Bucket], n_rows: int, n_split: int):
         out = []
         for b in buckets:
+            r_total, cap = b.cols.shape
+            # pad rows to a chunk multiple so the fori_loop chunk walk in
+            # _solve_buckets_device covers the whole bucket exactly
+            chunk = _bucket_chunk_rows(r_total, cap, cfg.rank, row_multiple)
+            pad = (-r_total) % chunk
+            arrs = dict(rows=b.rows, cols=b.cols, vals=b.vals, mask=b.mask,
+                        segmap=b.segmap)
+            if pad:
+                arrs["rows"] = np.concatenate(
+                    [b.rows, np.full(pad, n_rows, np.int32)])
+                for name in ("cols", "vals", "mask"):
+                    a = arrs[name]
+                    arrs[name] = np.concatenate(
+                        [a, np.zeros((pad, cap), a.dtype)])
+                if b.segmap is not None:
+                    arrs["segmap"] = np.concatenate(
+                        [b.segmap, np.full(pad, n_split, np.int32)])
             out.append(tuple(
-                jax.device_put(arr, row_shard)
-                for arr in (b.rows, b.cols, b.vals, b.mask)
+                None if arrs[name] is None
+                else jax.device_put(arrs[name], row_shard)
+                for name in ("rows", "cols", "vals", "mask", "segmap")
             ))
         return out
 
-    ub_dev = put_buckets(user_buckets)
-    ib_dev = put_buckets(item_buckets)
+    ub_dev = put_buckets(user_buckets, n_users, len(u_split))
+    ib_dev = put_buckets(item_buckets, n_items, len(i_split))
+    u_split_dev = jax.device_put(u_split, rep)
+    i_split_dev = jax.device_put(i_split, rep)
 
     # init item factors ~ N(0, 1/sqrt(rank)) like MLlib; users solved first
     key = jax.random.key(cfg.seed)
@@ -496,9 +697,10 @@ def als_train(
         # n_steps) so runs differing in iteration count share the compile
         train = _get_train_loop(n_users, n_items,
                                 dataclasses.replace(cfg, iterations=0),
-                                compute_rmse, n_steps)
+                                compute_rmse, n_steps, row_multiple)
         user_factors, item_factors, rmses = train(item_factors, user_factors,
-                                                  ub_dev, ib_dev)
+                                                  ub_dev, ib_dev,
+                                                  u_split_dev, i_split_dev)
         # a scalar readback is the reliable execution fence on this platform
         # (block_until_ready can return early behind the axon tunnel)
         float(item_factors[0, 0])

@@ -168,6 +168,9 @@ class ALSAlgorithmParams(Params):
     alpha: float = 1.0
     seed: Optional[int] = None
     computeRMSE: bool = False
+    # hot rows with more ratings than this train as summed segments
+    # (ops/als.py bucket_ragged_split); 0 disables
+    splitCap: int = 32768
 
     _ALIASES = {"lambda": "lambda_"}
 
@@ -190,6 +193,7 @@ class ALSAlgorithm(Algorithm):
             implicit=p.implicitPrefs,
             alpha=p.alpha,
             seed=ctx.seed if p.seed is None else p.seed,
+            split_cap=p.splitCap,
         )
         result = als_train(
             pd.user_idx, pd.item_idx, pd.ratings,

@@ -548,7 +548,27 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import logging
+    import os
+
     args = build_parser().parse_args(argv)
+    # Wire log levels like the reference's `pio --verbose` / log4j.properties
+    # (SURVEY.md §5): WARNING by default, INFO at --verbose 1, DEBUG at ≥2;
+    # PIO_LOG_LEVEL overrides (e.g. PIO_LOG_LEVEL=INFO for services, which
+    # have no --verbose flag).
+    verbose = getattr(args, "verbose", 0)
+    name = os.environ.get(
+        "PIO_LOG_LEVEL",
+        "DEBUG" if verbose >= 2 else "INFO" if verbose == 1 else "WARNING"
+    ).upper()
+    levels = {"CRITICAL": logging.CRITICAL, "FATAL": logging.CRITICAL,
+              "ERROR": logging.ERROR, "WARNING": logging.WARNING,
+              "WARN": logging.WARNING, "INFO": logging.INFO,
+              "DEBUG": logging.DEBUG, "NOTSET": logging.NOTSET}
+    level = int(name) if name.isdigit() else levels.get(name, logging.WARNING)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     return args.func(args)
 
 

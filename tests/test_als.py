@@ -219,3 +219,101 @@ class TestReviewRegressions:
         # empty-dict exclude also takes the unmasked path
         s, idx = recommend_topk(u, v, np.asarray([0]), 2, exclude={})
         assert idx[0].tolist() == [0, 1]
+
+
+class TestHotRowSplitting:
+    """bucket_ragged_split + segment accumulation: hot rows are split into
+    bounded segments whose partial normal equations are summed pre-solve,
+    so results match the unsplit math (SURVEY.md §7.3 padding-waste risk)."""
+
+    def _skewed(self, seed=0, n_users=40, n_items=25):
+        # user 0 rates every item 4x epochs... make user 0 and item 0 hot
+        rng = np.random.default_rng(seed)
+        ui, ii, r, _ = synth_ratings(n_users=n_users, n_items=n_items,
+                                     seed=seed, density=0.4)
+        return ui, ii, r
+
+    def test_split_table_and_coverage(self):
+        from predictionio_tpu.ops.als import bucket_ragged_split
+
+        ui, ii, r = self._skewed()
+        n_entries = len(r)
+        buckets, split = bucket_ragged_split(ui, ii, r, 40, 8, split_cap=8)
+        # every real entry appears exactly once across buckets
+        assert sum(int(b.mask.sum()) for b in buckets) == n_entries
+        counts = np.bincount(ui, minlength=40)
+        assert set(split) == set(np.nonzero(counts > 8)[0])
+        # no bucket is wider than the split cap (pow2 of it)
+        assert max(b.cap for b in buckets) <= 8
+        # segment rows carry real row ids and valid segmap slots
+        for b in buckets:
+            if b.segmap is None:
+                continue
+            seg = b.segmap < len(split)
+            assert np.all(np.isin(b.rows[seg], split))
+        # reconstruct per-row entry multisets
+        got = {}
+        for b in buckets:
+            for rr, cc, vv, mm in zip(b.rows, b.cols, b.vals, b.mask):
+                for c, v, m in zip(cc, vv, mm):
+                    if m:
+                        got.setdefault(int(rr), []).append((int(c), float(v)))
+        want = {}
+        for u, i, v in zip(ui, ii, r):
+            want.setdefault(int(u), []).append((int(i), float(v)))
+        assert {k: sorted(vs) for k, vs in got.items()} == \
+               {k: sorted(vs) for k, vs in want.items()}
+
+    def test_split_nothing_when_under_cap(self):
+        from predictionio_tpu.ops.als import bucket_ragged_split
+
+        ui, ii, r = self._skewed()
+        buckets, split = bucket_ragged_split(ui, ii, r, 40, 8,
+                                             split_cap=1 << 20)
+        assert len(split) == 0
+        assert all(b.segmap is None for b in buckets)
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_split_factors_match_unsplit(self, implicit):
+        ui, ii, r = self._skewed(seed=3)
+        base = ALSConfig(rank=5, iterations=4, reg=0.05, seed=1,
+                         implicit=implicit, split_cap=0)
+        split = dataclasses.replace(base, split_cap=8)
+        out_u = als_train(ui, ii, r, 40, 25, base, compute_rmse=True)
+        out_s = als_train(ui, ii, r, 40, 25, split, compute_rmse=True)
+        np.testing.assert_allclose(out_s.user_factors, out_u.user_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(out_s.item_factors, out_u.item_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(out_s.rmse_history, out_u.rmse_history,
+                                   rtol=1e-4)
+
+    def test_chunked_bucket_walk_matches(self, monkeypatch):
+        from predictionio_tpu.ops import als as als_mod
+
+        ui, ii, r = self._skewed(seed=5)
+        cfg = ALSConfig(rank=5, iterations=3, reg=0.05, seed=2)
+        out_full = als_train(ui, ii, r, 40, 25, cfg, compute_rmse=True)
+        # force the fori_loop row-chunk path for every bucket
+        monkeypatch.setattr(als_mod, "_CHUNK_BUDGET_BYTES", 1 << 12)
+        als_mod._get_train_loop.cache_clear()
+        out_chunk = als_train(ui, ii, r, 40, 25, cfg, compute_rmse=True)
+        als_mod._get_train_loop.cache_clear()
+        np.testing.assert_allclose(out_chunk.user_factors, out_full.user_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(out_chunk.rmse_history, out_full.rmse_history,
+                                   rtol=1e-4)
+
+    def test_split_with_chunking_combined(self, monkeypatch):
+        from predictionio_tpu.ops import als as als_mod
+
+        ui, ii, r = self._skewed(seed=7)
+        base = ALSConfig(rank=4, iterations=3, reg=0.05, seed=3, split_cap=0)
+        out_ref = als_train(ui, ii, r, 40, 25, base)
+        monkeypatch.setattr(als_mod, "_CHUNK_BUDGET_BYTES", 1 << 12)
+        als_mod._get_train_loop.cache_clear()
+        out = als_train(ui, ii, r, 40, 25,
+                        dataclasses.replace(base, split_cap=8))
+        als_mod._get_train_loop.cache_clear()
+        np.testing.assert_allclose(out.user_factors, out_ref.user_factors,
+                                   rtol=2e-4, atol=2e-5)
