@@ -38,6 +38,128 @@ def synth_ml100k():
     return ui, ii, r
 
 
+def bench_serving():
+    """Predict QPS + p50 through the real prediction-server HTTP stack
+    (BASELINE.json tracked metrics). Full loop: events → train via the
+    workflow → PredictionServer on a real socket → concurrent keep-alive
+    clients. Prints one JSON line; run with `bench.py --serving`."""
+    import http.client
+    import statistics
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        PredictionServer, ServerConfig,
+    )
+    from predictionio_tpu.workflow.create_workflow import run_train
+
+    src = SourceConfig(name="BENCH", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src, eventdata=src))
+    Storage.reset(storage)
+    app_id = storage.meta_apps().insert(App(id=0, name="BenchApp"))
+
+    rng = np.random.default_rng(7)
+    n_users, n_items, n_events = 943, 1682, 20_000
+    events = storage.l_events()
+    for u, i, v in zip(rng.integers(0, n_users, n_events),
+                       rng.zipf(1.3, n_events) % n_items,
+                       rng.integers(1, 6, n_events)):
+        events.insert(Event(event="rate", entity_type="user",
+                            entity_id=str(u), target_entity_type="item",
+                            target_entity_id=str(i),
+                            properties=DataMap({"rating": float(v)})),
+                      app_id=app_id)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        engine_json = os.path.join(tmp, "engine.json")
+        with open(engine_json, "w") as f:
+            json.dump({
+                "id": "bench", "engineFactory":
+                    "predictionio_tpu.templates.recommendation."
+                    "RecommendationEngine",
+                "datasource": {"params": {"appName": "BenchApp"}},
+                "algorithms": [{"name": "als", "params":
+                                {"rank": RANK, "numIterations": 10,
+                                 "lambda": 0.05, "seed": 1}}],
+            }, f)
+        run_train(engine_json=engine_json)
+
+    server = PredictionServer(ServerConfig(
+        ip="127.0.0.1", port=0, engine_id="bench", engine_variant="bench"))
+    server.start()
+    port = server.port
+
+    payloads = [json.dumps({"user": str(u), "num": 10}).encode()
+                for u in rng.integers(0, n_users, 512)]
+    stop = threading.Event()
+    latencies: list[list[float]] = []
+    errors: list[BaseException] = []
+
+    def client(lat_out):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            j = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                conn.request("POST", "/queries.json",
+                             payloads[j % len(payloads)],
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(f"HTTP {resp.status}: {body[:200]!r}")
+                lat_out.append(time.perf_counter() - t0)
+                j += 1
+            conn.close()
+        except BaseException as e:  # surface instead of deflating QPS
+            errors.append(e)
+            stop.set()
+
+    n_threads, duration_s = 8, 5.0
+    # warm-up (fills caches, primes thread pool)
+    t_end = time.time() + 1.0
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    while time.time() < t_end:
+        conn.request("POST", "/queries.json", payloads[0],
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+    conn.close()
+
+    threads = []
+    for _ in range(n_threads):
+        lat: list[float] = []
+        latencies.append(lat)
+        threads.append(threading.Thread(target=client, args=(lat,)))
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise SystemExit(f"serving bench failed: {errors[0]}")
+    all_lat = sorted(x for lat in latencies for x in lat)
+    qps = len(all_lat) / wall
+    p50 = statistics.median(all_lat)
+    server.shutdown()
+    print(json.dumps({
+        "metric": "predict_qps_ml100k_rank10",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "p50_ms": round(p50 * 1e3, 2),
+        "concurrency": n_threads,
+        "vs_baseline": None,
+    }))
+
+
 def main():
     from predictionio_tpu.ops.als import ALSConfig, als_train
 
@@ -67,4 +189,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--serving" in sys.argv:
+        bench_serving()
+    else:
+        main()

@@ -26,11 +26,10 @@ import sqlite3
 import threading
 import time
 from collections import Counter
-from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
-from predictionio_tpu.utils.http import HttpService
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 from predictionio_tpu.data.events import (
     Event,
@@ -76,26 +75,16 @@ class EventServerConfig:
         self.stats = stats
 
 
-class _EventHandler(BaseHTTPRequestHandler):
+class _EventHandler(JsonRequestHandler):
     server_version = "pio-tpu-eventserver/0.1"
-    protocol_version = "HTTP/1.1"
 
     # injected by create_event_server
     storage: Storage
     stats: Optional[Stats]
     plugins = None  # Optional[PluginRegistry]
 
-    def log_message(self, fmt, *args):  # silence default stderr chatter
-        pass
-
     # -- helpers -----------------------------------------------------------
-    def _send_json(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    _send_json = JsonRequestHandler.send_json
 
     def _query(self) -> dict[str, str]:
         qs = parse_qs(urlparse(self.path).query)

@@ -53,7 +53,11 @@ class BiMap(Generic[K, V]):
     __contains__ = contains
 
     def inverse(self) -> "BiMap[V, K]":
-        return BiMap(self._inv)
+        inv = getattr(self, "_inverse_bimap", None)
+        if inv is None:
+            inv = BiMap(self._inv)
+            self._inverse_bimap = inv  # serving hot path calls per query
+        return inv
 
     def to_index(self, keys: Sequence[K]) -> np.ndarray:
         """Vectorized forward lookup → int32 array (raises on unknown key)."""

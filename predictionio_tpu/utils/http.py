@@ -17,6 +17,10 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     """Base handler: JSON responses, silenced access log, body drain."""
 
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: headers and body go out in separate send()s; with Nagle
+    # on, the body waits for the client's delayed ACK (~40 ms per request
+    # on loopback keep-alive connections)
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):
         pass

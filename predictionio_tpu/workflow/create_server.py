@@ -21,11 +21,10 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
 
 from predictionio_tpu.plugins import PluginRejection
-from predictionio_tpu.utils.http import HttpService
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
@@ -119,20 +118,10 @@ class PredictionServer(HttpService):
         self._state_lock = threading.Lock()
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        class Handler(JsonRequestHandler):
             server_version = "pio-tpu-server/0.1"
 
-            def log_message(self, fmt, *args):
-                pass
-
-            def _send(self, code: int, payload: Any) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+            _send = JsonRequestHandler.send_json
 
             def do_GET(self):
                 state = server._state
