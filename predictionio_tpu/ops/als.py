@@ -205,11 +205,12 @@ class ALSConfig:
     # equations well-conditioned); "float32" for bit-stable results.
     compute_dtype: str = "float32"
     # normal-equation solver:
-    #   "auto" — "gj" on a single TPU device when applicable, else "chol"
+    #   "auto" — "gj" on TPU when the rank fits its VMEM budget, else "chol"
     #   "gj"   — Pallas batched Gauss-Jordan (ops/pallas_solve.py): the
     #            batched Cholesky custom-call dominates rank-64 epochs
     #            (~66% of device time, v5e profile) and the kernel solves
-    #            the same systems ~3.4× faster; single-device TPU only
+    #            the same systems ~3.4× faster; under a multi-device mesh
+    #            it runs shard_mapped, one kernel per device row shard
     #   "chol" — Cholesky (A is SPD by construction — λ>0 — and two
     #            triangular solves beat LU by ~30% on v5e)
     #   "lu"   — jnp.linalg.solve
@@ -282,6 +283,7 @@ def _solve_buckets_device(
     cfg: ALSConfig,
     split_rows=None,  # [U] int32 — row ids needing cross-segment combine
     row_multiple: int = 8,
+    mesh=None,  # enables the sharded Pallas solve when size > 1
 ):
     """One half-epoch: solve every row's normal equations, scatter into a
     fresh [out_rows, K] matrix. Pure jittable function of device arrays.
@@ -304,24 +306,52 @@ def _solve_buckets_device(
         acc_b = jnp.zeros((n_split, k), dtype=jnp.float32)
         acc_n = jnp.zeros((n_split,), dtype=jnp.float32)
 
-    use_pallas = cfg.pallas in ("on", "interpret")
+    # gather+Gram kernel: single-device only (not shard_mapped; the solver
+    # kernel below IS, so cfg.pallas="interpret" may arrive with a mesh)
+    use_pallas = (cfg.pallas in ("on", "interpret")
+                  and (mesh is None or mesh.size == 1))
     interpret = cfg.pallas == "interpret"
     cdtype = jnp.dtype(cfg.compute_dtype)
     f32 = jnp.float32
 
-    def solve_spd(a, b):
+    def chol_solve(a, b):
+        chol = jnp.linalg.cholesky(a)
+        y1 = jax.lax.linalg.triangular_solve(
+            chol, b[..., None], left_side=True, lower=True)
+        return jax.lax.linalg.triangular_solve(
+            chol, y1, left_side=True, lower=True,
+            transpose_a=True)[..., 0]
+
+    def solve_spd(a, b, row_sharded=True):
         if cfg.solver == "gj":
             from predictionio_tpu.ops import pallas_solve
 
+            if mesh is not None and mesh.size > 1:
+                if not row_sharded:
+                    # the [U] split-accumulator batch is not a multiple of
+                    # the data axis; U is tiny, so chol is fine here
+                    return chol_solve(a, b)
+                # pallas_call is a single-device program GSPMD can't
+                # partition; shard_map runs one kernel per device on its
+                # local row shard (rows are bucketed to multiples of the
+                # data-axis size, so shards are even)
+                from predictionio_tpu.parallel.mesh import DATA_AXIS
+                from jax.sharding import PartitionSpec as P
+
+                spec = P(DATA_AXIS)  # als_train requires a 'data' axis
+                solve = jax.shard_map(
+                    lambda a_, b_: pallas_solve.gj_solve(
+                        a_, b_, interpret=interpret),
+                    mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+                    # pallas_call out_shape carries no varying-mesh-axes
+                    # info; the kernel is elementwise over rows, so the
+                    # replication check adds nothing here
+                    check_vma=False)
+                return solve(a.astype(f32), b.astype(f32)).astype(a.dtype)
             return pallas_solve.gj_solve(a.astype(f32), b.astype(f32),
                                          interpret=interpret).astype(a.dtype)
         if cfg.solver == "chol":
-            chol = jnp.linalg.cholesky(a)
-            y1 = jax.lax.linalg.triangular_solve(
-                chol, b[..., None], left_side=True, lower=True)
-            return jax.lax.linalg.triangular_solve(
-                chol, y1, left_side=True, lower=True,
-                transpose_a=True)[..., 0]
+            return chol_solve(a, b)
         if cfg.solver == "cg":
             iters = cfg.cg_iters or max(8, min(32, k // 2))
             # Jacobi-preconditioned CG: all matvecs, MXU/VPU-only
@@ -382,13 +412,14 @@ def _solve_buckets_device(
                            preferred_element_type=f32)
         return a, b
 
-    def finalize(a, b, n):
+    def finalize(a, b, n, row_sharded=True):
         """Partial (A, b, n) → solved factors (adds Gram/reg, f32 → dtype)."""
         if cfg.implicit:
             a = a + gram[None]
         reg = cfg.reg * (n if cfg.weighted_reg else jnp.ones_like(n))
         a = (a + reg[:, None, None] * jnp.eye(k, dtype=f32)[None])
-        return solve_spd(a.astype(opposing.dtype), b.astype(opposing.dtype))
+        return solve_spd(a.astype(opposing.dtype), b.astype(opposing.dtype),
+                         row_sharded)
 
     def process(rows_c, cols_c, vals_c, mask_c, segmap_c, new, accs):
         n = mask_c.sum(-1)
@@ -415,7 +446,7 @@ def _solve_buckets_device(
             lambda sliced, carry: process(*sliced, *carry), (new, accs))
 
     if n_split:
-        x_u = finalize(*accs)
+        x_u = finalize(*accs, row_sharded=False)
         new = new.at[split_rows].set(x_u.astype(new.dtype), mode="drop")
     return new
 
@@ -445,7 +476,8 @@ def _predict_sq_err(u_factors, i_factors, buckets_dev, row_multiple: int = 8):
 
 @functools.lru_cache(maxsize=64)
 def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
-                    compute_rmse: bool, n_steps: int, row_multiple: int = 8):
+                    compute_rmse: bool, n_steps: int, row_multiple: int = 8,
+                    mesh=None):
     """`n_steps` iterations of training as ONE jitted program: `lax.scan`
     over iterations, so a train is a single dispatch with no host round
     trips (under `jit` everything is traced once and compiled — SURVEY.md
@@ -460,9 +492,9 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
         def body(carry, _):
             user_f, item_f = carry
             user_f = _solve_buckets_device(item_f, n_users, ub_dev, cfg,
-                                           u_split, row_multiple)
+                                           u_split, row_multiple, mesh)
             item_f = _solve_buckets_device(user_f, n_items, ib_dev, cfg,
-                                           i_split, row_multiple)
+                                           i_split, row_multiple, mesh)
             if compute_rmse:
                 total, count = _predict_sq_err(user_f, item_f, ub_dev,
                                                row_multiple)
@@ -528,17 +560,23 @@ def als_train(
         mesh = make_mesh()
     n_data = mesh.shape.get(DATA_AXIS, 1)
     row_multiple = max(8, n_data)
+    if row_multiple % n_data:  # non-power-of-two data axis: keep shards even
+        row_multiple = 8 * n_data
 
-    if mesh.size > 1 and cfg.pallas != "off":
-        # the Pallas kernel is a single-device program; under a real mesh
-        # the buckets are sharded and GSPMD can't partition a pallas_call —
-        # stay on the XLA gather+einsum path (which it shards fine)
+    if mesh.size > 1 and cfg.pallas == "on":
+        # the fused gather+Gram kernel is a single-device program; under a
+        # real mesh the buckets are sharded and GSPMD can't partition a
+        # pallas_call — stay on the XLA gather+einsum path (which it
+        # shards fine). "interpret" is kept: it still selects the
+        # interpret-mode SOLVER kernel (shard_mapped per device), while
+        # the gather kernel is disabled mesh-aware in
+        # _solve_buckets_device.
         cfg = dataclasses.replace(cfg, pallas="off")
     if cfg.solver == "auto":
         from predictionio_tpu.ops import pallas_solve
 
         on_tpu = jax.default_backend() == "tpu"
-        use_gj = (mesh.size == 1 and pallas_solve.gj_applicable(cfg.rank)
+        use_gj = (pallas_solve.gj_applicable(cfg.rank)
                   and (on_tpu or cfg.pallas == "interpret"))
         cfg = dataclasses.replace(cfg, solver="gj" if use_gj else "chol")
         log.info("als_train: solver='auto' resolved to %r (mesh.size=%d, "
@@ -547,13 +585,7 @@ def als_train(
     elif cfg.solver == "gj":
         from predictionio_tpu.ops import pallas_solve
 
-        if mesh.size > 1:
-            # same GSPMD limitation as the gather kernel above
-            log.warning("als_train: solver='gj' is single-device; "
-                        "falling back to 'chol' under a %d-device mesh",
-                        mesh.size)
-            cfg = dataclasses.replace(cfg, solver="chol")
-        elif not pallas_solve.gj_applicable(cfg.rank):
+        if not pallas_solve.gj_applicable(cfg.rank):
             log.warning("als_train: solver='gj' rank %d exceeds the VMEM "
                         "budget; falling back to 'chol'", cfg.rank)
             cfg = dataclasses.replace(cfg, solver="chol")
@@ -697,7 +729,8 @@ def als_train(
         # n_steps) so runs differing in iteration count share the compile
         train = _get_train_loop(n_users, n_items,
                                 dataclasses.replace(cfg, iterations=0),
-                                compute_rmse, n_steps, row_multiple)
+                                compute_rmse, n_steps, row_multiple,
+                                mesh if mesh.size > 1 else None)
         user_factors, item_factors, rmses = train(item_factors, user_factors,
                                                   ub_dev, ib_dev,
                                                   u_split_dev, i_split_dev)
