@@ -11,13 +11,14 @@
 // fallback. Output is bit-identical to the Python path:
 //   - buckets ordered by ascending capacity (power-of-two, >= min_cap)
 //   - rows within a bucket ordered by ascending row id
-//   - entries within a row in original (stable) order, truncated to
-//     max_cap keeping the first entries
+//   - entries within a row sorted by column id (stable; truncation to
+//     max_cap keeps the first entries in original order, then sorts)
 //   - row count padded to a multiple of row_multiple with sentinel
 //     row id == n_rows and zeroed cols/vals/mask
 //
 // Build: g++ -O3 -shared -fPIC (see native/__init__.py; no deps).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -174,6 +175,46 @@ int64_t pio_fill_buckets(const int32_t* rows, const int32_t* cols,
         vals_out[idx] = vals[k];
         mask_out[idx] = 1.0f;
         filled[r] += 1;
+    }
+
+    // sort each padded row by column id (stable, matching numpy argsort
+    // kind="stable"): Gram/RHS sums are order-invariant and monotonic
+    // gather indices are ~20x faster on TPU than random ones
+    {
+        std::vector<int64_t> perm;
+        std::vector<int32_t> tc;
+        std::vector<float> tv, tm;
+        for (int64_t b = 0; b < n_buckets; ++b) {
+            const int64_t cap = caps[b];
+            perm.resize(static_cast<size_t>(cap));
+            tc.resize(static_cast<size_t>(cap));
+            tv.resize(static_cast<size_t>(cap));
+            tm.resize(static_cast<size_t>(cap));
+            for (int64_t rr = 0; rr < rpads[b]; ++rr) {
+                const int64_t base = elem_off[b] + rr * cap;
+                for (int64_t j = 0; j < cap; ++j) perm[j] = j;
+                // perm starts as the identity, so tie-breaking on the
+                // index under plain sort IS the stable order — without
+                // stable_sort's per-call temp-buffer allocation
+                std::sort(perm.begin(), perm.end(),
+                          [&](int64_t x, int64_t y) {
+                              const int32_t cx = cols_out[base + x];
+                              const int32_t cy = cols_out[base + y];
+                              return cx != cy ? cx < cy : x < y;
+                          });
+                for (int64_t j = 0; j < cap; ++j) {
+                    tc[j] = cols_out[base + perm[j]];
+                    tv[j] = vals_out[base + perm[j]];
+                    tm[j] = mask_out[base + perm[j]];
+                }
+                std::memcpy(cols_out + base, tc.data(),
+                            static_cast<size_t>(cap) * sizeof(int32_t));
+                std::memcpy(vals_out + base, tv.data(),
+                            static_cast<size_t>(cap) * sizeof(float));
+                std::memcpy(mask_out + base, tm.data(),
+                            static_cast<size_t>(cap) * sizeof(float));
+            }
+        }
     }
     return 0;
 }
