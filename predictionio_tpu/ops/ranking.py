@@ -1,9 +1,10 @@
 """Top-k scoring + ranking metrics (MAP@k, precision@k, NDCG@k).
 
 The serving/eval math of the Recommendation templates: score = U Vᵀ with
-seen-item exclusion, then top-k. Batched over users in chunks so the
-[chunk, n_items] score tile stays MXU-sized instead of materializing the
-full n_users × n_items matrix (SURVEY.md §6 tracks MAP@10 on ML-20M).
+seen-item exclusion, then top-k. Batched over users in chunks sized so the
+[chunk, n_items] score tile stays within a ~1 GiB budget (small runs score
+in one tile; ML-20M-scale runs never materialize the full n_users ×
+n_items matrix — SURVEY.md §6 tracks MAP@10 on ML-20M).
 """
 
 from __future__ import annotations
@@ -71,7 +72,15 @@ def recommend_topk(
             np.take_along_axis(part, order, axis=1).astype(np.float32),
             np.take_along_axis(idx, order, axis=1).astype(np.int32),
         )
+    import jax
+
     fn = _topk_fn(k, masked)
+    # ship the item table once — a numpy arg would re-transfer it on every
+    # chunk call (measured: that transfer, not the matmul, dominated
+    # ML-20M-scale MAP@10). Chunks grow with the user count, bounded so
+    # the [chunk, n_items] score tile stays ~1 GB.
+    item_dev = jax.device_put(item_factors)
+    chunk = min(max(chunk, (1 << 28) // max(n_items, 1)), len(user_ids))
     all_scores, all_idx = [], []
     for s in range(0, len(user_ids), chunk):
         ids = user_ids[s : s + chunk]
@@ -85,9 +94,9 @@ def recommend_topk(
                 ex = exclude.get(int(uid))
                 if ex is not None and len(ex):
                     mask[i, ex] = 1.0
-            ts, ti = fn(u, item_factors, mask)
+            ts, ti = fn(u, item_dev, mask)
         else:
-            ts, ti = fn(u, item_factors)
+            ts, ti = fn(u, item_dev)
         all_scores.append(np.asarray(ts))
         all_idx.append(np.asarray(ti))
     return np.concatenate(all_scores), np.concatenate(all_idx)
