@@ -770,7 +770,10 @@ def als_train(
         done += n_steps
         if compute_rmse:
             rmse_history.extend(float(x) for x in np.asarray(rmses))
-        if manager:
+        # multi-host: all ranks restore (consistent global start state) but
+        # only process 0 writes — N ranks racing save/keep_only on a shared
+        # checkpoint dir could interleave delete-vs-write mid-step
+        if manager and jax.process_index() == 0:
             if not first_save_done:
                 manager.keep_only(restore_step)
                 first_save_done = True
@@ -782,7 +785,8 @@ def als_train(
                           "iterations": cfg.iterations, "rank": cfg.rank,
                           "fingerprint": fingerprint},
             )
-    if manager and not first_save_done and restore_step is not None:
+    if (manager and jax.process_index() == 0 and not first_save_done
+            and restore_step is not None):
         # fully-resumed run (no new saves): still purge stale steps now —
         # the restore point is on disk, so there's no crash window here.
         # (restore_step=None with no saves means a degenerate run, e.g.

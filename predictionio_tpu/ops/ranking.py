@@ -42,10 +42,14 @@ def recommend_topk(
     user_ids: np.ndarray,
     k: int,
     exclude: Optional[dict[int, np.ndarray]] = None,
-    chunk: int = 1024,
+    chunk: Optional[int] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Top-k items for each user id. `exclude` maps user id → item-id array
-    to hide (the 'unseen only' contract of the reference templates)."""
+    to hide (the 'unseen only' contract of the reference templates).
+
+    chunk: users scored per device dispatch. Default (None) auto-sizes to
+    a ~1 GiB [chunk, n_items] score tile; pass an explicit value to bound
+    memory — it is honored as-is."""
     n_items = item_factors.shape[0]
     k = min(k, n_items)
     if k <= 0 or len(user_ids) == 0:
@@ -80,7 +84,9 @@ def recommend_topk(
     # ML-20M-scale MAP@10). Chunks grow with the user count, bounded so
     # the [chunk, n_items] score tile stays ~1 GB.
     item_dev = jax.device_put(item_factors)
-    chunk = min(max(chunk, (1 << 28) // max(n_items, 1)), len(user_ids))
+    if chunk is None:
+        chunk = max(1024, (1 << 28) // max(n_items, 1))
+    chunk = min(chunk, len(user_ids))
     all_scores, all_idx = [], []
     for s in range(0, len(user_ids), chunk):
         ids = user_ids[s : s + chunk]

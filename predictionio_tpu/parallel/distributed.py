@@ -51,6 +51,13 @@ def initialize_from_env() -> bool:
     multi-host run; no-op (False) otherwise. Idempotent."""
     import jax
 
+    from predictionio_tpu.parallel.mesh import _apply_platform_override
+
+    # honor PIO_JAX_PLATFORM before any backend use: multi-process CPU
+    # testing (and CPU-only hosts next to a busy chip) must pick the
+    # platform before the distributed client pins it
+    _apply_platform_override()
+
     addr = os.environ.get("PIO_COORDINATOR_ADDRESS")
     if not addr:
         return False

@@ -48,7 +48,30 @@ class CoreWorkflow:
         sanity_check: bool = True,
     ) -> EngineInstance:
         """The `pio train` body (SURVEY.md §3.1): train → persist models →
-        mark instance COMPLETED."""
+        mark instance COMPLETED.
+
+        Multi-host: every rank trains (the jitted step is SPMD and all
+        ranks must participate in the collectives), but only process 0
+        persists — the reference has exactly one Spark driver writing the
+        EngineInstance row; N ranks each inserting their own row would
+        leave `pio deploy`'s latest-COMPLETED lookup racing N instances."""
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            models = engine.train(ctx, engine_params, sanity_check=sanity_check)
+            log.info("CoreWorkflow.run_train: rank %d trained %d model(s); "
+                     "rank 0 persists", jax.process_index(), len(models))
+            # WORKER_DONE ≠ COMPLETED: this rank did its SPMD share, but
+            # whether a servable instance exists is rank 0's verdict —
+            # orchestrators must watch rank 0 for the persisted id
+            return EngineInstance(
+                id=f"(worker rank {jax.process_index()}; rank 0 persists)",
+                status="WORKER_DONE", start_time=_now(), end_time=_now(),
+                engine_id=variant.id, engine_version=engine_version,
+                engine_variant=variant.id,
+                engine_factory=variant.engine_factory, batch=ctx.batch,
+                env={}, **engine_params_to_json(engine_params),
+            )
         storage = ctx.storage
         instances = storage.meta_engine_instances()
         instance = EngineInstance(

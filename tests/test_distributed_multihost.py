@@ -1,0 +1,86 @@
+"""Multi-host control plane e2e: 2 real processes × 4 CPU devices each
+federate into one 8-device world via `jax.distributed` and assemble a
+correct global sharded array — the TPU-native replacement for the
+reference's Spark driver↔executor bootstrap (SURVEY.md §2.7). Runs the
+same `PIO_COORDINATOR_ADDRESS`/`PIO_NUM_PROCESSES`/`PIO_PROCESS_ID`
+contract `pio train` uses on a real pod."""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, os.environ["PIO_TEST_REPO"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from predictionio_tpu.parallel import distributed
+
+    assert distributed.initialize_from_env()
+    import jax.numpy as jnp
+
+    mesh = distributed.global_mesh()
+    lo, hi = distributed.process_row_range(16)
+    local = (np.arange(lo, hi, dtype=np.float32).reshape(-1, 1)
+             * np.ones((1, 4), np.float32))
+    garr = distributed.make_global_array(mesh, local)
+    total = float(jax.jit(jnp.sum)(garr))
+    out = {
+        "pid": jax.process_index(),
+        "devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "sum": total,
+        "rows": [int(lo), int(hi)],
+        "mesh": dict(mesh.shape),
+    }
+    with open(os.environ["PIO_TEST_OUT"], "w") as f:
+        json.dump(out, f)
+""")
+
+
+@pytest.mark.e2e
+def test_two_process_global_mesh(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PIO_CONF_DIR", None)
+        env.update(
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            PIO_NUM_PROCESSES="2",
+            PIO_PROCESS_ID=str(pid),
+            PIO_TEST_REPO=str(REPO),
+            PIO_TEST_OUT=str(tmp_path / f"out{pid}.json"),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+
+    results = [json.loads((tmp_path / f"out{i}.json").read_text())
+               for i in range(2)]
+    expected_sum = float(sum(range(16)) * 4)
+    for pid, r in enumerate(results):
+        assert r["pid"] == pid
+        assert r["devices"] == 8 and r["local_devices"] == 4
+        assert r["sum"] == expected_sum  # every rank sees the global sum
+        assert r["mesh"] == {"data": 8, "model": 1}
+    # the two ranks fed disjoint halves of the global rows
+    assert results[0]["rows"] == [0, 8] and results[1]["rows"] == [8, 16]

@@ -272,3 +272,38 @@ def test_eval_batchpredict_dashboard(rig, tmp_path):
     rows = [json.loads(l) for l in out_file.read_text().splitlines()]
     assert len(rows) == 5
     assert all("itemScores" in r["prediction"] for r in rows)
+
+
+def test_train_checkpoint_resume(rig, tmp_path):
+    """`pio train --checkpoint-dir`: a re-run over the same data/config
+    resumes from the saved step instead of retraining (SURVEY.md §5
+    checkpoint/resume contract)."""
+    rig.run("app", "new", "CkptApp")
+    engine_dir = tmp_path / "CkptEngine"
+    rig.run("template", "get", "recommendation", str(engine_dir),
+            "--app-name", "CkptApp")
+    lines = []
+    for u in range(1, 11):
+        for i in range(1, 21):
+            if ((u * 2654435761 + i * 40503) >> 4) % 3 == 0:
+                lines.append(json.dumps({
+                    "event": "rate", "entityType": "user", "entityId": str(u),
+                    "targetEntityType": "item", "targetEntityId": str(i),
+                    "properties": {"rating": float((u + i) % 5 + 1)}}))
+    f = tmp_path / "ev.jsonl"
+    f.write_text("\n".join(lines) + "\n")
+    rig.run("import", "--appname", "CkptApp", "--input", str(f))
+
+    ckpt = tmp_path / "ckpt"
+    out1 = rig.run("train", "--checkpoint-dir", str(ckpt),
+                   "--checkpoint-every", "2", "--verbose", "1",
+                   cwd=str(engine_dir))
+    assert "Training completed" in out1.stdout
+    assert any(ckpt.iterdir())  # checkpoints on disk
+
+    # same data + config → full resume, no retraining from scratch
+    out2 = rig.run("train", "--checkpoint-dir", str(ckpt),
+                   "--checkpoint-every", "2", "--verbose", "1",
+                   cwd=str(engine_dir))
+    assert "Training completed" in out2.stdout
+    assert "resumed from checkpoint step" in (out2.stdout + out2.stderr)
