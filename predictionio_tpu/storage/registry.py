@@ -14,12 +14,15 @@ different sources, exactly like the reference's HBase-events + ES-metadata
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
 from typing import Optional
 
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+log = logging.getLogger(__name__)
 
 _REPOSITORIES = ("METADATA", "MODELDATA", "EVENTDATA")
 
@@ -39,12 +42,21 @@ def _make_localfs(source: "SourceConfig") -> base.StorageBackend:
     return LocalFSBackend(source.path)
 
 
+def _make_postgres(source: "SourceConfig") -> base.StorageBackend:
+    # gated: raises ImportError with install guidance when no PEP-249
+    # Postgres driver is present (this image ships none)
+    from predictionio_tpu.storage.postgres import PostgresBackend
+
+    return PostgresBackend(source.path)
+
+
 # type name → factory(SourceConfig) — the reflective-client-load analogue
 # of the reference's Storage.scala; third-party backends register here
 BACKEND_TYPES: dict = {
     "sqlite": _make_sqlite,
     "memory": _make_memory,
     "localfs": _make_localfs,
+    "postgres": _make_postgres,
 }
 
 
@@ -184,7 +196,10 @@ class Storage:
             try:
                 fn()
                 results[name] = True
-            except Exception:
+            except Exception as e:
+                # surface WHY (e.g. "install psycopg2-binary or pg8000"):
+                # a bare FAILED line hides actionable config errors
+                log.warning("storage check %s failed: %s", name, e)
                 results[name] = False
         return results
 

@@ -101,6 +101,10 @@ CREATE INDEX IF NOT EXISTS idx_events_entity
 
 
 class SQLiteBackend(base.StorageBackend):
+    # uniqueness-violation exception classes; dialect subclasses (e.g.
+    # storage/postgres.py) extend with their driver's
+    integrity_errors: tuple = (sqlite3.IntegrityError,)
+
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._local = threading.local()
@@ -207,7 +211,7 @@ class SQLiteApps(base.Apps):
                     (app.name, app.description),
                 )
                 return cur.lastrowid
-        except sqlite3.IntegrityError:
+        except self._b.integrity_errors:
             return None
 
     def get(self, app_id: int) -> Optional[App]:
@@ -251,7 +255,7 @@ class SQLiteAccessKeys(base.AccessKeys):
                     (access_key.key, access_key.app_id, json.dumps(access_key.events)),
                 )
             return access_key.key
-        except sqlite3.IntegrityError:
+        except self._b.integrity_errors:
             return None
 
     def get(self, key: str) -> Optional[AccessKey]:
@@ -286,7 +290,7 @@ class SQLiteChannels(base.Channels):
                     (channel.name, channel.app_id),
                 )
                 return cur.lastrowid
-        except sqlite3.IntegrityError:
+        except self._b.integrity_errors:
             return None
 
     def get(self, channel_id: int) -> Optional[Channel]:

@@ -20,12 +20,13 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 WORKER = textwrap.dedent("""
     import json, os, sys
     sys.path.insert(0, os.environ["PIO_TEST_REPO"])
-    import jax
-    jax.config.update("jax_platforms", "cpu")
     import numpy as np
     from predictionio_tpu.parallel import distributed
 
+    # PIO_JAX_PLATFORM=cpu in the env exercises the platform override
+    # inside initialize_from_env (the production path on CPU-only hosts)
     assert distributed.initialize_from_env()
+    import jax
     import jax.numpy as jnp
 
     mesh = distributed.global_mesh()
@@ -60,6 +61,7 @@ def test_two_process_global_mesh(tmp_path):
         env = dict(os.environ)
         env.pop("PIO_CONF_DIR", None)
         env.update(
+            PIO_JAX_PLATFORM="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
             PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
             PIO_NUM_PROCESSES="2",
