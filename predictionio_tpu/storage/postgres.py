@@ -24,7 +24,6 @@ guidance; `storage/registry.py` registers the "postgres" source type so
 from __future__ import annotations
 
 import re
-import threading
 from typing import Optional
 
 from predictionio_tpu.storage.sqlite import _SCHEMA, SQLiteBackend
@@ -148,20 +147,36 @@ class PostgresBackend(SQLiteBackend):
                 "postgres needs one of them on the serving/training hosts)."
             )
         self._driver = driver
-        self.path = dsn
-        self._local = threading.local()
-        self._shared = None  # per-thread connections, like file SQLite
-        self._shared_lock = threading.RLock()
-        self._all_conns = []
-        self._conns_lock = threading.Lock()
+        self._driver_name = name
+        self._init_conn_state(dsn)
         self.integrity_errors = (driver.IntegrityError,)
+        # ONE shared connection, serialized by the existing lock (the
+        # :memory: model): ThreadingHTTPServer spawns a thread per client,
+        # and per-thread connections would accumulate until the server's
+        # max_connections is exhausted (threads die, their connections
+        # would not). A real pool is the round-2 upgrade; correctness and
+        # bounded resource use come first.
+        self._shared = self._connect()
         with self._cursor() as cur:
             for stmt in _SCHEMA.split(";"):
                 if stmt.strip():
                     cur.execute(stmt)
 
     def _connect(self):
-        conn = self._driver.connect(**_parse_dsn(self.path))
+        kwargs = _parse_dsn(self.path)
+        if self._driver_name == "pg8000":
+            # pg8000's connect() has no libpq-style option kwargs; drop
+            # unsupported DSN query options rather than crashing
+            supported = {"host", "database", "user", "password", "port"}
+            dropped = sorted(set(kwargs) - supported)
+            if dropped:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "postgres: pg8000 does not accept DSN option(s) %s; "
+                    "ignored (psycopg2 supports them)", ", ".join(dropped))
+                kwargs = {k: v for k, v in kwargs.items() if k in supported}
+        conn = self._driver.connect(**kwargs)
         with self._conns_lock:
             self._all_conns.append(conn)
         return conn

@@ -106,19 +106,25 @@ class SQLiteBackend(base.StorageBackend):
     integrity_errors: tuple = (sqlite3.IntegrityError,)
 
     def __init__(self, path: str = ":memory:"):
-        self.path = path
-        self._local = threading.local()
+        self._init_conn_state(path)
         # :memory: must share one connection across threads (each connection
         # would otherwise get its own private database), serialized by a lock.
         # File databases get one connection per thread; WAL handles them.
-        self._shared: Optional[sqlite3.Connection] = None
-        self._shared_lock = threading.RLock()
-        self._all_conns: list[sqlite3.Connection] = []
-        self._conns_lock = threading.Lock()
         if path == ":memory:":
             self._shared = self._connect()
         with self._cursor() as cur:
             cur.executescript(_SCHEMA)
+
+    def _init_conn_state(self, path: str) -> None:
+        """Connection bookkeeping shared with dialect subclasses (e.g.
+        storage/postgres.py) — one place to grow, so subclass __init__s
+        can't drift."""
+        self.path = path
+        self._local = threading.local()
+        self._shared = None  # set → one shared connection, lock-serialized
+        self._shared_lock = threading.RLock()
+        self._all_conns: list = []
+        self._conns_lock = threading.Lock()
 
     def _connect(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.path, check_same_thread=False, timeout=30.0)
@@ -192,7 +198,10 @@ class SQLiteBackend(base.StorageBackend):
             for conn in self._all_conns:
                 try:
                     conn.close()
-                except sqlite3.Error:
+                except Exception:
+                    # driver-specific close errors (incl. dialect
+                    # subclasses' drivers) must not leak the remaining
+                    # connections
                     pass
             self._all_conns.clear()
         self._shared = None
