@@ -1,0 +1,64 @@
+"""FakeWorkflow — run arbitrary code under the workflow harness.
+
+Parity with «core/…/workflow/FakeWorkflow.scala :: FakeWorkflow» (SURVEY.md
+§2.1 [U]): the reference lets tests and one-off jobs run a function with a
+real SparkContext inside the workflow machinery (status rows, error
+handling) without defining a DASE engine. The TPU equivalent hands the
+function a `WorkflowContext` (mesh, storage, seed, profiling hooks) and
+records an `EngineInstance` row for the run, so ad-hoc jobs stay visible
+to `pio status`-style tooling and are idempotently re-runnable like any
+train."""
+
+from __future__ import annotations
+
+import logging
+import traceback
+from datetime import datetime, timezone
+from typing import Any, Callable, Optional
+
+from predictionio_tpu.controller.context import WorkflowContext
+from predictionio_tpu.storage.base import EngineInstance
+
+log = logging.getLogger(__name__)
+
+
+def run_fake_workflow(
+    fn: Callable[[WorkflowContext], Any],
+    ctx: Optional[WorkflowContext] = None,
+    batch: str = "",
+    record: bool = True,
+) -> Any:
+    """Run `fn(ctx)` as a workflow: RUNNING → COMPLETED/FAILED row in the
+    engine-instances store (when `record`), exceptions re-raised after the
+    FAILED mark. Returns fn's result."""
+    ctx = ctx or WorkflowContext(batch=batch)
+    instances = ctx.storage.meta_engine_instances() if record else None
+
+    def now():
+        return datetime.now(timezone.utc)
+
+    instance = EngineInstance(
+        id="", status="RUNNING", start_time=now(), end_time=now(),
+        engine_id="fake", engine_version="1", engine_variant="fake",
+        engine_factory=f"{fn.__module__}.{getattr(fn, '__qualname__', fn)}",
+        batch=batch, env={},
+    )
+    if instances is not None:
+        instance.id = instances.insert(instance)
+        log.info("FakeWorkflow: instance %s RUNNING (%s)", instance.id,
+                 instance.engine_factory)
+    try:
+        result = fn(ctx)
+    except Exception:
+        if instances is not None:
+            instance.status = "FAILED"
+            instance.end_time = now()
+            instances.update(instance)
+        log.error("FakeWorkflow: FAILED\n%s", traceback.format_exc())
+        raise
+    if instances is not None:
+        instance.status = "COMPLETED"
+        instance.end_time = now()
+        instances.update(instance)
+        log.info("FakeWorkflow: instance %s COMPLETED", instance.id)
+    return result

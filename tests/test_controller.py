@@ -370,3 +370,38 @@ class TestReviewRegressions:
         rc = main(["eval", "no.such.module.Eval"])
         assert rc == 1
         assert "Evaluation failed" in capsys.readouterr().err
+
+
+class TestFakeWorkflow:
+    """«FakeWorkflow» parity (SURVEY.md §2.1): arbitrary code under the
+    workflow harness with instance-row bookkeeping."""
+
+    def test_completed_run_records_instance(self, memory_storage):
+        from predictionio_tpu.workflow.fake import run_fake_workflow
+
+        def job(ctx):
+            assert ctx.mesh is not None
+            return 41 + 1
+
+        assert run_fake_workflow(job) == 42
+        rows = memory_storage.meta_engine_instances().get_all()
+        assert any(r.engine_id == "fake" and r.status == "COMPLETED"
+                   for r in rows)
+
+    def test_failed_run_marks_failed_and_raises(self, memory_storage):
+        from predictionio_tpu.workflow.fake import run_fake_workflow
+
+        def job(ctx):
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            run_fake_workflow(job)
+        rows = memory_storage.meta_engine_instances().get_all()
+        assert any(r.engine_id == "fake" and r.status == "FAILED"
+                   for r in rows)
+
+    def test_record_false_leaves_no_rows(self, memory_storage):
+        from predictionio_tpu.workflow.fake import run_fake_workflow
+
+        assert run_fake_workflow(lambda ctx: "ok", record=False) == "ok"
+        assert not memory_storage.meta_engine_instances().get_all()
