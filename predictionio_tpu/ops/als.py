@@ -426,8 +426,10 @@ def _solve_buckets_device(
                                        interpret=interpret)
             return a.astype(f32), b.astype(f32)
         y = _gather_rows(opposing, cols_c, mesh)  # [R, C, K]
+        # ym on BOTH einsum sides: the mask is 0/1 so m² == m, and keeping
+        # the raw `y` alive as a second operand forces XLA to materialize
+        # the gather for it (measured 15× slower at the hot-bucket shape)
         ym = (y * mask_c[..., None]).astype(cdtype)
-        yc = y.astype(cdtype)
         if cfg.implicit:
             conf = cfg.alpha * vals_c  # C - I, zero at padding
             a = jnp.einsum("rck,rc,rcl->rkl", ym, conf.astype(cdtype), ym,
@@ -435,7 +437,7 @@ def _solve_buckets_device(
             b = jnp.einsum("rck,rc->rk", ym, (1.0 + conf).astype(cdtype),
                            preferred_element_type=f32)
         else:
-            a = jnp.einsum("rck,rcl->rkl", ym, yc,
+            a = jnp.einsum("rck,rcl->rkl", ym, ym,
                            preferred_element_type=f32)
             b = jnp.einsum("rck,rc->rk", ym, vals_c.astype(cdtype),
                            preferred_element_type=f32)

@@ -11,6 +11,7 @@ story and is preserved (SURVEY.md §5 'Failure detection').
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import traceback
 from datetime import datetime, timezone
@@ -31,6 +32,31 @@ from predictionio_tpu.workflow.workflow_utils import (
 )
 
 log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def tracked_instance(instances, instance, completed: str = "COMPLETED",
+                     failed: str = "FAILED", label: str = "workflow"):
+    """Instance-row lifecycle shared by train/eval/fake workflows: insert
+    as-is (caller sets the RUNNING-style status), mark `completed` after
+    the block, mark `failed` + log + re-raise on exception. Fields the
+    block sets on the instance (e.g. evaluator results) persist in the
+    final update."""
+    instance.id = instances.insert(instance)
+    log.info("%s: instance %s %s", label, instance.id, instance.status)
+    try:
+        yield instance
+    except Exception:
+        instance.status = failed
+        instance.end_time = _now()
+        instances.update(instance)
+        log.error("%s: instance %s %s\n%s", label, instance.id, failed,
+                  traceback.format_exc())
+        raise
+    instance.status = completed
+    instance.end_time = _now()
+    instances.update(instance)
+    log.info("%s: instance %s %s", label, instance.id, completed)
 
 
 def _now() -> datetime:
@@ -87,25 +113,14 @@ class CoreWorkflow:
             env={},
             **engine_params_to_json(engine_params),
         )
-        instance_id = instances.insert(instance)
-        log.info("CoreWorkflow.run_train: engine instance %s RUNNING", instance_id)
-        try:
+        with tracked_instance(instances, instance,
+                              label="CoreWorkflow.run_train"):
             models = engine.train(ctx, engine_params, sanity_check=sanity_check)
-            blob = engine.serialize_models(models, instance_id, engine_params)
-            storage.model_data_models().insert(Model(id=instance_id, models=blob))
-            instance.status = "COMPLETED"
-            instance.end_time = _now()
-            instances.update(instance)
-            log.info("CoreWorkflow.run_train: instance %s COMPLETED (%d model(s), "
-                     "%d byte blob)", instance_id, len(models), len(blob))
-            return instance
-        except Exception:
-            instance.status = "FAILED"
-            instance.end_time = _now()
-            instances.update(instance)
-            log.error("CoreWorkflow.run_train: instance %s FAILED\n%s",
-                      instance_id, traceback.format_exc())
-            raise
+            blob = engine.serialize_models(models, instance.id, engine_params)
+            storage.model_data_models().insert(Model(id=instance.id, models=blob))
+            log.info("CoreWorkflow.run_train: instance %s trained %d model(s), "
+                     "%d byte blob", instance.id, len(models), len(blob))
+        return instance
 
     @staticmethod
     def run_evaluation(
@@ -127,23 +142,12 @@ class CoreWorkflow:
             engine_params_generator_class=generator_class or type(generator).__name__,
             batch=ctx.batch,
         )
-        instance_id = instances.insert(instance)
-        try:
+        with tracked_instance(instances, instance, completed="EVALCOMPLETED",
+                              failed="EVALFAILED",
+                              label="CoreWorkflow.run_evaluation"):
             result = MetricEvaluator.evaluate(
                 ctx, evaluation, list(generator.engine_params_list)
             )
-            instance.status = "EVALCOMPLETED"
-            instance.end_time = _now()
             instance.evaluator_results = result.summary()
             instance.evaluator_results_json = result.to_json()
-            instances.update(instance)
-            log.info("CoreWorkflow.run_evaluation: instance %s EVALCOMPLETED",
-                     instance_id)
-            return instance, result
-        except Exception:
-            instance.status = "EVALFAILED"
-            instance.end_time = _now()
-            instances.update(instance)
-            log.error("CoreWorkflow.run_evaluation: instance %s EVALFAILED\n%s",
-                      instance_id, traceback.format_exc())
-            raise
+        return instance, result

@@ -12,12 +12,11 @@ train."""
 from __future__ import annotations
 
 import logging
-import traceback
-from datetime import datetime, timezone
 from typing import Any, Callable, Optional
 
 from predictionio_tpu.controller.context import WorkflowContext
 from predictionio_tpu.storage.base import EngineInstance
+from predictionio_tpu.workflow.core_workflow import _now, tracked_instance
 
 log = logging.getLogger(__name__)
 
@@ -32,33 +31,15 @@ def run_fake_workflow(
     engine-instances store (when `record`), exceptions re-raised after the
     FAILED mark. Returns fn's result."""
     ctx = ctx or WorkflowContext(batch=batch)
-    instances = ctx.storage.meta_engine_instances() if record else None
-
-    def now():
-        return datetime.now(timezone.utc)
-
+    if not record:
+        return fn(ctx)
     instance = EngineInstance(
-        id="", status="RUNNING", start_time=now(), end_time=now(),
+        id="", status="RUNNING", start_time=_now(), end_time=_now(),
         engine_id="fake", engine_version="1", engine_variant="fake",
         engine_factory=f"{fn.__module__}.{getattr(fn, '__qualname__', fn)}",
         batch=batch, env={},
     )
-    if instances is not None:
-        instance.id = instances.insert(instance)
-        log.info("FakeWorkflow: instance %s RUNNING (%s)", instance.id,
-                 instance.engine_factory)
-    try:
+    with tracked_instance(ctx.storage.meta_engine_instances(), instance,
+                          label="FakeWorkflow"):
         result = fn(ctx)
-    except Exception:
-        if instances is not None:
-            instance.status = "FAILED"
-            instance.end_time = now()
-            instances.update(instance)
-        log.error("FakeWorkflow: FAILED\n%s", traceback.format_exc())
-        raise
-    if instances is not None:
-        instance.status = "COMPLETED"
-        instance.end_time = now()
-        instances.update(instance)
-        log.info("FakeWorkflow: instance %s COMPLETED", instance.id)
     return result
