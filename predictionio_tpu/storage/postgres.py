@@ -102,6 +102,12 @@ class _PGCursor:
             self._pending_id = self._cur.fetchone()[0]
         return self
 
+    def executemany(self, sql: str, seq_of_params):
+        self._pending_id = None
+        self._cur.executemany(translate_sql(sql),
+                              [tuple(p) for p in seq_of_params])
+        return self
+
     @property
     def lastrowid(self) -> Optional[int]:
         return self._pending_id

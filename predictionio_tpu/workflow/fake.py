@@ -32,7 +32,15 @@ def run_fake_workflow(
     FAILED mark. Returns fn's result."""
     ctx = ctx or WorkflowContext(batch=batch)
     if not record:
-        return fn(ctx)
+        try:
+            return fn(ctx)
+        except Exception:
+            # same failure record as the tracked path, minus the row
+            import traceback
+
+            log.error("FakeWorkflow (unrecorded): FAILED\n%s",
+                      traceback.format_exc())
+            raise
     instance = EngineInstance(
         id="", status="RUNNING", start_time=_now(), end_time=_now(),
         engine_id="fake", engine_version="1", engine_variant="fake",

@@ -37,6 +37,11 @@ class _FakeCursor:
         self._cur.execute(sql, params)
         return self
 
+    def executemany(self, sql, seq):
+        assert "?" not in sql, f"untranslated placeholder: {sql}"
+        self._cur.executemany(sql.replace("%s", "?"), seq)
+        return self
+
     def __getattr__(self, name):
         return getattr(self._cur, name)
 
@@ -153,3 +158,11 @@ class TestReposThroughAdapter:
     def test_dsn_with_options_and_encoding(self):
         out = _parse_dsn("postgres://u:p%40ss@db:5432/pio?sslmode=require")
         assert out["password"] == "p@ss" and out["sslmode"] == "require"
+
+    def test_insert_batch(self, pg_backend):
+        events = pg_backend.events()
+        batch = [Event(event="view", entity_type="user", entity_id=f"u{i}")
+                 for i in range(7)]
+        ids = events.insert_batch(batch, app_id=1)
+        assert len(set(ids)) == 7
+        assert len(events.find(app_id=1)) == 7

@@ -225,3 +225,15 @@ class TestReviewRegressions:
         k = AccessKey(key="fixed", app_id=1)
         assert keys.insert(k) == "fixed"
         assert keys.insert(AccessKey(key="fixed", app_id=2)) is None
+
+
+class TestBatchInsert:
+    def test_insert_batch_single_transaction(self, memory_storage):
+        events = memory_storage.l_events()
+        batch = [ev("rate", eid=f"u{i}", t=ts(i % 24)) for i in range(250)]
+        ids = events.insert_batch(batch, app_id=1)
+        assert len(ids) == 250 and len(set(ids)) == 250
+        found = events.find(app_id=1)
+        assert len(found) == 250
+        # events carry their assigned ids back
+        assert all(e.event_id for e in batch)
