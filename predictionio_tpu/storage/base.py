@@ -210,7 +210,13 @@ class LEvents(abc.ABC):
         channel_id: Optional[int] = None,
     ) -> list[str]:
         """Bulk insert. Default: per-event loop; backends override with a
-        single-transaction fast path (bulk import is 20×+ faster there)."""
+        single-transaction fast path (bulk import is 20×+ faster there).
+
+        No atomicity guarantee at this interface: the default commits
+        per event (a mid-batch failure leaves earlier events stored),
+        while the SQLite/Postgres overrides are all-or-nothing. Callers
+        needing exactness should treat a raised exception as "re-import
+        this file/chunk after fixing the cause"."""
         return [self.insert(e, app_id, channel_id) for e in events]
 
     @abc.abstractmethod

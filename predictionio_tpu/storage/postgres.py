@@ -87,8 +87,9 @@ class _PGCursor:
     """DB-API cursor adapter: translated SQL, chainable execute, named
     rows, RETURNING-based lastrowid."""
 
-    def __init__(self, cur):
+    def __init__(self, cur, driver_name: str = ""):
         self._cur = cur
+        self._driver_name = driver_name
         self._pending_id: Optional[int] = None
 
     def execute(self, sql: str, params=()):
@@ -104,8 +105,16 @@ class _PGCursor:
 
     def executemany(self, sql: str, seq_of_params):
         self._pending_id = None
-        self._cur.executemany(translate_sql(sql),
-                              [tuple(p) for p in seq_of_params])
+        sql = translate_sql(sql)
+        rows = [tuple(p) for p in seq_of_params]
+        if self._driver_name == "psycopg2":
+            # psycopg2's executemany is a per-row round-trip loop;
+            # execute_batch collapses it into multi-statement pages
+            from psycopg2.extras import execute_batch  # type: ignore
+
+            execute_batch(self._cur, sql, rows)
+        else:
+            self._cur.executemany(sql, rows)
         return self
 
     @property
@@ -190,10 +199,12 @@ class PostgresBackend(SQLiteBackend):
     def _cursor(self):
         outer = super()._cursor()
 
+        driver_name = self._driver_name
+
         class _Ctx:
             def __enter__(self):
                 self._inner = outer.__enter__()
-                return _PGCursor(self._inner)
+                return _PGCursor(self._inner, driver_name)
 
             def __exit__(self, *exc):
                 return outer.__exit__(*exc)
