@@ -20,10 +20,10 @@ message) on any other non-2xx.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
+import threading
 import urllib.parse
-import urllib.request
 from datetime import datetime, timezone
 from typing import Any, Optional, Sequence, Union
 
@@ -51,33 +51,96 @@ def _format_time(t: Union[None, str, datetime]) -> Optional[str]:
 
 
 class _BaseClient:
+    """Keep-alive transport: one persistent HTTP/1.1 connection per thread
+    (a fresh TCP handshake per event caps SDK ingest at ~1k events/s;
+    keep-alive measures ~5× that). Broken connections reconnect once."""
+
     def __init__(self, url: str, timeout: float = 10.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        parts = urllib.parse.urlsplit(self.url)
+        if parts.scheme not in ("http", "https", ""):
+            raise ValueError(
+                f"Unsupported URL scheme in {url!r} (http/https only)")
+        self._https = parts.scheme == "https"
+        self._host = parts.hostname or "localhost"
+        self._port = parts.port or (443 if self._https else 80)
+        self._prefix = parts.path.rstrip("/")
+        self._tl = threading.local()
+
+    def _conn(self) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, fresh): `fresh` is True when this call created it —
+        retry policy depends on whether a keep-alive could be stale."""
+        conn = getattr(self._tl, "conn", None)
+        if conn is None:
+            cls = (http.client.HTTPSConnection if self._https
+                   else http.client.HTTPConnection)
+            conn = cls(self._host, self._port, timeout=self.timeout)
+            self._tl.conn = conn
+            return conn, True
+        return conn, False
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._tl, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._tl.conn = None
+
+    def close(self) -> None:
+        """Close this thread's persistent connection (optional; idle
+        connections are reaped by the server side too)."""
+        self._drop_conn()
 
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None,
                  body: Optional[Any] = None) -> Any:
         q = {k: v for k, v in (query or {}).items() if v is not None}
-        url = self.url + path
+        target = self._prefix + path
         if q:
-            url += "?" + urllib.parse.urlencode(q)
+            target += "?" + urllib.parse.urlencode(q)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+        headers = {"Content-Type": "application/json"}
+        for attempt in (0, 1):
+            conn, fresh = self._conn()
+            sent = False
+            try:
+                conn.request(method, target, data, headers)
+                sent = True
+                resp = conn.getresponse()
                 payload = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
+                status = resp.status
+                break
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._drop_conn()
+                # Retry exactly once, and ONLY on a reused keep-alive where
+                # the request cannot have been processed: failure at send
+                # time, or RemoteDisconnected from getresponse (the server
+                # closed the idle connection before our request — the
+                # standard stale keep-alive race; a close WITHOUT a
+                # response means it was not processed). Timeouts and
+                # mid-response failures are NOT retried: the server may
+                # have processed them, and re-sending a POST would
+                # duplicate events.
+                can_retry = (not attempt and not fresh
+                             and (not sent
+                                  or isinstance(e, http.client.RemoteDisconnected)))
+                if not can_retry:
+                    raise
+        if 300 <= status < 400:
+            # the reference stack never redirects; auto-following would
+            # silently re-send bodies across hosts — surface it instead
+            raise PredictionIOError(
+                status, "unexpected redirect to "
+                        f"{resp.getheader('Location', '?')} (not followed)")
+        if status >= 400:
+            detail = payload.decode(errors="replace")
             try:
                 detail = json.loads(detail).get("message", detail)
             except (json.JSONDecodeError, AttributeError):
                 pass
-            if e.code == 404:
-                raise NotFoundError(detail) from None
-            raise PredictionIOError(e.code, detail) from None
+            if status == 404:
+                raise NotFoundError(detail)
+            raise PredictionIOError(status, detail)
         return json.loads(payload) if payload else None
 
 

@@ -109,3 +109,31 @@ class TestEngineClient:
             assert "itemScores" in result
         finally:
             server.shutdown()
+
+
+class TestKeepAliveTransport:
+    def test_stale_connection_reconnects(self, memory_storage):
+        """Server restarts between calls: the reused keep-alive fails with
+        RemoteDisconnected and the client retries once on a fresh
+        connection (send-complete failures are NOT retried — POST dedup)."""
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="KaApp"))
+        key = AccessKey.generate(app_id)
+        memory_storage.meta_access_keys().insert(key)
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          memory_storage)
+        srv.start()
+        port = srv.port
+        client = EventClient(access_key=key.key,
+                             url=f"http://127.0.0.1:{port}")
+        client.record_user_action_on_item("view", "u1", "i1")  # opens conn
+        srv.shutdown()
+        srv2 = EventServer(EventServerConfig(ip="127.0.0.1", port=port),
+                           memory_storage)
+        srv2.start()
+        try:
+            # reused connection is stale; must transparently reconnect
+            eid = client.record_user_action_on_item("view", "u1", "i2")
+            assert eid
+            assert len(client.find_events(limit=-1)) == 2
+        finally:
+            srv2.shutdown()
