@@ -22,7 +22,6 @@ this path, so throughput is bounded by SQLite writes, not the server.
 from __future__ import annotations
 
 import json
-import sqlite3
 import threading
 import time
 from collections import Counter
@@ -123,7 +122,9 @@ class _EventHandler(JsonRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 
-    def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
+    def _validate_event(self, d: dict, access_key, app_id: int,
+                        channel_id) -> Event:
+        """Parse + validate + auth + plugin gate; storage untouched."""
         event = Event.from_dict(d)
         validate_event(event)
         if access_key.events and event.event not in access_key.events:
@@ -134,9 +135,14 @@ class _EventHandler(JsonRequestHandler):
             # blockers raise PluginRejection (403 at the route); sniffer
             # failures are swallowed inside the registry
             self.plugins.on_event(d, app_id, channel_id)
+        return event
+
+    def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
+        event = self._validate_event(d, access_key, app_id, channel_id)
+        le = self.storage.l_events()
         try:
-            eid = self.storage.l_events().insert(event, app_id, channel_id)
-        except sqlite3.IntegrityError as e:
+            eid = le.insert(event, app_id, channel_id)
+        except le.integrity_errors as e:
             raise EventValidationError(
                 f"duplicate eventId {event.event_id!r}"
             ) from e
@@ -228,17 +234,45 @@ class _EventHandler(JsonRequestHandler):
                     {"message": f"Batch request must have less than or equal to "
                                 f"{BATCH_LIMIT} events"},
                 )
-            results = []
-            for d in items:
+            # two-phase: validate every row first (per-row statuses), then
+            # store the valid ones in ONE transaction via insert_batch
+            results: list = []
+            prepared: list[tuple[int, Event]] = []
+            for i, d in enumerate(items):
                 try:
-                    eid = self._insert_event(d, access_key, app_id, channel_id)
-                    results.append({"status": 201, "eventId": eid})
+                    event = self._validate_event(d, access_key, app_id,
+                                                 channel_id)
+                    prepared.append((i, event))
+                    results.append(None)  # filled after the batch insert
                 except PluginRejection as e:
                     if self.stats:
                         self.stats.update(app_id, "<blocked>", 403)
                     results.append({"status": 403, "message": str(e)})
                 except (EventValidationError, ValueError) as e:
                     results.append({"status": 400, "message": str(e)})
+            if prepared:
+                le = self.storage.l_events()
+                try:
+                    ids = le.insert_batch(
+                        [e for _, e in prepared], app_id, channel_id)
+                except le.integrity_errors:
+                    # duplicate caller-set eventId somewhere in the chunk:
+                    # the transaction rolled back — redo per event so only
+                    # the offending rows 400
+                    ids = []
+                    for _, event in prepared:
+                        try:
+                            ids.append(le.insert(event, app_id, channel_id))
+                        except le.integrity_errors:
+                            ids.append(None)
+                for (i, event), eid in zip(prepared, ids):
+                    if eid is None:
+                        results[i] = {"status": 400, "message":
+                                      f"duplicate eventId {event.event_id!r}"}
+                        continue
+                    results[i] = {"status": 201, "eventId": eid}
+                    if self.stats:
+                        self.stats.update(app_id, event.event, 201)
             return self._send_json(200, results)
 
         if path.startswith("/webhooks/") and path.endswith(".json"):

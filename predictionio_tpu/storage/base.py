@@ -196,6 +196,11 @@ class Models(abc.ABC):
 class LEvents(abc.ABC):
     """Event CRUD. `channel_id=None` addresses an app's default channel."""
 
+    # duplicate-key exception classes of the underlying store, for callers
+    # that map uniqueness violations to user errors (the event API's
+    # duplicate-eventId 400). Backends override; () catches nothing.
+    integrity_errors: tuple = ()
+
     @abc.abstractmethod
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool: ...
 

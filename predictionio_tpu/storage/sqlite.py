@@ -535,6 +535,12 @@ class SQLiteLEvents(base.LEvents):
     def __init__(self, backend: SQLiteBackend):
         self._b = backend
 
+    @property
+    def integrity_errors(self) -> tuple:
+        # the backend's, so the Postgres dialect subclass propagates its
+        # driver's IntegrityError to API-level duplicate handling
+        return self._b.integrity_errors
+
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         return True  # single events table; nothing to create per app
 

@@ -90,6 +90,23 @@ class TestEventServer:
                          [RATE] * 51)
         assert status == 400
 
+    def test_batch_duplicate_event_id(self, server):
+        """A duplicate caller-set eventId 400s only its own row; the rest
+        of the batch lands (two-phase insert with per-event fallback)."""
+        srv, key = server
+        first = dict(RATE, eventId="fixed-id")
+        status, [r1] = call(srv, "POST", f"/batch/events.json?accessKey={key}",
+                            [first])
+        assert r1["status"] == 201 and r1["eventId"] == "fixed-id"
+        batch = [dict(RATE, entityId="uA"),
+                 dict(RATE, eventId="fixed-id"),  # duplicate
+                 dict(RATE, entityId="uB")]
+        status, results = call(srv, "POST",
+                               f"/batch/events.json?accessKey={key}", batch)
+        assert status == 200
+        assert [r["status"] for r in results] == [201, 400, 201]
+        assert "duplicate eventId" in results[1]["message"]
+
     def test_delete(self, server):
         srv, key = server
         _, body = call(srv, "POST", f"/events.json?accessKey={key}", RATE)

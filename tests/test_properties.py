@@ -1,0 +1,112 @@
+"""Property-based tests (hypothesis) for the two subtlest invariants:
+the `$set/$unset/$delete` property fold and the bucketizer round-trip
+(ROADMAP.md 'Quality'). Each property is checked against an independent
+straight-line model of the semantics."""
+
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from predictionio_tpu.data.datamap import DataMap, aggregate_properties
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.ops.als import bucket_ragged_split
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+special_op = st.sampled_from(["$set", "$unset", "$delete"])
+entity = st.sampled_from(["e1", "e2", "e3"])
+prop_key = st.sampled_from(["a", "b", "c"])
+
+
+@st.composite
+def special_events(draw):
+    n = draw(st.integers(0, 25))
+    events = []
+    for i in range(n):
+        op = draw(special_op)
+        props = {}
+        if op in ("$set", "$unset"):
+            for k in draw(st.sets(prop_key, min_size=1, max_size=3)):
+                props[k] = draw(st.integers(0, 9)) if op == "$set" else None
+        events.append(Event(
+            event=op, entity_type="user", entity_id=draw(entity),
+            properties=DataMap(props),
+            # distinct strictly-increasing event times: the fold orders by
+            # (event_time, creation_time), so the model can replay linearly
+            event_time=T0 + timedelta(minutes=i),
+        ))
+    return events
+
+
+@settings(max_examples=60, deadline=None)
+@given(special_events(), st.randoms())
+def test_aggregate_properties_matches_sequential_model(events, rnd):
+    # model: replay in time order over plain dicts
+    model: dict[str, dict] = {}
+    for e in sorted(events, key=lambda e: e.event_time):
+        if e.event == "$set":
+            model.setdefault(e.entity_id, {}).update(e.properties.to_dict())
+        elif e.event == "$unset":
+            if e.entity_id in model:
+                for k in e.properties.keyset():
+                    model[e.entity_id].pop(k, None)
+        else:
+            model.pop(e.entity_id, None)
+
+    shuffled = list(events)
+    rnd.shuffle(shuffled)  # the fold must not depend on insertion order
+    got = aggregate_properties(shuffled)
+    assert {k: v.to_dict() for k, v in got.items()} == model
+
+
+@st.composite
+def coo(draw):
+    n = draw(st.integers(0, 120))
+    n_rows = draw(st.integers(1, 12))
+    n_cols = draw(st.integers(1, 12))
+    rows = draw(st.lists(st.integers(0, n_rows - 1), min_size=n, max_size=n))
+    cols = draw(st.lists(st.integers(0, n_cols - 1), min_size=n, max_size=n))
+    vals = [float(i + 1) for i in range(n)]  # distinct → multiset-checkable
+    return (np.asarray(rows, np.int32), np.asarray(cols, np.int32),
+            np.asarray(vals, np.float32), n_rows)
+
+
+def _entries(buckets):
+    out = {}
+    for b in buckets:
+        for r, cs, vs, ms in zip(b.rows, b.cols, b.vals, b.mask):
+            for c, v, m in zip(cs, vs, ms):
+                if m:
+                    out.setdefault(int(r), []).append((int(c), float(v)))
+    return out
+
+
+@settings(max_examples=60, deadline=None)
+@given(coo(), st.integers(2, 16))
+def test_bucketizer_roundtrip_and_sorted(data, split_cap):
+    rows, cols, vals, n_rows = data
+    buckets, split = bucket_ragged_split(rows, cols, vals, n_rows,
+                                         row_multiple=4, split_cap=split_cap)
+    got = _entries(buckets)
+    want: dict[int, list] = {}
+    for r, c, v in zip(rows, cols, vals):
+        want.setdefault(int(r), []).append((int(c), float(v)))
+    # every entry exactly once, attributed to its row
+    assert {k: sorted(vs) for k, vs in got.items()} == \
+           {k: sorted(vs) for k, vs in want.items()}
+    for b in buckets:
+        # within-row column ids sorted (monotonic-gather invariant)
+        assert all(np.all(np.diff(c) >= 0) for c in b.cols)
+        # no real row exceeds split_cap entries
+        assert b.mask.sum(axis=1).max(initial=0) <= max(
+            split_cap, 1 << (split_cap - 1).bit_length())
+        # caps are powers of two
+        assert b.cap & (b.cap - 1) == 0
+    # split table lists exactly the rows whose count exceeds split_cap
+    counts = np.bincount(rows, minlength=n_rows) if len(rows) else \
+        np.zeros(n_rows, int)
+    assert set(split) == set(np.nonzero(counts > split_cap)[0])
