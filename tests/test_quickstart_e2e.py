@@ -102,6 +102,18 @@ def rig(tmp_path):
     r.teardown()
 
 
+def _spread(n_users, n_items, row_fn):
+    """Deterministic hash-spread event rows: users' item subsets overlap
+    without being identical (identical per-user sets would make k-fold
+    holdout items unreachable and evals legitimately 0)."""
+    lines = []
+    for u in range(1, n_users + 1):
+        for i in range(1, n_items + 1):
+            if ((u * 2654435761 + i * 40503) >> 4) % 3 == 0:
+                lines.extend(row_fn(u, i))
+    return lines
+
+
 def test_quickstart_recommendation(rig, tmp_path):
     # 1. pio app new — parse the printed access key
     out = rig.run("app", "new", "QuickApp").stdout
@@ -224,17 +236,10 @@ def test_eval_batchpredict_dashboard(rig, tmp_path):
     rig.run("template", "get", "recommendation", str(engine_dir),
             "--app-name", "EvalApp")
 
-    # import deterministic but well-mixed ratings (hash-spread so held-out
-    # fold items still appear in other users' training splits — identical
-    # per-user item sets would make every fold's MAP legitimately 0)
-    lines = []
-    for u in range(1, 16):
-        for i in range(1, 25):
-            if ((u * 2654435761 + i * 40503) >> 4) % 3 == 0:
-                lines.append(json.dumps({
-                    "event": "rate", "entityType": "user", "entityId": str(u),
-                    "targetEntityType": "item", "targetEntityId": str(i),
-                    "properties": {"rating": float((u * 3 + i) % 5 + 1)}}))
+    lines = _spread(15, 24, lambda u, i: [json.dumps({
+        "event": "rate", "entityType": "user", "entityId": str(u),
+        "targetEntityType": "item", "targetEntityId": str(i),
+        "properties": {"rating": float((u * 3 + i) % 5 + 1)}})])
     events_file = tmp_path / "ratings.jsonl"
     events_file.write_text("\n".join(lines) + "\n")
     rig.run("import", "--appname", "EvalApp", "--input", str(events_file))
@@ -282,14 +287,10 @@ def test_train_checkpoint_resume(rig, tmp_path):
     engine_dir = tmp_path / "CkptEngine"
     rig.run("template", "get", "recommendation", str(engine_dir),
             "--app-name", "CkptApp")
-    lines = []
-    for u in range(1, 11):
-        for i in range(1, 21):
-            if ((u * 2654435761 + i * 40503) >> 4) % 3 == 0:
-                lines.append(json.dumps({
-                    "event": "rate", "entityType": "user", "entityId": str(u),
-                    "targetEntityType": "item", "targetEntityId": str(i),
-                    "properties": {"rating": float((u + i) % 5 + 1)}}))
+    lines = _spread(10, 20, lambda u, i: [json.dumps({
+        "event": "rate", "entityType": "user", "entityId": str(u),
+        "targetEntityType": "item", "targetEntityId": str(i),
+        "properties": {"rating": float((u + i) % 5 + 1)}})])
     f = tmp_path / "ev.jsonl"
     f.write_text("\n".join(lines) + "\n")
     rig.run("import", "--appname", "CkptApp", "--input", str(f))
@@ -307,3 +308,71 @@ def test_train_checkpoint_resume(rig, tmp_path):
                    cwd=str(engine_dir))
     assert "Training completed" in out2.stdout
     assert "resumed from checkpoint step" in (out2.stdout + out2.stderr)
+
+
+def test_similarproduct_and_ecommerce(rig, tmp_path):
+    """The remaining template pair through the real CLI: similarproduct
+    (item-item from implicit ALS) and ecommerce (serve-time business
+    rules incl. the unavailable-items constraint read through the event
+    store on the query path)."""
+    rig.run("app", "new", "ShopApp")
+    def shop_rows(u, i):
+        rows = [json.dumps({
+            "event": "view", "entityType": "user", "entityId": str(u),
+            "targetEntityType": "item", "targetEntityId": f"i{i}"})]
+        if (u + i) % 4 == 0:
+            rows.append(json.dumps({
+                "event": "buy", "entityType": "user", "entityId": str(u),
+                "targetEntityType": "item", "targetEntityId": f"i{i}"}))
+        return rows
+
+    lines = _spread(12, 18, shop_rows)
+    f = tmp_path / "shop.jsonl"
+    f.write_text("\n".join(lines) + "\n")
+    rig.run("import", "--appname", "ShopApp", "--input", str(f))
+
+    # -- similarproduct ---------------------------------------------------
+    sp_dir = tmp_path / "Similar"
+    rig.run("template", "get", "similarproduct", str(sp_dir),
+            "--app-name", "ShopApp")
+    rig.run("train", cwd=str(sp_dir))
+    port = rig.serve("deploy", "--ip", "127.0.0.1", "--port", "0",
+                     cwd=str(sp_dir),
+                     ready_re=r"deployed on 127\.0\.0\.1:(\d+)")
+    res = EngineClient(url=f"http://127.0.0.1:{port}").send_query(
+        {"items": ["i5"], "num": 3})  # i5: viewed by every user in the synth
+    assert len(res["itemScores"]) == 3
+    assert all(r["item"] != "i5" for r in res["itemScores"])  # excludes self
+
+    # -- ecommerce --------------------------------------------------------
+    ec_dir = tmp_path / "Shop"
+    rig.run("template", "get", "ecommerce", str(ec_dir),
+            "--app-name", "ShopApp")
+    rig.run("train", cwd=str(ec_dir))
+    port = rig.serve("deploy", "--ip", "127.0.0.1", "--port", "0",
+                     cwd=str(ec_dir),
+                     ready_re=r"deployed on 127\.0\.0\.1:(\d+)")
+    ec = EngineClient(url=f"http://127.0.0.1:{port}")
+    res = ec.send_query({"user": "3", "num": 4})
+    assert res["itemScores"], res
+    first_item = res["itemScores"][0]["item"]
+
+    # mark the top item unavailable via $set constraint — the reference's
+    # serve-time LEventStore lookup must drop it without redeploying
+    events_file = tmp_path / "constraint.jsonl"
+    events_file.write_text(json.dumps({
+        "event": "$set", "entityType": "constraint",
+        "entityId": "unavailableItems",
+        "properties": {"items": [first_item]}}) + "\n")
+    rig.run("import", "--appname", "ShopApp", "--input", str(events_file))
+    # serve-time caches expire; poll briefly for the rule to take effect
+    for _ in range(30):
+        res2 = ec.send_query({"user": "3", "num": 4})
+        if res2["itemScores"] and all(
+                r["item"] != first_item for r in res2["itemScores"]):
+            break
+        time.sleep(1)
+    # non-empty guard: an empty list would pass the all() vacuously while
+    # the filter is actually masking everything
+    assert res2["itemScores"], res2
+    assert all(r["item"] != first_item for r in res2["itemScores"]), res2
