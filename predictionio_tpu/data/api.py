@@ -258,17 +258,26 @@ class _EventHandler(JsonRequestHandler):
                 except le.integrity_errors:
                     # duplicate caller-set eventId somewhere in the chunk:
                     # the transaction rolled back — redo per event so only
-                    # the offending rows 400
+                    # the offending rows 400. Each row commits individually
+                    # here, so a non-integrity failure must become THAT
+                    # row's status, not a request-wide 500 that would
+                    # discard the statuses of rows already committed (a
+                    # naive full-batch retry would then duplicate them).
                     ids = []
                     for _, event in prepared:
                         try:
                             ids.append(le.insert(event, app_id, channel_id))
                         except le.integrity_errors:
                             ids.append(None)
+                        except Exception as e:  # noqa: BLE001
+                            ids.append(e)
                 for (i, event), eid in zip(prepared, ids):
                     if eid is None:
                         results[i] = {"status": 400, "message":
                                       f"duplicate eventId {event.event_id!r}"}
+                        continue
+                    if isinstance(eid, Exception):
+                        results[i] = {"status": 500, "message": str(eid)}
                         continue
                     results[i] = {"status": 201, "eventId": eid}
                     if self.stats:

@@ -85,7 +85,9 @@ def recommend_topk(
     # the [chunk, n_items] score tile stays ~1 GB.
     item_dev = jax.device_put(item_factors)
     if chunk is None:
-        chunk = max(1024, (1 << 28) // max(n_items, 1))
+        # no floor: a floor of 1024 would blow the ~1 GiB tile bound past
+        # ~262k items (at 10M items the [1024, n_items] tile is ~40 GB)
+        chunk = max(1, (1 << 28) // max(n_items, 1))
     chunk = min(chunk, len(user_ids))
     all_scores, all_idx = [], []
     for s in range(0, len(user_ids), chunk):

@@ -24,6 +24,7 @@ import http.client
 import json
 import threading
 import urllib.parse
+import uuid
 from datetime import datetime, timezone
 from typing import Any, Optional, Sequence, Union
 
@@ -93,13 +94,15 @@ class _BaseClient:
 
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None,
-                 body: Optional[Any] = None) -> Any:
+                 body: Optional[Any] = None,
+                 idempotent: bool = False) -> Any:
         q = {k: v for k, v in (query or {}).items() if v is not None}
         target = self._prefix + path
         if q:
             target += "?" + urllib.parse.urlencode(q)
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
+        idempotent = idempotent or method in ("GET", "DELETE")
         for attempt in (0, 1):
             conn, fresh = self._conn()
             sent = False
@@ -113,17 +116,21 @@ class _BaseClient:
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 self._drop_conn()
                 # Retry exactly once, and ONLY on a reused keep-alive where
-                # the request cannot have been processed: failure at send
-                # time, or RemoteDisconnected from getresponse (the server
-                # closed the idle connection before our request — the
-                # standard stale keep-alive race; a close WITHOUT a
-                # response means it was not processed). Timeouts and
-                # mid-response failures are NOT retried: the server may
-                # have processed them, and re-sending a POST would
-                # duplicate events.
+                # retrying is safe: failure at send time (request bytes
+                # never completed), or — for idempotent requests only —
+                # RemoteDisconnected from getresponse (the stale keep-alive
+                # race). A close without a response does NOT prove the
+                # server skipped the request (it may have died after
+                # processing but before replying), so non-idempotent POSTs
+                # are never replayed on it; event POSTs are made idempotent
+                # by the client-set eventId (see create_event), which turns
+                # a replay into a duplicate-rejection by the store's
+                # uniqueness constraint. Timeouts and mid-response failures
+                # are never retried.
                 can_retry = (not attempt and not fresh
                              and (not sent
-                                  or isinstance(e, http.client.RemoteDisconnected)))
+                                  or (idempotent and isinstance(
+                                      e, http.client.RemoteDisconnected))))
                 if not can_retry:
                     raise
         if 300 <= status < 400:
@@ -164,12 +171,25 @@ class EventClient(_BaseClient):
                      target_entity_type: Optional[str] = None,
                      target_entity_id: Optional[str] = None,
                      properties: Optional[dict] = None,
-                     event_time: Union[None, str, datetime] = None) -> str:
-        """POST /events.json → eventId."""
+                     event_time: Union[None, str, datetime] = None,
+                     event_id: Optional[str] = None) -> str:
+        """POST /events.json → eventId.
+
+        When `event_id` is not given, a fresh uuid is set client-side so
+        the POST is idempotent: a stale-keep-alive replay that hits an
+        already-committed first attempt is rejected by the store's
+        eventId uniqueness constraint, which this client maps back to
+        success (the id is fresh, so the only possible duplicate is our
+        own earlier attempt). Caller-supplied ids get no such mapping —
+        a duplicate then is a real error the caller must see.
+        """
+        generated = event_id is None
+        eid = event_id or uuid.uuid4().hex
         body: dict[str, Any] = {
             "event": event,
             "entityType": entity_type,
             "entityId": entity_id,
+            "eventId": eid,
         }
         if target_entity_type:
             body["targetEntityType"] = target_entity_type
@@ -179,13 +199,47 @@ class EventClient(_BaseClient):
             body["properties"] = properties
         if event_time:
             body["eventTime"] = _format_time(event_time)
-        out = self._request("POST", "/events.json", self._auth(), body)
+        try:
+            # only a client-generated id is replay-safe: its duplicate
+            # rejection provably means our own earlier attempt committed.
+            # A caller-supplied id gets no retry — a replay's 400 would be
+            # indistinguishable from the caller's own real duplicate.
+            out = self._request("POST", "/events.json", self._auth(), body,
+                                idempotent=generated)
+        except PredictionIOError as e:
+            if generated and e.status == 400 and "duplicate eventId" in e.message:
+                return eid
+            raise
         return out["eventId"]
 
     def create_batch_events(self, events: Sequence[dict]) -> list[dict]:
-        """POST /batch/events.json (≤50 events) → per-event results."""
-        return self._request("POST", "/batch/events.json", self._auth(),
-                             list(events))
+        """POST /batch/events.json (≤50 events) → per-event results.
+
+        Events lacking an `eventId` get a client-generated uuid (same
+        replay-safety contract as `create_event`); a duplicate rejection
+        for an id generated in this call means the row committed on a
+        previous send attempt and is reported as 201.
+        """
+        generated: set[str] = set()
+        payload = []
+        for d in events:
+            d = dict(d)
+            if not d.get("eventId"):
+                d["eventId"] = uuid.uuid4().hex
+                generated.add(d["eventId"])
+            payload.append(d)
+        # replay-safe only when EVERY row's id was generated here (a
+        # replayed caller-set row would surface as a spurious 400)
+        results = self._request("POST", "/batch/events.json", self._auth(),
+                                payload,
+                                idempotent=len(generated) == len(payload))
+        for d, r in zip(payload, results):
+            if (d["eventId"] in generated and isinstance(r, dict)
+                    and r.get("status") == 400
+                    and "duplicate eventId" in r.get("message", "")):
+                r.clear()
+                r.update({"status": 201, "eventId": d["eventId"]})
+        return results
 
     def get_event(self, event_id: str) -> dict:
         return self._request(

@@ -85,6 +85,66 @@ class TestEventClient:
         assert stats  # per-app counts present
 
 
+class TestEventIdDedup:
+    """Client-set eventIds make event POSTs replay-safe (ADVICE r1: a
+    RemoteDisconnected retry could otherwise duplicate an event the
+    server committed before dying)."""
+
+    def test_caller_event_id_roundtrip_and_real_duplicate_raises(
+            self, event_client):
+        eid = event_client.create_event(
+            event="view", entity_type="user", entity_id="u1",
+            event_id="fixed-id-1")
+        assert eid == "fixed-id-1"
+        # caller-supplied id: a duplicate is a real error, not mapped away
+        with pytest.raises(PredictionIOError) as ei:
+            event_client.create_event(
+                event="view", entity_type="user", entity_id="u1",
+                event_id="fixed-id-1")
+        assert ei.value.status == 400
+
+    def test_generated_id_duplicate_maps_to_success(
+            self, event_client, monkeypatch):
+        """A duplicate rejection for an id generated in this call proves a
+        previous send attempt committed — the client reports success."""
+        import uuid as _uuid
+
+        class FakeUUID:
+            hex = "replayed-uuid-0001"
+
+        event_client.create_event(
+            event="view", entity_type="user", entity_id="u1",
+            event_id=FakeUUID.hex)  # "the first attempt that committed"
+        monkeypatch.setattr("predictionio_tpu.sdk.uuid.uuid4",
+                            lambda: FakeUUID)
+        eid = event_client.create_event(
+            event="view", entity_type="user", entity_id="u1")
+        assert eid == FakeUUID.hex
+        # only one event stored despite two successful-looking creates
+        assert len([e for e in event_client.find_events(limit=-1)
+                    if e["eventId"] == FakeUUID.hex]) == 1
+
+    def test_batch_generated_id_duplicate_rewritten_to_201(
+            self, event_client, monkeypatch):
+        import uuid as _uuid
+
+        class FakeUUID:
+            hex = "replayed-batch-uuid"
+
+        base = {"event": "view", "entityType": "user", "entityId": "u1"}
+        first = event_client.create_batch_events(
+            [dict(base, eventId=FakeUUID.hex)])
+        assert first[0]["status"] == 201
+        monkeypatch.setattr("predictionio_tpu.sdk.uuid.uuid4",
+                            lambda: FakeUUID)
+        replay = event_client.create_batch_events([dict(base)])
+        assert replay[0] == {"status": 201, "eventId": FakeUUID.hex}
+        # caller-set duplicate in a batch still surfaces as 400
+        dup = event_client.create_batch_events(
+            [dict(base, eventId=FakeUUID.hex)])
+        assert dup[0]["status"] == 400
+
+
 class TestEngineClient:
     def test_send_query_against_deployed_engine(self, memory_storage):
         # train a tiny recommendation model through the real workflow,
