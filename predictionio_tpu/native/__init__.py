@@ -22,7 +22,10 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pio_native.cpp")
+_SRCS = [
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    for name in ("pio_native.cpp", "pio_scan.cpp")
+]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
@@ -35,16 +38,21 @@ def _build_dir() -> str:
 
 
 def _compile() -> Optional[str]:
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    tag = hashlib.blake2b(src, digest_size=8).hexdigest()
+    h = hashlib.blake2b(digest_size=8)
+    for src_path in _SRCS:
+        with open(src_path, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()
     out_dir = _build_dir()
     so_path = os.path.join(out_dir, f"pio_native_{tag}.so")
     if os.path.exists(so_path):
         return so_path
     os.makedirs(out_dir, exist_ok=True)
     tmp = so_path + f".build.{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    # -ldl: pio_scan.cpp dlopens libsqlite3 (a no-op on glibc >= 2.34
+    # where dlopen lives in libc)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", *_SRCS,
+           "-o", tmp, "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)
@@ -88,12 +96,84 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pio_fill_buckets.argtypes = [
             i32p, i32p, f32p, i64, ctypes.c_int32, i64, i64, i64, i64,
             i64p, i64p, i32p, i32p, f32p, f32p]
+        cstr = ctypes.c_char_p
+        cstrp = ctypes.POINTER(ctypes.c_char_p)
+        i64_out = ctypes.POINTER(ctypes.c_int64)
+        lib.pio_scan_open.restype = i64
+        lib.pio_scan_open.argtypes = [
+            cstr, cstr, cstrp, i64, cstr, cstrp, i64,
+            ctypes.POINTER(ctypes.c_void_p),
+            i64_out, i64_out, i64_out, i64_out, i64_out]
+        lib.pio_scan_fill.restype = i64
+        lib.pio_scan_fill.argtypes = [
+            ctypes.c_void_p, i32p, i32p, i32p, f32p,
+            np.ctypeslib.ndpointer(np.float64), ctypes.c_char_p,
+            ctypes.c_char_p]
+        lib.pio_scan_free.restype = None
+        lib.pio_scan_free.argtypes = [ctypes.c_void_p]
+        lib.pio_scan_error.restype = ctypes.c_char_p
+        lib.pio_scan_error.argtypes = []
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def columnar_scan_native(db_path: str, sql: str, params: list,
+                         value_key: Optional[str],
+                         event_names: list):
+    """Bulk columnar event scan via the C++ sqlite3 reader (pio_scan.cpp).
+
+    `sql` must select (entity_id, target_entity_id, event, properties,
+    event_time) with `?` placeholders bound from `params` (all bound as
+    text; sqlite's column affinity converts). Returns
+    (entity_codes, target_codes, event_codes, values, times,
+    entity_ids_sorted, target_ids_sorted) with codes in sorted-distinct
+    order, or None when the native path is unavailable or bails (caller
+    falls back to the pure-SQL scan).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    c_params = (ctypes.c_char_p * max(len(params), 1))(
+        *[str(p).encode() for p in params])
+    c_names = (ctypes.c_char_p * max(len(event_names), 1))(
+        *[str(s).encode() for s in event_names])
+    handle = ctypes.c_void_p()
+    n = ctypes.c_int64()
+    n_ent, ent_bytes = ctypes.c_int64(), ctypes.c_int64()
+    n_tgt, tgt_bytes = ctypes.c_int64(), ctypes.c_int64()
+    rc = lib.pio_scan_open(
+        db_path.encode(), sql.encode(), c_params, len(params),
+        value_key.encode() if value_key is not None else None,
+        c_names, len(event_names), ctypes.byref(handle),
+        ctypes.byref(n), ctypes.byref(n_ent), ctypes.byref(ent_bytes),
+        ctypes.byref(n_tgt), ctypes.byref(tgt_bytes))
+    if rc != 0:
+        log.info("native scan: %s — SQL fallback",
+                 lib.pio_scan_error().decode(errors="replace"))
+        return None
+    try:
+        nn = n.value
+        ent = np.empty(nn, np.int32)
+        tgt = np.empty(nn, np.int32)
+        ev = np.empty(nn, np.int32)
+        val = np.empty(nn, np.float32)
+        tim = np.empty(nn, np.float64)
+        ent_buf = ctypes.create_string_buffer(max(ent_bytes.value, 1))
+        tgt_buf = ctypes.create_string_buffer(max(tgt_bytes.value, 1))
+        if lib.pio_scan_fill(handle, ent, tgt, ev, val, tim,
+                             ent_buf, tgt_buf) != 0:
+            return None
+        ent_ids = (ent_buf.raw[:ent_bytes.value].decode().split("\0")[:-1]
+                   if n_ent.value else [])
+        tgt_ids = (tgt_buf.raw[:tgt_bytes.value].decode().split("\0")[:-1]
+                   if n_tgt.value else [])
+        return ent, tgt, ev, val, tim, ent_ids, tgt_ids
+    finally:
+        lib.pio_scan_free(handle)
 
 
 def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,

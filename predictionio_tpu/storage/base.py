@@ -13,9 +13,12 @@ import abc
 import dataclasses
 import secrets
 from datetime import datetime
-from typing import Iterable, Optional
+from typing import TYPE_CHECKING, Iterable, Optional
 
 from predictionio_tpu.data.events import Event
+
+if TYPE_CHECKING:
+    from predictionio_tpu.data.columnar import EventColumns
 
 
 @dataclasses.dataclass
@@ -247,6 +250,47 @@ class LEvents(abc.ABC):
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterable[Event]: ...
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        target_entity_type: Optional[str] = None,
+        event_names: Optional[list[str]] = None,
+        value_key: Optional[str] = None,
+        ordered: bool = True,
+    ) -> "EventColumns":
+        """Bulk columnar scan: integer-coded entity/target/event columns +
+        one numeric property column, no per-event Python objects (the
+        reference's HBase `TableInputFormat` scan role — SURVEY.md §2.2
+        [U]). Default implementation folds over `find()` so every backend
+        has the interface; SQL backends override with a pushed-down query
+        (see `storage/sqlite.py`). BiMap codes are assigned in sorted
+        order of the distinct ids on every path.
+        """
+        from predictionio_tpu.data.columnar import (
+            columns_from_events,
+            columns_from_numeric_rows,
+        )
+
+        if event_names is not None and not event_names:
+            # explicit empty filter selects nothing (the find() layers
+            # treat [] as "no filter" — that must not leak special events
+            # into a columnar scan)
+            return columns_from_numeric_rows([], [], [], [])
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+        )
+        return columns_from_events(events, event_names, value_key, ordered)
 
 
 class StorageBackend(abc.ABC):

@@ -45,9 +45,38 @@ def _load_driver():
         return None, ""
 
 
+def _qmark_to_format(sql: str) -> str:
+    """qmark → format placeholders, leaving `?` inside single-quoted
+    string literals alone (the columnar scan's regex literal contains
+    `?` quantifiers that a naive replace would corrupt). Handles the ''
+    escape; our SQL carries no literal `%`, so no doubling is needed."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            if ch == "'":
+                if i + 1 < len(sql) and sql[i + 1] == "'":
+                    out.append("''")
+                    i += 2
+                    continue
+                in_str = False
+            out.append(ch)
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+        elif ch == "?":
+            out.append("%s")
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 def translate_sql(sql: str) -> str:
     """SQLite-dialect SQL (as written in storage/sqlite.py) → Postgres."""
-    out = sql.replace("?", "%s")
+    out = _qmark_to_format(sql)
     out = out.replace("INTEGER PRIMARY KEY AUTOINCREMENT", "SERIAL PRIMARY KEY")
     out = out.replace("BLOB", "BYTEA")
     # sqlite upsert spelling → standard ON CONFLICT (only the models blob
@@ -191,10 +220,57 @@ class PostgresBackend(SQLiteBackend):
                     "postgres: pg8000 does not accept DSN option(s) %s; "
                     "ignored (psycopg2 supports them)", ", ".join(dropped))
                 kwargs = {k: v for k, v in kwargs.items() if k in supported}
+            if not kwargs.get("user"):
+                # pg8000.connect() requires `user`; psycopg2 defaults it to
+                # the OS user. Fail with a configuration error, not pg8000's
+                # opaque TypeError.
+                raise ValueError(
+                    "postgres DSN has no username, and the pg8000 driver "
+                    "does not default it; add user=... (or user@host) to "
+                    f"the DSN {self.path!r}"
+                )
         conn = self._driver.connect(**kwargs)
         with self._conns_lock:
             self._all_conns.append(conn)
         return conn
+
+    # -- columnar-scan dialect hooks (sqlite spellings → Postgres) --------
+    def _sql_epoch(self, col: str) -> str:
+        return f"EXTRACT(EPOCH FROM ({col})::timestamptz)"
+
+    def _sql_json_num(self, col: str) -> str:
+        # top-level key lookup; `?` is translated to %s by the cursor
+        # adapter and receives the bare key (no $-path). Type-gated like
+        # the sqlite spelling: non-numeric text → NULL (missing), not an
+        # error/0.0
+        t = f"jsonb_typeof(({col})::jsonb -> ?)"
+        v = f"(({col})::jsonb ->> ?)"
+        return (
+            f"CASE {t} "
+            f"WHEN 'number' THEN {v}::float8 "
+            f"WHEN 'boolean' THEN (CASE {v} WHEN 'true' THEN 1.0 ELSE 0.0 END) "
+            f"WHEN 'string' THEN (CASE WHEN {v} ~ "
+            f"'^[+-]?([0-9]+\\.?[0-9]*|\\.[0-9]+)([eE][+-]?[0-9]+)?$' "
+            f"THEN {v}::float8 END) "
+            f"END"
+        )
+
+    _json_num_param_count = 5
+
+    def _json_key_param(self, key: str) -> str:
+        return key
+
+    def _sql_inf(self) -> str:
+        return "'Infinity'::float8"
+
+    def _begin_snapshot(self, cur) -> None:
+        # drivers open the transaction implicitly at the first statement;
+        # SET TRANSACTION must be that first statement (an explicit BEGIN
+        # would warn "already a transaction in progress" under psycopg2)
+        cur.execute("SET TRANSACTION ISOLATION LEVEL REPEATABLE READ")
+
+    def _native_scan_path(self):
+        return None  # the C++ reader is sqlite-only; use the SQL tier
 
     def _cursor(self):
         outer = super()._cursor()

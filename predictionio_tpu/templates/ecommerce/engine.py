@@ -41,7 +41,7 @@ from predictionio_tpu.controller import (
     SanityCheck,
     WorkflowContext,
 )
-from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.bimap import BiMap, compress_codes
 from predictionio_tpu.data.store import LEventStore, PEventStore
 from predictionio_tpu.ops.als import ALSConfig, als_train
 from predictionio_tpu.storage.registry import Storage
@@ -86,13 +86,27 @@ class DataSourceParams(Params):
 
 @dataclasses.dataclass
 class TrainingData(SanityCheck):
-    users: list  # interaction user ids, aligned with items
-    items: list
+    """Columnar view/buy events (coded COO via BiMaps — no per-event
+    Python; VERDICT r1 #4) + per-item category properties."""
+
+    user_idx: np.ndarray  # [n] int32 codes into user_ids
+    item_idx: np.ndarray  # [n] int32 codes into item_ids
     weights: np.ndarray  # [n] float32 — buy counts more than view
+    user_ids: BiMap
+    item_ids: BiMap
     item_categories: dict  # item id → [category]
 
+    @property
+    def users(self) -> list:
+        """Decoded user id strings (debug/compat view; O(n) Python)."""
+        return self.user_ids.from_index(self.user_idx)
+
+    @property
+    def items(self) -> list:
+        return self.item_ids.from_index(self.item_idx)
+
     def sanity_check(self):
-        if not self.users:
+        if not len(self.user_idx):
             raise ValueError(
                 "TrainingData has no view/buy events; ingest events first."
             )
@@ -109,18 +123,21 @@ class DataSource(BaseDataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         store = PEventStore(ctx.storage)
-        users, items, weights = [], [], []
-        for e in store.find(
+        cols = store.find_columnar(
             app_name=self.params.appName,
             entity_type="user",
             target_entity_type="item",
             event_names=list(self.params.eventNames),
-        ):
-            if e.target_entity_id is None:
-                continue
-            users.append(e.entity_id)
-            items.append(e.target_entity_id)
-            weights.append(self.EVENT_WEIGHTS.get(e.event, 1.0))
+            ordered=False,  # summed per-pair confidence is order-invariant
+        )
+        valid = cols.target_ids >= 0
+        weight_of = np.asarray(
+            [self.EVENT_WEIGHTS.get(name, 1.0) for name in cols.event_names],
+            dtype=np.float32,
+        )
+        weights = (weight_of[cols.event_codes[valid]]
+                   if len(cols.event_names)
+                   else np.empty(0, np.float32))
         item_props = store.aggregate_properties(
             app_name=self.params.appName, entity_type="item"
         )
@@ -130,11 +147,15 @@ class DataSource(BaseDataSource):
         }
         log.info(
             "DataSource: %d view/buy events, %d items with properties, app %r",
-            len(users), len(item_categories), self.params.appName,
+            int(valid.sum()), len(item_categories), self.params.appName,
         )
         return TrainingData(
-            users, items, np.asarray(weights, dtype=np.float32),
-            item_categories,
+            user_idx=cols.entity_ids[valid],
+            item_idx=cols.target_ids[valid],
+            weights=weights,
+            user_ids=cols.entity_bimap,
+            item_ids=cols.target_bimap,
+            item_categories=item_categories,
         )
 
 
@@ -152,10 +173,9 @@ class Preparator(BasePreparator):
     """BiMap ids; sum repeated interactions into per-pair confidence."""
 
     def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
-        user_ids = BiMap.string_int(td.users)
-        item_ids = BiMap.string_int(td.items)
-        u = user_ids.to_index(td.users)
-        i = item_ids.to_index(td.items)
+        # re-code densely over present entities
+        u, user_ids = compress_codes(td.user_idx, td.user_ids)
+        i, item_ids = compress_codes(td.item_idx, td.item_ids)
         n_items = max(len(item_ids), 1)
         pair = u.astype(np.int64) * n_items + i
         uniq, inverse = np.unique(pair, return_inverse=True)

@@ -33,7 +33,7 @@ from predictionio_tpu.controller import (
     SanityCheck,
     WorkflowContext,
 )
-from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.bimap import BiMap, compress_codes
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.ops.als import ALSConfig, als_train
 
@@ -51,12 +51,26 @@ class DataSourceParams(Params):
 
 @dataclasses.dataclass
 class TrainingData(SanityCheck):
-    users: list  # view-event user ids (strings), aligned with items
-    items: list  # viewed item ids
-    item_categories: dict  # item id → list of category strings ($set props)
+    """Columnar view events (coded COO via BiMaps — no per-event Python;
+    VERDICT r1 #4) + per-item category properties ($set-folded)."""
+
+    user_idx: np.ndarray  # [n] int32 codes into user_ids
+    item_idx: np.ndarray  # [n] int32 codes into item_ids
+    user_ids: BiMap
+    item_ids: BiMap
+    item_categories: dict  # item id string → list of category strings
+
+    @property
+    def users(self) -> list:
+        """Decoded user id strings (debug/compat view; O(n) Python)."""
+        return self.user_ids.from_index(self.user_idx)
+
+    @property
+    def items(self) -> list:
+        return self.item_ids.from_index(self.item_idx)
 
     def sanity_check(self):
-        if not self.users:
+        if not len(self.user_idx):
             raise ValueError(
                 "TrainingData has no view events; ingest view events first."
             )
@@ -70,17 +84,14 @@ class DataSource(BaseDataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         store = PEventStore(ctx.storage)
-        users, items = [], []
-        for e in store.find(
+        cols = store.find_columnar(
             app_name=self.params.appName,
             entity_type="user",
             target_entity_type="item",
             event_names=list(self.params.similarEvents),
-        ):
-            if e.target_entity_id is None:
-                continue
-            users.append(e.entity_id)
-            items.append(e.target_entity_id)
+            ordered=False,  # per-pair counts are order-invariant
+        )
+        valid = cols.target_ids >= 0
         item_props = store.aggregate_properties(
             app_name=self.params.appName, entity_type="item"
         )
@@ -90,9 +101,15 @@ class DataSource(BaseDataSource):
         }
         log.info(
             "DataSource: %d view events, %d items with properties, app %r",
-            len(users), len(item_categories), self.params.appName,
+            int(valid.sum()), len(item_categories), self.params.appName,
         )
-        return TrainingData(users, items, item_categories)
+        return TrainingData(
+            user_idx=cols.entity_ids[valid],
+            item_idx=cols.target_ids[valid],
+            user_ids=cols.entity_bimap,
+            item_ids=cols.target_bimap,
+            item_categories=item_categories,
+        )
 
 
 @dataclasses.dataclass
@@ -110,12 +127,11 @@ class Preparator(BasePreparator):
     'rating' — «MLlib ALS.trainImplicit» treats values as confidence)."""
 
     def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
-        user_ids = BiMap.string_int(td.users)
-        # items seen only via $set still get factors' rows? No — factors come
-        # from interactions; category-only items can never score anyway.
-        item_ids = BiMap.string_int(td.items)
-        u = user_ids.to_index(td.users)
-        i = item_ids.to_index(td.items)
+        # re-code densely over present entities. Items seen only via $set
+        # get no factor rows — factors come from interactions;
+        # category-only items can never score anyway.
+        u, user_ids = compress_codes(td.user_idx, td.user_ids)
+        i, item_ids = compress_codes(td.item_idx, td.item_ids)
         pair = u.astype(np.int64) * max(len(item_ids), 1) + i
         uniq, counts = np.unique(pair, return_counts=True)
         return PreparedData(

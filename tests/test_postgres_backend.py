@@ -166,3 +166,32 @@ class TestReposThroughAdapter:
         ids = events.insert_batch(batch, app_id=1)
         assert len(set(ids)) == 7
         assert len(events.find(app_id=1)) == 7
+
+
+class TestColumnarDialect:
+    def test_qmark_translation_spares_quoted_literals(self):
+        """The pg value-extraction regex contains `?` quantifiers inside
+        a quoted literal; placeholder translation must not touch them
+        (r2 review)."""
+        from predictionio_tpu.storage.postgres import translate_sql
+
+        sql = "SELECT a ~ '^[+-]?[0-9]?$', b FROM t WHERE c=? AND d='??'"
+        out = translate_sql(sql)
+        assert out == ("SELECT a ~ '^[+-]?[0-9]?$', b FROM t "
+                       "WHERE c=%s AND d='??'")
+
+    def test_json_num_placeholder_count_matches(self):
+        """_json_num_param_count must equal the number of real (unquoted)
+        placeholders in the dialect's _sql_json_num expression."""
+        from predictionio_tpu.storage.postgres import (
+            PostgresBackend, _qmark_to_format,
+        )
+        from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+        sq = SQLiteBackend(":memory:")
+        expr = sq._sql_json_num("properties")
+        assert expr.count("?") == sq._json_num_param_count
+        # pg expression: count placeholders the translator would bind
+        pg_expr = PostgresBackend._sql_json_num(sq, "properties")
+        translated = _qmark_to_format(pg_expr)
+        assert translated.count("%s") == PostgresBackend._json_num_param_count
