@@ -591,9 +591,21 @@ def als_train(
     if mesh is None:
         mesh = make_mesh()
     n_data = mesh.shape.get(DATA_AXIS, 1)
-    row_multiple = max(8, n_data)
-    if row_multiple % n_data:  # non-power-of-two data axis: keep shards even
-        row_multiple = 8 * n_data
+    from predictionio_tpu.parallel.mesh import MODEL_AXIS
+
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    model_sharded = n_model > 1
+    if model_sharded:
+        # factors shard P('model'); per-device chunks must stay a multiple
+        # of the model-axis size for the per-chunk psum_scatter
+        from predictionio_tpu.ops import als_sharded
+
+        rm_local = als_sharded.local_row_multiple(n_model)
+        row_multiple = rm_local * n_data
+    else:
+        row_multiple = max(8, n_data)
+        if row_multiple % n_data:  # non-pow2 data axis: keep shards even
+            row_multiple = 8 * n_data
 
     if mesh.size > 1 and cfg.pallas == "on":
         # the fused gather+Gram kernel is a single-device program; under a
@@ -650,8 +662,16 @@ def als_train(
         for b in buckets:
             r_total, cap = b.cols.shape
             # pad rows to a chunk multiple so the fori_loop chunk walk in
-            # _solve_buckets_device covers the whole bucket exactly
-            chunk = _bucket_chunk_rows(r_total, cap, cfg.rank, row_multiple)
+            # _solve_buckets_device covers the whole bucket exactly. In
+            # model-sharded mode the walk runs per device on local rows,
+            # so the alignment is computed in local units × n_data.
+            if model_sharded:
+                r_local = r_total // n_data
+                chunk = n_data * _bucket_chunk_rows(
+                    r_local, cap, cfg.rank, rm_local)
+            else:
+                chunk = _bucket_chunk_rows(r_total, cap, cfg.rank,
+                                           row_multiple)
             pad = (-r_total) % chunk
             arrs = dict(rows=b.rows, cols=b.cols, vals=b.vals, mask=b.mask,
                         segmap=b.segmap)
@@ -677,13 +697,35 @@ def als_train(
     u_split_dev = jax.device_put(u_split, rep)
     i_split_dev = jax.device_put(i_split, rep)
 
+    # factor sharding: replicated on a data-only mesh; row-sharded over
+    # the `model` axis otherwise (VERDICT r1 #3 — config 5's capability)
+    if model_sharded:
+        n_users_pad = als_sharded.pad_to(max(n_users, 1), n_model)
+        n_items_pad = als_sharded.pad_to(max(n_items, 1), n_model)
+        factor_sharding = NamedSharding(mesh, P(MODEL_AXIS, None))
+    else:
+        n_users_pad, n_items_pad = n_users, n_items
+        factor_sharding = rep
+
+    def place_factors(uf, itf):
+        """Host/device [n, K] factor pairs → padded, sharded device arrays
+        (pad rows are zero so implicit-mode Gram sums are unaffected)."""
+        uf = np.asarray(uf)
+        itf = np.asarray(itf)
+        if model_sharded:
+            uf = np.concatenate(
+                [uf, np.zeros((n_users_pad - n_users, cfg.rank), uf.dtype)])
+            itf = np.concatenate(
+                [itf, np.zeros((n_items_pad - n_items, cfg.rank), itf.dtype)])
+        return (jax.device_put(uf, factor_sharding),
+                jax.device_put(itf, factor_sharding))
+
     # init item factors ~ N(0, 1/sqrt(rank)) like MLlib; users solved first
     key = jax.random.key(cfg.seed)
-    item_factors = jax.device_put(
-        (jax.random.normal(key, (n_items, cfg.rank), dtype=dtype) / np.sqrt(cfg.rank)),
-        rep,
-    )
-    user_factors = jax.device_put(jnp.zeros((n_users, cfg.rank), dtype=dtype), rep)
+    item_init = (jax.random.normal(key, (n_items, cfg.rank), dtype=dtype)
+                 / np.sqrt(cfg.rank))
+    user_factors, item_factors = place_factors(
+        jnp.zeros((n_users, cfg.rank), dtype=dtype), item_init)
 
     import time
 
@@ -727,8 +769,7 @@ def als_train(
                         and uf is not None and vf is not None
                         and uf.shape == (n_users, cfg.rank)
                         and vf.shape == (n_items, cfg.rank)):
-                    user_factors = jax.device_put(uf, rep)
-                    item_factors = jax.device_put(vf, rep)
+                    user_factors, item_factors = place_factors(uf, vf)
                     restore_step = start_iter = usable[-1]
                     rmse_history = list(meta.get("rmse_history", []))[:start_iter]
                     log.info("als_train: resumed from checkpoint step %d",
@@ -759,10 +800,19 @@ def als_train(
                    if manager else cfg.iterations - done)
         # cache key excludes cfg.iterations (the traced program only sees
         # n_steps) so runs differing in iteration count share the compile
-        train = _get_train_loop(n_users, n_items,
-                                dataclasses.replace(cfg, iterations=0),
-                                compute_rmse, n_steps, row_multiple,
-                                mesh if mesh.size > 1 else None)
+        if model_sharded:
+            train = als_sharded.get_train_loop_sharded(
+                n_users_pad, n_items_pad,
+                dataclasses.replace(cfg, iterations=0), compute_rmse,
+                n_steps, rm_local, mesh,
+                tuple(b[4] is not None for b in ub_dev),
+                tuple(b[4] is not None for b in ib_dev),
+                len(u_split), len(i_split))
+        else:
+            train = _get_train_loop(n_users, n_items,
+                                    dataclasses.replace(cfg, iterations=0),
+                                    compute_rmse, n_steps, row_multiple,
+                                    mesh if mesh.size > 1 else None)
         user_factors, item_factors, rmses = train(item_factors, user_factors,
                                                   ub_dev, ib_dev,
                                                   u_split_dev, i_split_dev)
@@ -781,8 +831,8 @@ def als_train(
                 first_save_done = True
             manager.save(
                 done,
-                {"user_factors": np.asarray(user_factors),
-                 "item_factors": np.asarray(item_factors)},
+                {"user_factors": np.asarray(user_factors)[:n_users],
+                 "item_factors": np.asarray(item_factors)[:n_items]},
                 metadata={"rmse_history": rmse_history,
                           "iterations": cfg.iterations, "rank": cfg.rank,
                           "fingerprint": fingerprint},
@@ -802,8 +852,8 @@ def als_train(
                  rmse_history[0], rmse_history[-1], cfg.iterations)
 
     return ALSResult(
-        user_factors=np.asarray(user_factors),
-        item_factors=np.asarray(item_factors),
+        user_factors=np.asarray(user_factors)[:n_users],
+        item_factors=np.asarray(item_factors)[:n_items],
         rmse_history=rmse_history,
         epoch_times=epoch_times,
         start_epoch=start_iter,

@@ -345,3 +345,88 @@ class TestShardedGJSolver:
                                    rtol=5e-4, atol=5e-5)
         np.testing.assert_allclose(out_gj.item_factors, out_chol.item_factors,
                                    rtol=5e-4, atol=5e-5)
+
+
+class TestModelShardedALS:
+    """Factor sharding over the mesh `model` axis (VERDICT r1 #3 /
+    SURVEY.md §2.6 row 2): on a (data=4, model=2) mesh the factor
+    matrices shard P('model') and per-chunk normal equations combine via
+    psum_scatter + all_gather. Results must match the replicated path."""
+
+    def _mesh(self):
+        from predictionio_tpu.parallel.mesh import (
+            DATA_AXIS, MODEL_AXIS, make_mesh,
+        )
+
+        return make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_matches_replicated_path(self, implicit):
+        ui, ii, r, _ = synth_ratings(n_users=50, n_items=34, seed=7)
+        cfg = ALSConfig(rank=6, iterations=4, reg=0.05, seed=3,
+                        implicit=implicit, alpha=2.0, solver="chol",
+                        split_cap=8)  # small cap → segment accumulators
+        ref = als_train(ui, ii, r, 50, 34, cfg, compute_rmse=True)
+        out = als_train(ui, ii, r, 50, 34, cfg, mesh=self._mesh(),
+                        compute_rmse=True)
+        assert out.user_factors.shape == (50, 6)
+        assert out.item_factors.shape == (34, 6)
+        np.testing.assert_allclose(out.user_factors, ref.user_factors,
+                                   rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(out.item_factors, ref.item_factors,
+                                   rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(out.rmse_history, ref.rmse_history,
+                                   rtol=1e-3)
+
+    def test_uses_sharded_loop_and_sharded_factors(self, monkeypatch):
+        """The model-axis mesh must actually route through the sharded
+        loop with non-replicated factor specs (guards against silently
+        replicating — ROADMAP r1's admitted gap)."""
+        import jax
+        from predictionio_tpu.ops import als_sharded
+
+        seen_shardings = []
+        real = als_sharded.get_train_loop_sharded.__wrapped__
+
+        def spy(*args, **kw):
+            fn = real(*args, **kw)
+
+            def wrapper(item_f, user_f, *rest):
+                seen_shardings.append(item_f.sharding.spec)
+                return fn(item_f, user_f, *rest)
+
+            return wrapper
+
+        monkeypatch.setattr(als_sharded, "get_train_loop_sharded", spy)
+        ui, ii, r, _ = synth_ratings(n_users=24, n_items=16, seed=1)
+        cfg = ALSConfig(rank=4, iterations=2, reg=0.1, seed=0, solver="chol")
+        als_train(ui, ii, r, 24, 16, cfg, mesh=self._mesh())
+        assert seen_shardings, "sharded loop was not used on a model-axis mesh"
+        from predictionio_tpu.parallel.mesh import MODEL_AXIS
+
+        assert seen_shardings[0][0] == MODEL_AXIS
+
+    def test_chunked_walk_matches(self, monkeypatch):
+        """Chunked per-device bucket walk (tiny budget) under the sharded
+        path still reproduces the replicated result."""
+        import predictionio_tpu.ops.als as als_mod
+
+        ui, ii, r, _ = synth_ratings(n_users=50, n_items=34, seed=9)
+        cfg = ALSConfig(rank=4, iterations=3, reg=0.05, seed=5,
+                        solver="chol")
+        ref = als_train(ui, ii, r, 50, 34, cfg)
+        monkeypatch.setattr(als_mod, "_CHUNK_BUDGET_BYTES", 64 * 1024)
+        out = als_train(ui, ii, r, 50, 34, cfg, mesh=self._mesh())
+        np.testing.assert_allclose(out.user_factors, ref.user_factors,
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_rank_128_smoke(self):
+        """Config-5's rank on the 8-device mesh (CPU, 1 iteration): runs,
+        shapes right, finite."""
+        ui, ii, r, _ = synth_ratings(n_users=40, n_items=24, seed=2)
+        cfg = ALSConfig(rank=128, iterations=1, reg=0.1, seed=0,
+                        solver="chol")
+        out = als_train(ui, ii, r, 40, 24, cfg, mesh=self._mesh())
+        assert out.user_factors.shape == (40, 128)
+        assert np.isfinite(out.user_factors).all()
+        assert np.isfinite(out.item_factors).all()
