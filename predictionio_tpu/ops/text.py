@@ -6,8 +6,12 @@ MLlib («HashingTF»/«IDF» and «mllib.feature.Word2Vec.fit» — SURVEY.md §
 embedding updates averaged on the driver, SURVEY.md §2.6 strategy 3); here
 it is skip-gram with negative sampling as ONE jitted `lax.scan` over
 minibatch steps — embedding gathers, a [B,K]·[B,K] contraction, and
-scatter-add updates, with the batch axis sharded over the mesh `data` axis
-so gradient reductions become GSPMD psums.
+scatter-add updates. On a multi-device mesh the per-step pair batch is
+sharded over the `data` axis under `shard_map`
+(`_w2v_train_loop_sharded`): each device computes sparse row-gradients
+for its slice, an `all_gather` rejoins them, and every replica applies
+the identical update — exact single-device semantics at 1/d the gradient
+FLOPs per device.
 
 Host side stays minimal: tokenization and the skip-gram pair enumeration
 (ragged, string-ish work XLA can't help with); everything per-step runs on
@@ -211,6 +215,92 @@ def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=16)
+def _w2v_train_loop_sharded(n_pairs: int, vocab_size: int,
+                            cfg: Word2VecConfig, mesh):
+    """Data-parallel variant (SURVEY.md §2.6 strategy 3, «Word2Vec.fit»'s
+    parameter-mixing DP re-expressed for ICI): the per-step pair batch is
+    sharded over the mesh `data` axis — each device computes the SGNS
+    row-gradients for its B/d slice — and the sparse gradients rejoin
+    with one `all_gather` ([B, K]-sized, the sparse analogue of a psum'd
+    dense gradient) before every device applies the identical scatter
+    update to its replica. Sampling uses the replicated key, so the
+    result matches the single-device loop exactly (same pairs, same
+    updates; only reduction order differs). A dense-gradient psum would
+    move [V, K] per step — this moves B·(N+2)·K."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+    n_data = mesh.shape[DATA_AXIS]
+    b_loc = cfg.batch_size // n_data
+
+    def run(key, pairs, emb_in0, emb_out0):
+        inv_b = 1.0 / cfg.batch_size
+        lr = cfg.learning_rate
+
+        def step(carry, _):
+            emb_in, emb_out, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            # replicated sampling: every device derives the same full
+            # batch, then works its own slice
+            idx = jax.random.randint(k1, (cfg.batch_size,), 0, n_pairs)
+            batch = pairs[idx]  # [B, 2]
+            center, ctx = batch[:, 0], batch[:, 1]
+            neg = jax.random.randint(
+                k2, (cfg.batch_size, cfg.negatives), 0, vocab_size)
+
+            off = lax.axis_index(DATA_AXIS) * b_loc
+            center_l = lax.dynamic_slice_in_dim(center, off, b_loc, 0)
+            ctx_l = lax.dynamic_slice_in_dim(ctx, off, b_loc, 0)
+            neg_l = lax.dynamic_slice_in_dim(neg, off, b_loc, 0)
+
+            c = emb_in[center_l]  # [B/d, K]
+            pos = emb_out[ctx_l]
+            ngs = emb_out[neg_l]  # [B/d, N, K]
+            pos_score = jnp.sum(c * pos, axis=-1)
+            neg_score = jnp.einsum("bk,bnk->bn", c, ngs)
+            loss = -lax.psum(
+                jax.nn.log_sigmoid(pos_score).sum()
+                + jax.nn.log_sigmoid(-neg_score).sum(),
+                DATA_AXIS) * inv_b
+            g_pos = (jax.nn.sigmoid(pos_score) - 1.0) * inv_b
+            g_neg = jax.nn.sigmoid(neg_score) * inv_b
+            g_c_l = (g_pos[:, None] * pos
+                     + jnp.einsum("bn,bnk->bk", g_neg, ngs))
+            g_ctx_l = g_pos[:, None] * c
+            g_ngs_l = g_neg[..., None] * c[:, None, :]
+
+            # sparse-gradient exchange: rows are already known everywhere
+            # (replicated sampling); only the gradient values travel
+            g_c = lax.all_gather(g_c_l, DATA_AXIS, axis=0, tiled=True)
+            g_ctx = lax.all_gather(g_ctx_l, DATA_AXIS, axis=0, tiled=True)
+            g_ngs = lax.all_gather(g_ngs_l, DATA_AXIS, axis=0, tiled=True)
+
+            emb_in = emb_in.at[center].add(-lr * g_c)
+            emb_out = emb_out.at[ctx].add(-lr * g_ctx)
+            emb_out = emb_out.at[neg.reshape(-1)].add(
+                -lr * g_ngs.reshape(-1, g_ngs.shape[-1]))
+            return (emb_in, emb_out, key), loss
+
+        (emb_in, emb_out, _), losses = lax.scan(
+            step, (emb_in0, emb_out0, key), xs=None, length=cfg.steps)
+        return emb_in, losses
+
+    from jax.sharding import PartitionSpec as P
+
+    rep = P()
+    shard = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(rep, rep, rep, rep),
+        out_specs=(rep, rep),
+        check_vma=False,  # replicated-in/replicated-out by construction
+    )
+    return jax.jit(shard)
+
+
 def word2vec_train(
     docs_tokens: Sequence[Sequence[str]],
     cfg: Word2VecConfig = Word2VecConfig(),
@@ -241,7 +331,17 @@ def word2vec_train(
     emb_out = jax.device_put(jnp.zeros((v, cfg.dim), dtype=jnp.float32), rep)
     pairs_dev = jax.device_put(jnp.asarray(pairs), rep)
 
-    run = _w2v_train_loop(len(pairs), v, cfg)
+    from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+    n_data = mesh.shape.get(DATA_AXIS, 1) if mesh.size > 1 else 1
+    if n_data > 1 and cfg.batch_size % n_data == 0:
+        run = _w2v_train_loop_sharded(len(pairs), v, cfg, mesh)
+    else:
+        if n_data > 1:
+            log.warning(
+                "word2vec_train: batch_size %d not divisible by data axis "
+                "%d — running the single-device loop", cfg.batch_size, n_data)
+        run = _w2v_train_loop(len(pairs), v, cfg)
     emb, losses = run(k_run, pairs_dev, emb_in, emb_out)
     losses = np.asarray(losses)
     log.info(

@@ -263,3 +263,78 @@ class TestWord2VecSparseStep:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(losses), dense_losses,
                                    rtol=1e-5)
+
+
+class TestWord2VecDataParallel:
+    """VERDICT r1 #5: the advertised Word2Vec data parallelism must be
+    real — pair batches sharded over the 8-device `data` axis, sparse
+    gradients all_gathered — and equal the single-device loop exactly
+    (same replicated sampling, same updates)."""
+
+    def _cfg(self, **kw):
+        from predictionio_tpu.ops.text import Word2VecConfig
+
+        base = dict(dim=8, steps=5, batch_size=64, negatives=4,
+                    learning_rate=0.1, seed=0)
+        base.update(kw)
+        return Word2VecConfig(**base)
+
+    def test_sharded_loop_matches_single_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.ops.text import (
+            _w2v_train_loop,
+            _w2v_train_loop_sharded,
+        )
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        V, P = 60, 300
+        cfg = self._cfg()
+        rng = np.random.default_rng(3)
+        pairs = jnp.asarray(rng.integers(0, V, (P, 2)), dtype=jnp.int32)
+        emb_in0 = jnp.asarray(rng.normal(size=(V, cfg.dim)), jnp.float32)
+        emb_out0 = jnp.asarray(rng.normal(size=(V, cfg.dim)), jnp.float32)
+        key = jax.random.key(11)
+
+        ref, ref_losses = _w2v_train_loop(P, V, cfg)(
+            key, pairs, emb_in0, emb_out0)
+        mesh = make_mesh({DATA_AXIS: 8})
+        out, losses = _w2v_train_loop_sharded(P, V, cfg, mesh)(
+            key, pairs, emb_in0, emb_out0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(losses),
+                                   np.asarray(ref_losses),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_word2vec_train_routes_through_sharded_loop(self, monkeypatch):
+        import predictionio_tpu.ops.text as text_mod
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        calls = []
+        real = text_mod._w2v_train_loop_sharded.__wrapped__
+
+        def spy(*a, **k):
+            calls.append(a)
+            return real(*a, **k)
+
+        monkeypatch.setattr(text_mod, "_w2v_train_loop_sharded", spy)
+        docs = [["a", "b", "c", "d"]] * 20
+        text_mod.word2vec_train(
+            docs, self._cfg(steps=2), mesh=make_mesh({DATA_AXIS: 8}))
+        assert calls, "multi-device mesh did not use the sharded loop"
+
+    def test_indivisible_batch_falls_back(self, caplog):
+        import logging
+
+        import predictionio_tpu.ops.text as text_mod
+        from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        docs = [["a", "b", "c", "d"]] * 20
+        with caplog.at_level(logging.WARNING, "predictionio_tpu.ops.text"):
+            m = text_mod.word2vec_train(
+                docs, self._cfg(steps=2, batch_size=60),
+                mesh=make_mesh({DATA_AXIS: 8}))
+        assert any("not divisible" in r for r in caplog.messages)
+        assert m.vectors.shape[1] == 8
