@@ -509,7 +509,7 @@ def _predict_sq_err(u_factors, i_factors, buckets_dev, row_multiple: int = 8,
 @functools.lru_cache(maxsize=64)
 def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
                     compute_rmse: bool, n_steps: int, row_multiple: int = 8,
-                    mesh=None):
+                    mesh=None, checked: bool = False):
     """`n_steps` iterations of training as ONE jitted program: `lax.scan`
     over iterations, so a train is a single dispatch with no host round
     trips (under `jit` everything is traced once and compiled — SURVEY.md
@@ -533,6 +533,14 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
                 rmse = jnp.sqrt(jnp.maximum(total, 0.0) / jnp.maximum(count, 1.0))
             else:
                 rmse = jnp.zeros((), dtype=jnp.float32)
+            if checked:
+                from jax.experimental import checkify
+
+                checkify.check(
+                    jnp.all(jnp.isfinite(user_f))
+                    & jnp.all(jnp.isfinite(item_f)),
+                    "ALS: non-finite factors after solve (rank-deficient "
+                    "normal equations or corrupt input)")
             return (user_f, item_f), rmse
 
         (user_f, item_f), rmses = jax.lax.scan(
@@ -540,6 +548,10 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
         )
         return user_f, item_f, rmses
 
+    if checked:
+        from predictionio_tpu.utils import checks
+
+        return checks.checked_jit(run)
     return jax.jit(run)
 
 
@@ -606,6 +618,23 @@ def als_train(
         row_multiple = max(8, n_data)
         if row_multiple % n_data:  # non-pow2 data axis: keep shards even
             row_multiple = 8 * n_data
+
+    from predictionio_tpu.utils import checks as _checks
+
+    if _checks.enabled() and model_sharded:
+        log.warning("als_train: --check-asserts is not supported with "
+                    "model-axis factor sharding (checkify does not compose "
+                    "with the shard_mapped loop); running unchecked")
+    if _checks.enabled() and not model_sharded:
+        # checkify cannot transform pallas_call (KeyError: closed_call), so
+        # assert mode pins the pure-XLA solver path
+        if cfg.solver in ("auto", "gj") or cfg.pallas != "off":
+            log.info("als_train: --check-asserts forces the XLA solver path "
+                     "(checkify cannot transform Pallas kernels)")
+        cfg = dataclasses.replace(
+            cfg,
+            solver="chol" if cfg.solver in ("auto", "gj") else cfg.solver,
+            pallas="off")
 
     if mesh.size > 1 and cfg.pallas == "on":
         # the fused gather+Gram kernel is a single-device program; under a
@@ -812,7 +841,8 @@ def als_train(
             train = _get_train_loop(n_users, n_items,
                                     dataclasses.replace(cfg, iterations=0),
                                     compute_rmse, n_steps, row_multiple,
-                                    mesh if mesh.size > 1 else None)
+                                    mesh if mesh.size > 1 else None,
+                                    checked=_checks.enabled())
         user_factors, item_factors, rmses = train(item_factors, user_factors,
                                                   ub_dev, ib_dev,
                                                   u_split_dev, i_split_dev)

@@ -156,6 +156,7 @@ def cmd_train(args) -> int:
             profile_dir=args.profile_dir,
             metrics_file=args.metrics_file,
             debug_nans=args.debug_nans,
+            check_asserts=args.check_asserts,
         )
     except FileNotFoundError as e:
         print(f"Cannot read engine variant: {e}", file=sys.stderr)
@@ -474,6 +475,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="append per-epoch metrics as JSON lines here")
     train.add_argument("--debug-nans", action="store_true",
                        help="recompile with NaN detection (slow)")
+    train.add_argument("--check-asserts", action="store_true",
+                       help="checkify assert mode: float/user checks inside "
+                            "jitted train loops (slow; SURVEY.md §5)")
     train.set_defaults(func=cmd_train)
 
     ev = sub.add_parser("eval")

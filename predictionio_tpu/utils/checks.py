@@ -1,0 +1,74 @@
+"""`checkify`-based train-loop assert mode (SURVEY.md §5 'Race detection').
+
+The reference's JVM gets memory-safety and data-race freedom from the
+runtime; the JAX rebuild gets the race story from functional purity, and
+this module supplies the *numeric* assertion half: under
+`pio train --check-asserts`, jitted train loops are run through
+`jax.experimental.checkify` with
+
+- `float_checks`  — every op is instrumented for NaN/inf production (the
+  divergence-at-the-source analogue of `--debug-nans`, but it works inside
+  `lax.scan`/`cond` and reports the failing primitive),
+- `user_checks`   — explicit domain invariants (`checkify.check`), e.g.
+  "solved factors are finite" after each training iteration.
+
+`index_checks` is deliberately NOT armed: the bucket layout uses row id
+== n_rows as its padding sentinel and *relies* on XLA's out-of-bounds
+scatter-drop semantics to discard padding rows (ops/als.py
+`_solve_buckets_device`), so index instrumentation would flag designed-in
+behavior on every clean run.
+
+Checked programs carry an error value through the computation and throw on
+readback — slower (instrumentation defeats some fusion), debugging only.
+
+Global-flag design: the mode is process-wide (like `jax_debug_nans`) so a
+CLI flag can arm it without threading a parameter through every op; ops
+consult `enabled()` when *building* jitted loops, and loop caches must key
+on it (ops/als.py `_get_train_loop(checked=...)` does).
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+_enabled = False
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+    if on:
+        log.info("checks: checkify assert mode enabled "
+                 "(float/user checks in train loops)")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def all_errors():
+    from jax.experimental import checkify
+
+    # no index_checks: the OOB-scatter padding sentinel is intentional
+    # (module docstring)
+    return checkify.float_checks | checkify.user_checks
+
+
+def checked_jit(fn):
+    """`jit(checkify(fn))` returning a callable that throws
+    `checkify.JaxRuntimeError` on the first failed check; the error value
+    is resolved on the host after the dispatch, so the loop itself stays
+    one compiled program."""
+    import jax
+    from jax.experimental import checkify
+
+    cf = jax.jit(checkify.checkify(fn, errors=all_errors()))
+
+    def wrapper(*args, **kwargs):
+        err, out = cf(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper

@@ -55,14 +55,22 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def set_debug_flags(nan_check: bool = False) -> None:
+def set_debug_flags(nan_check: bool = False,
+                    check_asserts: bool = False) -> None:
     """Numeric sanitizers for the train loop. `nan_check` recompiles jitted
-    programs with NaN detection (slow; debugging only)."""
+    programs with NaN detection (slow; debugging only). `check_asserts`
+    arms the `checkify` assert mode (utils/checks.py): float/index/user
+    checks *inside* scan-based train loops, which `jax_debug_nans` cannot
+    see into."""
     if nan_check:
         import jax
 
         jax.config.update("jax_debug_nans", True)
         log.info("profiling: jax_debug_nans enabled")
+    if check_asserts:
+        from predictionio_tpu.utils import checks
+
+        checks.enable(True)
 
 
 class MetricsLogger:

@@ -51,6 +51,7 @@ def run_train(
     profile_dir: Optional[str] = None,
     metrics_file: Optional[str] = None,
     debug_nans: bool = False,
+    check_asserts: bool = False,
 ):
     from predictionio_tpu.parallel.distributed import initialize_from_env
     from predictionio_tpu.utils.profiling import (
@@ -60,7 +61,7 @@ def run_train(
     )
 
     initialize_from_env()  # multi-host bootstrap when PIO_COORDINATOR_* set
-    set_debug_flags(nan_check=debug_nans)
+    set_debug_flags(nan_check=debug_nans, check_asserts=check_asserts)
     variant = read_engine_json(engine_json)
     engine = get_engine(variant.engine_factory)
     engine_params = extract_engine_params(engine, variant)

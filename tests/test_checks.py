@@ -1,0 +1,66 @@
+"""checkify assert mode (utils/checks.py, `pio train --check-asserts`):
+SURVEY.md §5 'Race detection' — numeric assertions *inside* the jitted
+scan train loop, where `jax_debug_nans` cannot see."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.utils import checks
+from predictionio_tpu.utils.profiling import set_debug_flags
+
+
+@pytest.fixture()
+def assert_mode():
+    checks.enable(True)
+    yield
+    checks.enable(False)
+
+
+def _toy(nan_at=None):
+    rng = np.random.default_rng(0)
+    ui = rng.integers(0, 40, 500).astype(np.int32)
+    ii = rng.integers(0, 30, 500).astype(np.int32)
+    r = rng.uniform(1, 5, 500).astype(np.float32)
+    if nan_at is not None:
+        r[nan_at] = np.nan
+    return ui, ii, r
+
+
+def test_clean_train_passes_checked(assert_mode):
+    ui, ii, r = _toy()
+    res = als_train(ui, ii, r, 40, 30, ALSConfig(rank=4, iterations=2))
+    assert np.isfinite(res.user_factors).all()
+
+
+def test_nan_input_raises_inside_scan(assert_mode):
+    from jax.experimental import checkify
+
+    ui, ii, r = _toy(nan_at=7)
+    with pytest.raises(checkify.JaxRuntimeError, match="nan|non-finite"):
+        als_train(ui, ii, r, 40, 30, ALSConfig(rank=4, iterations=2))
+
+
+def test_nan_input_silent_when_unchecked():
+    """Without assert mode the same corrupt input trains 'successfully' —
+    the check mode exists because this failure is otherwise silent."""
+    ui, ii, r = _toy(nan_at=7)
+    res = als_train(ui, ii, r, 40, 30, ALSConfig(rank=4, iterations=2))
+    assert not np.isfinite(res.user_factors).all()
+
+
+def test_set_debug_flags_arms_the_mode():
+    assert not checks.enabled()
+    try:
+        set_debug_flags(check_asserts=True)
+        assert checks.enabled()
+    finally:
+        checks.enable(False)
+
+
+def test_cli_flag_parses():
+    from predictionio_tpu.tools.console import build_parser
+
+    args = build_parser().parse_args(
+        ["train", "--engine-json", "x.json", "--check-asserts"])
+    assert args.check_asserts
