@@ -179,8 +179,67 @@ class _PGCursor:
         return self._cur.connection
 
 
+class _ConnPool:
+    """Small bounded connection pool: a LIFO free-list under a
+    `BoundedSemaphore`. Acquire blocks when all `max_size` connections are
+    out (callers are request threads — backpressure beats unbounded server
+    connections), creates lazily up to the cap, and `discard` drops a
+    connection whose transport broke so it can't poison later requests."""
+
+    def __init__(self, factory, max_size: int, on_discard=None):
+        import threading
+
+        self._factory = factory
+        self._sem = threading.BoundedSemaphore(max_size)
+        self._idle: list = []
+        self._lock = threading.Lock()
+        self._on_discard = on_discard  # e.g. drop from backend bookkeeping
+
+    def acquire(self):
+        self._sem.acquire()
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        try:
+            return self._factory()
+        except BaseException:
+            self._sem.release()
+            raise
+
+    def release(self, conn, discard: bool = False):
+        if discard:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            if self._on_discard is not None:
+                self._on_discard(conn)
+        else:
+            with self._lock:
+                self._idle.append(conn)
+        self._sem.release()
+
+    def drain(self):
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+DEFAULT_POOL_SIZE = 8
+
+
 class PostgresBackend(SQLiteBackend):
-    """Postgres via dialect adaptation of the shared repository SQL."""
+    """Postgres via dialect adaptation of the shared repository SQL.
+
+    Connections come from a bounded pool (`?pool_size=N` DSN option,
+    default 8): the event/prediction servers run a thread per client, and
+    round 1's single shared connection serialized every request — the pool
+    lifts concurrent serving + ingest while keeping the server-side
+    connection count capped (threads over the cap queue on acquire)."""
 
     def __init__(self, dsn: str):
         driver, name = _load_driver()
@@ -194,13 +253,17 @@ class PostgresBackend(SQLiteBackend):
         self._driver_name = name
         self._init_conn_state(dsn)
         self.integrity_errors = (driver.IntegrityError,)
-        # ONE shared connection, serialized by the existing lock (the
-        # :memory: model): ThreadingHTTPServer spawns a thread per client,
-        # and per-thread connections would accumulate until the server's
-        # max_connections is exhausted (threads die, their connections
-        # would not). A real pool is the round-2 upgrade; correctness and
-        # bounded resource use come first.
-        self._shared = self._connect()
+        raw_pool_size = _parse_dsn(dsn).get("pool_size", DEFAULT_POOL_SIZE)
+        try:
+            pool_size = int(raw_pool_size)
+        except ValueError:
+            raise ValueError(
+                f"postgres DSN option pool_size must be an integer: {dsn!r}")
+        if pool_size < 1:
+            raise ValueError(
+                f"postgres DSN option pool_size must be >= 1: {dsn!r}")
+        self._pool = _ConnPool(self._connect, pool_size,
+                               on_discard=self._forget_conn)
         with self._cursor() as cur:
             for stmt in _SCHEMA.split(";"):
                 if stmt.strip():
@@ -208,6 +271,7 @@ class PostgresBackend(SQLiteBackend):
 
     def _connect(self):
         kwargs = _parse_dsn(self.path)
+        kwargs.pop("pool_size", None)  # pool option, not a driver kwarg
         if self._driver_name == "pg8000":
             # pg8000's connect() has no libpq-style option kwargs; drop
             # unsupported DSN query options rather than crashing
@@ -273,19 +337,75 @@ class PostgresBackend(SQLiteBackend):
         return None  # the C++ reader is sqlite-only; use the SQL tier
 
     def _cursor(self):
-        outer = super()._cursor()
-
-        driver_name = self._driver_name
+        backend = self
 
         class _Ctx:
-            def __enter__(self):
-                self._inner = outer.__enter__()
-                return _PGCursor(self._inner, driver_name)
+            """One pooled connection per cursor context; commit on clean
+            exit, rollback on exception. A broken transport (Interface/
+            OperationalError from the driver, or a failed rollback) is
+            discarded instead of returned, so later requests get a fresh
+            connection."""
 
-            def __exit__(self, *exc):
-                return outer.__exit__(*exc)
+            def __enter__(self):
+                self._conn = backend._pool.acquire()
+                try:
+                    self._cur = self._conn.cursor()
+                except BaseException:
+                    backend._pool.release(self._conn, discard=True)
+                    raise
+                return _PGCursor(self._cur, backend._driver_name)
+
+            def __exit__(self, exc_type, exc, tb):
+                broken = (exc_type is not None
+                          and issubclass(exc_type, backend._transport_errors))
+                try:
+                    if exc_type is None:
+                        # a failed COMMIT must propagate — swallowing it
+                        # would report success for a write that was never
+                        # made durable (incl. commit-time IntegrityError,
+                        # which callers catch via backend.integrity_errors)
+                        try:
+                            self._conn.commit()
+                        except BaseException:
+                            broken = True
+                            raise
+                    elif not broken:
+                        try:
+                            self._conn.rollback()
+                        except Exception:
+                            broken = True  # original exception propagates
+                finally:
+                    try:
+                        self._cur.close()
+                    except Exception:
+                        broken = True
+                    backend._pool.release(self._conn, discard=broken)
+                return False
 
         return _Ctx()
+
+    def _forget_conn(self, conn) -> None:
+        """Drop a discarded connection from close() bookkeeping (a
+        long-lived server discards broken connections over time; keeping
+        them in `_all_conns` would grow the list without bound)."""
+        with self._conns_lock:
+            try:
+                self._all_conns.remove(conn)
+            except ValueError:
+                pass
+
+    def close(self) -> None:
+        self._pool.drain()
+        super().close()
+
+    @property
+    def _transport_errors(self) -> tuple:
+        """Driver exception classes that mean the connection itself may be
+        broken (PEP-249 optional attributes; absent on the test fake)."""
+        return tuple(
+            e for e in (getattr(self._driver, "InterfaceError", None),
+                        getattr(self._driver, "OperationalError", None))
+            if e is not None)
 
 
 def _parse_dsn(dsn: str) -> dict:
