@@ -77,6 +77,8 @@ def bench_serving(storage_spec: str = "memory"):
     storage = Storage(StorageConfig(metadata=src, modeldata=src, eventdata=src))
     Storage.reset(storage)
     app_id = storage.meta_apps().insert(App(id=0, name="BenchApp"))
+    if app_id is None:  # persistent --storage re-run: app already exists
+        app_id = storage.meta_apps().get_by_name("BenchApp").id
 
     rng = np.random.default_rng(7)
     n_users, n_items, n_events = 943, 1682, 20_000

@@ -1,0 +1,228 @@
+"""Bundled S3-compatible object-store emulation server.
+
+A MinIO-lite for dev and CI: path-style PUT/GET/DELETE/HEAD of objects
+onto a local directory, with optional AWS SigV4 verification (shared
+implementation with the client in storage/objectstore.py, so the signing
+path is exercised end-to-end). This is what makes the "s3" storage source
+testable on an image with no external services — and a real deployment
+just points `endpoint=` at actual S3/MinIO instead.
+
+    python -m predictionio_tpu.storage.objectstore_server \
+        --port 9001 --data-dir /var/pio/objects [--access-key AK --secret-key SK]
+
+Objects are stored as files under `<data-dir>/<bucket>/<key>` with the
+same temp-file + os.replace atomicity as the localfs models backend.
+Keys are restricted to a safe charset (no traversal).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import logging
+import os
+import re
+import socketserver
+import tempfile
+import threading
+import urllib.parse
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# bucket/key path: path-style `/bucket/key...`; key segments must be plain
+_SAFE_SEGMENT = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "pio-objectstore/1.0"
+
+    # set by make_server
+    data_dir: str = ""
+    access_key: str = ""
+    secret_key: str = ""
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("objectstore: " + fmt, *args)
+
+    def _deny(self, status: int, code: str):
+        body = (f'<?xml version="1.0"?><Error><Code>{code}</Code>'
+                f'</Error>').encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _object_path(self) -> Optional[str]:
+        parts = urllib.parse.unquote(
+            urllib.parse.urlsplit(self.path).path).strip("/").split("/")
+        if len(parts) < 2:
+            return None
+        # the regex alone would admit ".." (dots are legal mid-name);
+        # exclude the traversal segments explicitly
+        if not all(_SAFE_SEGMENT.match(p) and p not in (".", "..")
+                   for p in parts):
+            return None
+        return os.path.join(self.data_dir, *parts)
+
+    def _authorized(self, body: bytes) -> bool:
+        if not self.access_key:
+            return True
+        auth = self.headers.get("Authorization", "")
+        amz_date = self.headers.get("x-amz-date", "")
+        content_sha = self.headers.get("x-amz-content-sha256", "")
+        m = re.match(
+            r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/s3/"
+            r"aws4_request, SignedHeaders=([^,]+), Signature=([0-9a-f]+)",
+            auth)
+        if not m or m.group(1) != self.access_key:
+            return False
+        import datetime
+        import hashlib
+
+        from predictionio_tpu.storage.objectstore import sign_v4
+
+        if hashlib.sha256(body).hexdigest() != content_sha:
+            return False
+        try:
+            now = datetime.datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ").replace(
+                    tzinfo=datetime.timezone.utc)
+        except ValueError:
+            return False
+        expect = sign_v4(
+            self.command, self.headers.get("Host", ""),
+            urllib.parse.urlsplit(self.path).path, {}, content_sha,
+            self.access_key, self.secret_key, region=m.group(3), now=now)
+        expect_sig = expect["Authorization"].rsplit("Signature=", 1)[1]
+        import hmac as _hmac
+
+        return _hmac.compare_digest(expect_sig, m.group(5))
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0") or "0")
+        return self.rfile.read(n) if n else b""
+
+    def do_PUT(self):
+        body = self._read_body()
+        if not self._authorized(body):
+            return self._deny(403, "SignatureDoesNotMatch")
+        path = self._object_path()
+        if path is None:
+            return self._deny(400, "InvalidObjectName")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(body)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._authorized(b""):
+            return self._deny(403, "SignatureDoesNotMatch")
+        path = self._object_path()
+        if path is None:
+            return self._deny(400, "InvalidObjectName")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except (FileNotFoundError, IsADirectoryError):
+            return self._deny(404, "NoSuchKey")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_HEAD(self):
+        if not self._authorized(b""):
+            return self._deny(403, "SignatureDoesNotMatch")
+        path = self._object_path()
+        if path is None or not os.path.isfile(path):
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(os.path.getsize(path)))
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._authorized(b""):
+            return self._deny(403, "SignatureDoesNotMatch")
+        path = self._object_path()
+        if path is None:
+            return self._deny(400, "InvalidObjectName")
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            return self._deny(404, "NoSuchKey")
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class ObjectStoreServer:
+    """Threaded server wrapper with a test-friendly lifecycle."""
+
+    def __init__(self, data_dir: str, ip: str = "127.0.0.1", port: int = 0,
+                 access_key: str = "", secret_key: str = ""):
+        handler = type("BoundHandler", (_Handler,), {
+            "data_dir": os.path.abspath(data_dir),
+            "access_key": access_key,
+            "secret_key": secret_key,
+        })
+        os.makedirs(data_dir, exist_ok=True)
+
+        class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+
+        self._httpd = _Server((ip, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ip", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9001)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--access-key", default="")
+    ap.add_argument("--secret-key", default="")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = ObjectStoreServer(args.data_dir, args.ip, args.port,
+                               args.access_key, args.secret_key)
+    print(f"objectstore listening on {args.ip}:{server.port}", flush=True)
+    try:
+        server._httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

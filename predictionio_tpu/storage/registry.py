@@ -50,6 +50,12 @@ def _make_postgres(source: "SourceConfig") -> base.StorageBackend:
     return PostgresBackend(source.path)
 
 
+def _make_s3(source: "SourceConfig") -> base.StorageBackend:
+    from predictionio_tpu.storage.objectstore import S3Backend
+
+    return S3Backend(source.path)
+
+
 # type name → factory(SourceConfig) — the reflective-client-load analogue
 # of the reference's Storage.scala; third-party backends register here
 BACKEND_TYPES: dict = {
@@ -57,6 +63,7 @@ BACKEND_TYPES: dict = {
     "memory": _make_memory,
     "localfs": _make_localfs,
     "postgres": _make_postgres,
+    "s3": _make_s3,  # models-only; PATH = s3://bucket/prefix?endpoint=...
 }
 
 

@@ -1,0 +1,177 @@
+"""S3-role remote model-blob store (VERDICT r1 #10): the SigV4 client and
+models repo against the bundled S3-compatible emulation server — real
+sockets, real signatures."""
+
+import threading
+
+import pytest
+
+from predictionio_tpu.storage.base import Model
+from predictionio_tpu.storage.objectstore import (
+    ObjectStoreError, S3Backend, S3Client, S3Models, sign_v4,
+)
+from predictionio_tpu.storage.objectstore_server import ObjectStoreServer
+
+
+@pytest.fixture()
+def anon_server(tmp_path):
+    srv = ObjectStoreServer(str(tmp_path / "objects")).start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def auth_server(tmp_path):
+    srv = ObjectStoreServer(str(tmp_path / "objects"),
+                            access_key="AKTEST", secret_key="sk-test").start()
+    yield srv
+    srv.shutdown()
+
+
+class TestClient:
+    def test_put_get_delete_roundtrip(self, anon_server):
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "models")
+        blob = b"\x00\x01factor-matrix\xff" * 100
+        c.put_object("m1.model", blob)
+        assert c.get_object("m1.model") == blob
+        assert c.delete_object("m1.model") is True
+        assert c.get_object("m1.model") is None
+        assert c.delete_object("m1.model") is False
+
+    def test_overwrite(self, anon_server):
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "models")
+        c.put_object("m.model", b"v1")
+        c.put_object("m.model", b"v2")
+        assert c.get_object("m.model") == b"v2"
+
+    def test_signed_requests_accepted(self, auth_server):
+        c = S3Client(f"http://127.0.0.1:{auth_server.port}", "models",
+                     access_key="AKTEST", secret_key="sk-test")
+        c.put_object("signed.model", b"signed-bytes")
+        assert c.get_object("signed.model") == b"signed-bytes"
+
+    def test_unsigned_rejected_by_auth_server(self, auth_server):
+        c = S3Client(f"http://127.0.0.1:{auth_server.port}", "models")
+        with pytest.raises(ObjectStoreError) as ei:
+            c.put_object("nope.model", b"x")
+        assert ei.value.status == 403
+
+    def test_wrong_secret_rejected(self, auth_server):
+        c = S3Client(f"http://127.0.0.1:{auth_server.port}", "models",
+                     access_key="AKTEST", secret_key="wrong")
+        with pytest.raises(ObjectStoreError) as ei:
+            c.put_object("nope.model", b"x")
+        assert ei.value.status == 403
+
+    def test_stale_keepalive_retried(self, anon_server):
+        """A dead pooled connection must be rebuilt, not surfaced."""
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "models")
+        c.put_object("ka.model", b"alive")
+        c._conn().close()  # simulate server-side idle close
+        assert c.get_object("ka.model") == b"alive"
+
+    def test_concurrent_threads(self, anon_server):
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "models")
+        errs = []
+
+        def worker(i):
+            try:
+                c.put_object(f"t{i}.model", b"x" * (i + 1))
+                assert c.get_object(f"t{i}.model") == b"x" * (i + 1)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+
+
+class TestSigV4:
+    def test_signature_is_deterministic_and_keyed(self):
+        import datetime
+
+        now = datetime.datetime(2026, 7, 30, 12, 0, 0,
+                                tzinfo=datetime.timezone.utc)
+        a = sign_v4("PUT", "h:9001", "/b/k", {}, "0" * 64, "AK", "SK", now=now)
+        b = sign_v4("PUT", "h:9001", "/b/k", {}, "0" * 64, "AK", "SK", now=now)
+        c = sign_v4("PUT", "h:9001", "/b/k", {}, "0" * 64, "AK", "SK2", now=now)
+        assert a == b
+        assert a["Authorization"] != c["Authorization"]
+        assert a["x-amz-date"] == "20260730T120000Z"
+
+
+class TestModelsRepo:
+    def test_models_repo_roundtrip(self, anon_server):
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "pio")
+        models = S3Models(c, prefix="app1")
+        models.insert(Model(id="abc123", models=b"blob-bytes"))
+        got = models.get("abc123")
+        assert got is not None and bytes(got.models) == b"blob-bytes"
+        assert models.delete("abc123") is True
+        assert models.get("abc123") is None
+
+    def test_model_id_validation(self, anon_server):
+        c = S3Client(f"http://127.0.0.1:{anon_server.port}", "pio")
+        models = S3Models(c)
+        for bad in ("", "a/b", "..", "a%2fb", "k?x"):
+            with pytest.raises(ValueError):
+                models.get(bad)
+
+
+class TestBackendWiring:
+    def test_registry_source(self, anon_server, tmp_path):
+        from predictionio_tpu.storage.registry import (
+            SourceConfig, Storage, StorageConfig,
+        )
+
+        meta = SourceConfig(name="META", type="memory")
+        s3 = SourceConfig(
+            name="S3", type="s3",
+            path=f"s3://pio/models?endpoint=http://127.0.0.1:{anon_server.port}")
+        storage = Storage(StorageConfig(metadata=meta, modeldata=s3,
+                                        eventdata=meta))
+        try:
+            models = storage.model_data_models()
+            models.insert(Model(id="m9", models=b"via-registry"))
+            assert bytes(models.get("m9").models) == b"via-registry"
+        finally:
+            storage.close()
+
+    def test_non_model_repos_fail_fast(self, anon_server):
+        b = S3Backend(
+            f"s3://pio?endpoint=http://127.0.0.1:{anon_server.port}")
+        with pytest.raises(NotImplementedError, match="model blobs"):
+            b.events()
+
+    def test_bad_paths_rejected(self):
+        with pytest.raises(ValueError, match="endpoint"):
+            S3Backend("s3://bucket/prefix")
+        with pytest.raises(ValueError, match="expected"):
+            S3Backend("http://bucket/prefix")
+        with pytest.raises(ValueError, match="endpoint"):
+            S3Client("ftp://host", "b")
+
+
+class TestServerHardening:
+    def test_path_traversal_rejected(self, anon_server):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", anon_server.port)
+        conn.request("PUT", "/b/../../../../tmp/evil", b"x",
+                     {"Content-Length": "1"})
+        assert conn.getresponse().status == 400
+        conn.close()
+        assert not __import__("os").path.exists("/tmp/evil")
+
+    def test_signature_uses_path_as_sent(self):
+        """sign_v4 must not re-encode the path (double encoding breaks
+        real S3/MinIO; r2 review)."""
+        import datetime
+
+        now = datetime.datetime(2026, 7, 30, tzinfo=datetime.timezone.utc)
+        a = sign_v4("GET", "h", "/b/k%20x", {}, "0" * 64, "A", "S", now=now)
+        b = sign_v4("GET", "h", "/b/k%2520x", {}, "0" * 64, "A", "S", now=now)
+        assert a["Authorization"] != b["Authorization"]
