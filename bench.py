@@ -1,14 +1,24 @@
 #!/usr/bin/env python
-"""Benchmark: ALS epoch time at MovieLens-100K scale (BASELINE.json config 1).
+"""Benchmark: ALS epoch time at the north-star shape — rank 64 at
+MovieLens-20M scale (BASELINE.json north_star / config 5).
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+Modes:
+    bench.py                  north-star: rank-64, 20M ratings (default)
+    bench.py --scale 2m       rank-64, 2M ratings
+    bench.py --quickstart     rank-10, ML-100K shape (config 1)
+    bench.py --serving        predict QPS/p50 through the HTTP stack
+
 Baseline: the reference (PredictionIO) publishes no numbers and its mount
-was empty (see BASELINE.md), so the baseline is our self-measured
-single-thread numpy CPU ALS on the same synthetic ML-100K-scale workload:
-82 ms/epoch (rank 10, 100k ratings, 943x1682; measured on this image's
-1-vCPU host, 2026-07-29 — see BASELINE.md for the derivation).
+was empty (see BASELINE.md), so `vs_baseline` compares against our
+MLlib-semantics-faithful CPU reference ALS (quality/mllib_als.py —
+BLAS-batched numpy, the honest CPU yardstick VERDICT r1 asked for, not
+round 1's single-thread per-row loop), measured on this image's host on
+the same planted-factor datasets (quality.py runs, 2026-07-30):
+rank-64/20M 22.2 s/epoch, rank-64/2M 1.92 s/epoch. The quickstart mode
+keeps round 1's 82 ms single-thread number for cross-round continuity.
 `vs_baseline` > 1 means faster than that CPU baseline.
 """
 
@@ -21,7 +31,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-CPU_BASELINE_EPOCH_S = 0.082  # measured numpy ALS epoch (BASELINE.md)
+CPU_BASELINE_EPOCH_S = 0.082  # round-1 single-thread numpy epoch (BASELINE.md)
+# MLlib-faithful BLAS CPU reference (quality/mllib_als.py), median epoch on
+# this host over the same planted-factor data — BASELINE.md round-2 table
+CPU_REF_EPOCH_S = {"2m": 1.92, "20m": 22.2}
 
 N_USERS, N_ITEMS, N_RATINGS, RANK = 943, 1682, 100_000, 10
 
@@ -177,6 +190,36 @@ def bench_serving(storage_spec: str = "memory"):
     }))
 
 
+def bench_north_star(scale: str = "20m"):
+    """Rank-64 ALS epoch time at 2M/20M scale (the BASELINE.json north
+    star), on the planted-factor dataset the quality-parity runs use, so
+    the timed shape and the quality-evidence shape are the same workload.
+    Same-window best-of-3 methodology as the quickstart bench."""
+    from predictionio_tpu.ops.als import ALSConfig, als_train
+    from predictionio_tpu.quality import datasets
+
+    split = datasets.synth_explicit(scale, seed=0)
+    cfg = ALSConfig(rank=64, iterations=5, reg=0.05, seed=0,
+                    compute_dtype="bfloat16", solver="auto")
+    # warm-up compiles; the timed reps reuse the executable and the
+    # device-resident buckets
+    als_train(split.train_u, split.train_i, split.train_r,
+              split.n_users, split.n_items, cfg)
+    epoch_s = min(
+        float(np.median(als_train(
+            split.train_u, split.train_i, split.train_r,
+            split.n_users, split.n_items, cfg).epoch_times))
+        for _ in range(3))
+    print(json.dumps({
+        "metric": f"als_epoch_time_ml{scale}_rank64",
+        "value": round(epoch_s, 3),
+        "unit": "s",
+        "vs_baseline": round(CPU_REF_EPOCH_S[scale] / epoch_s, 1),
+        "baseline": "mllib-faithful BLAS CPU reference epoch "
+                    f"({CPU_REF_EPOCH_S[scale]} s, quality/mllib_als.py)",
+    }))
+
+
 def main():
     from predictionio_tpu.ops.als import ALSConfig, als_train
 
@@ -206,13 +249,22 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--serving" in sys.argv:
-        spec = "memory"
-        for i, a in enumerate(sys.argv):
-            if a == "--storage" and i + 1 < len(sys.argv):
-                spec = sys.argv[i + 1]
-            elif a.startswith("--storage="):
-                spec = a.split("=", 1)[1]
-        bench_serving(spec)
-    else:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serving", action="store_true",
+                    help="predict QPS/p50 through the HTTP stack")
+    ap.add_argument("--storage", default="memory",
+                    help="serving-bench store: memory | sqlite:///path | "
+                         "postgres://...")
+    ap.add_argument("--quickstart", action="store_true",
+                    help="rank-10 ML-100K epoch (BASELINE config 1)")
+    ap.add_argument("--scale", choices=sorted(CPU_REF_EPOCH_S),
+                    default="20m", help="north-star dataset scale")
+    args = ap.parse_args()
+    if args.serving:
+        bench_serving(args.storage)
+    elif args.quickstart:
         main()
+    else:
+        bench_north_star(args.scale)

@@ -63,8 +63,16 @@ def initialize_from_env() -> bool:
         return False
     num = int(os.environ["PIO_NUM_PROCESSES"])
     pid = int(os.environ["PIO_PROCESS_ID"])
+    kwargs = {}
+    timeout_s = os.environ.get("PIO_COORDINATOR_TIMEOUT_S")
+    if timeout_s:
+        # bounded failure detection at bootstrap (SURVEY.md §5): a rank
+        # that never shows up should fail the job in timeout_s, not hang
+        # the surviving ranks on jax's (much longer) default
+        kwargs["initialization_timeout"] = int(timeout_s)
     jax.distributed.initialize(
-        coordinator_address=addr, num_processes=num, process_id=pid
+        coordinator_address=addr, num_processes=num, process_id=pid,
+        **kwargs
     )
     log.info("jax.distributed up: process %d/%d, %d global devices",
              jax.process_index(), jax.process_count(), jax.device_count())

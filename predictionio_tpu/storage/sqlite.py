@@ -19,6 +19,7 @@ from typing import Iterable, Optional
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.events import Event, format_time, parse_time
 from predictionio_tpu.storage import base
+from predictionio_tpu.utils import faults
 from predictionio_tpu.storage.base import (
     AccessKey,
     App,
@@ -650,6 +651,7 @@ class SQLiteLEvents(base.LEvents):
         rows = [self._row_of(e, app_id, channel_id) for e in events]
         with self._b._cursor() as cur:
             cur.executemany(self._INSERT_SQL, rows)
+            faults.inject("events.batch.pre_commit")
         return [r[0] for r in rows]
 
     @staticmethod

@@ -1,0 +1,66 @@
+"""Fault-injection points (SURVEY.md §5 'Failure detection / recovery /
+fault injection').
+
+Crash-consistency claims (atomic checkpoints, all-or-nothing batch
+ingest) are only evidence when a process actually dies at the worst
+moment. Production code marks those moments with `faults.inject("site")`;
+a test arms a site via the `PIO_FAULTS` env var and the process hard-dies
+(`os._exit(137)` — no atexit handlers, no flushing, like SIGKILL) when
+execution reaches it:
+
+    PIO_FAULTS=checkpoint.pre_replace        # die at first hit
+    PIO_FAULTS=events.batch.pre_commit:3     # die at the 3rd hit
+    PIO_FAULTS=a.site,b.site:2               # multiple sites
+
+Unarmed sites cost one dict lookup on a module-level map that is empty in
+production (PIO_FAULTS unset ⇒ `inject` returns immediately).
+
+Sites in the tree:
+- `checkpoint.pre_replace` — after a checkpoint's temp dir is fully
+  written, before the atomic `os.replace` publishes it
+- `events.batch.pre_commit` — after a batch insert's `executemany`,
+  before the transaction commits
+"""
+
+from __future__ import annotations
+
+import os
+
+_armed: dict[str, int] = {}
+_hits: dict[str, int] = {}
+_parsed_from: str = ""
+
+
+def _parse() -> None:
+    global _parsed_from
+    spec = os.environ.get("PIO_FAULTS", "")
+    if spec == _parsed_from:
+        return
+    _parsed_from = spec
+    _armed.clear()
+    _hits.clear()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            site, n = part.rsplit(":", 1)
+            _armed[site] = int(n)
+        else:
+            _armed[part] = 1
+
+
+def inject(site: str) -> None:
+    """Hard-kill the process if `site` is armed and its hit count is
+    reached. A no-op (one env read + dict lookup) otherwise."""
+    _parse()
+    if not _armed:
+        return
+    n = _armed.get(site)
+    if n is None:
+        return
+    _hits[site] = _hits.get(site, 0) + 1
+    if _hits[site] >= n:
+        # stderr survives even though buffers don't get flushed on _exit
+        os.write(2, f"PIO_FAULTS: dying at {site}\n".encode())
+        os._exit(137)

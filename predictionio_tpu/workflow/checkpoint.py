@@ -28,6 +28,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from predictionio_tpu.utils import faults
+
 log = logging.getLogger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -112,6 +114,7 @@ class CheckpointManager:
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"step": step, "spec": spec,
                            "metadata": metadata or {}}, f)
+            faults.inject("checkpoint.pre_replace")
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.replace(tmp, final)
