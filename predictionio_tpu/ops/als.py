@@ -235,11 +235,14 @@ class ALSConfig:
     # (bucket_ragged_split): bounds the dense tile width a hot row can
     # force on its bucket. Power of two; 0 disables splitting.
     split_cap: int = 32768
-    # Pallas fused gather+Gram kernel (ops/pallas_als.py). "off"/"auto":
-    # XLA gather+einsum path (measured at parity with the kernel on v5e at
-    # ML-20M-like density — auto stays conservative until the kernel wins);
-    # "on": force the kernel (TPU, rank % 128 == 0, factors fit VMEM);
-    # "interpret": kernel in interpreter mode on any backend (tests).
+    # Pallas mode for the SOLVER kernel (ops/pallas_solve.py):
+    # "auto"/"off"/"on" are equivalent today (the GJ solver is selected via
+    # `solver`); "interpret" runs it in interpreter mode on any backend
+    # (tests). A fused gather+Gram kernel was tried and retired in round 2:
+    # TPU row-gather is op-throughput-bound (~40M rows/s, invariant to
+    # table size and dtype — docs/performance.md §roofline), Mosaic has no
+    # vector-indexed gather to beat it, and the scalar-loop kernel peaked
+    # at 1.1× XLA at rank 128 while failing to compile at rank 64.
     pallas: str = "auto"
 
 
@@ -325,8 +328,6 @@ def _solve_buckets_device(
 
     import jax
 
-    from predictionio_tpu.ops import pallas_als
-
     k = opposing.shape[-1]
     new = jnp.zeros((out_rows, k), dtype=opposing.dtype)
     n_split = 0 if split_rows is None else split_rows.shape[0]
@@ -335,10 +336,6 @@ def _solve_buckets_device(
         acc_b = jnp.zeros((n_split, k), dtype=jnp.float32)
         acc_n = jnp.zeros((n_split,), dtype=jnp.float32)
 
-    # gather+Gram kernel: single-device only (not shard_mapped; the solver
-    # kernel below IS, so cfg.pallas="interpret" may arrive with a mesh)
-    use_pallas = (cfg.pallas in ("on", "interpret")
-                  and (mesh is None or mesh.size == 1))
     interpret = cfg.pallas == "interpret"
     cdtype = jnp.dtype(cfg.compute_dtype)
     f32 = jnp.float32
@@ -414,17 +411,6 @@ def _solve_buckets_device(
     def partial_gram(cols_c, vals_c, mask_c):
         """Raw per-row partial normal equations (no global Gram, no reg):
         associative over any split of a row's entries, f32."""
-        if use_pallas:
-            # fused gather + weighted Gram/RHS (see ops/pallas_als.py)
-            if cfg.implicit:
-                wa = cfg.alpha * vals_c
-                wb = (1.0 + cfg.alpha * vals_c) * mask_c
-            else:
-                wa = mask_c
-                wb = vals_c
-            a, b = pallas_als.gram_rhs(opposing, cols_c, wa, wb,
-                                       interpret=interpret)
-            return a.astype(f32), b.astype(f32)
         y = _gather_rows(opposing, cols_c, mesh)  # [R, C, K]
         # ym on BOTH einsum sides: the mask is 0/1 so m² == m, and keeping
         # the raw `y` alive as a second operand forces XLA to materialize
@@ -636,15 +622,6 @@ def als_train(
             solver="chol" if cfg.solver in ("auto", "gj") else cfg.solver,
             pallas="off")
 
-    if mesh.size > 1 and cfg.pallas == "on":
-        # the fused gather+Gram kernel is a single-device program; under a
-        # real mesh the buckets are sharded and GSPMD can't partition a
-        # pallas_call — stay on the XLA gather+einsum path (which it
-        # shards fine). "interpret" is kept: it still selects the
-        # interpret-mode SOLVER kernel (shard_mapped per device), while
-        # the gather kernel is disabled mesh-aware in
-        # _solve_buckets_device.
-        cfg = dataclasses.replace(cfg, pallas="off")
     if cfg.solver == "auto":
         from predictionio_tpu.ops import pallas_solve
 

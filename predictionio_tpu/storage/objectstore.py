@@ -142,11 +142,14 @@ class S3Client:
         self._host = u.hostname
         self._port = u.port or (443 if u.scheme == "https" else 80)
         # the signed Host must be byte-identical to what http.client sends:
-        # it omits the scheme's default port, so an explicit :80/:443 in
-        # the endpoint must not leak into the signature
+        # it omits the scheme's default port (so an explicit :80/:443 must
+        # not leak into the signature) and re-brackets IPv6 literals
         default_port = 443 if u.scheme == "https" else 80
-        self._host_header = (u.hostname if u.port in (None, default_port)
-                             else f"{u.hostname}:{u.port}")
+        host = u.hostname or ""
+        if ":" in host:  # IPv6 literal — http.client sends it bracketed
+            host = f"[{host}]"
+        self._host_header = (host if u.port in (None, default_port)
+                             else f"{host}:{u.port}")
         self.bucket = bucket
         self._auth = (access_key, secret_key) if access_key else None
         self._region = region

@@ -82,6 +82,21 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.keep = max(1, keep)
         os.makedirs(self.directory, exist_ok=True)
+        # salvage a step renamed aside by a save() that crashed between
+        # rename-aside and publish (see save's overwrite protocol): the
+        # aside copy is the only complete version of that step
+        for name in os.listdir(self.directory):
+            if not name.endswith(".old"):
+                continue
+            orig = os.path.join(self.directory, name[: -len(".old")])
+            aside = os.path.join(self.directory, name)
+            if _STEP_RE.match(name[: -len(".old")]):
+                if os.path.exists(orig):
+                    shutil.rmtree(aside, ignore_errors=True)  # publish won
+                else:
+                    os.rename(aside, orig)
+                    log.info("checkpoint: salvaged %s from interrupted "
+                             "overwrite", orig)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")
@@ -114,10 +129,20 @@ class CheckpointManager:
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"step": step, "spec": spec,
                            "metadata": metadata or {}}, f)
-            faults.inject("checkpoint.pre_replace")
+            # overwrite protocol: rename the existing step ASIDE (not
+            # rmtree — a crash between delete and publish would lose the
+            # old step too), publish, then drop the aside copy. A crash in
+            # the window leaves `step_N.old`, salvaged on next init.
+            old = None
             if os.path.exists(final):
-                shutil.rmtree(final)
+                old = final + ".old"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(final, old)
+            faults.inject("checkpoint.pre_replace")
             os.replace(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise

@@ -116,6 +116,45 @@ class TestCheckpointCrash:
         assert ref.returncode == 0
 
 
+OVERWRITE_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["PIO_TEST_REPO"])
+    import numpy as np
+    from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(os.environ["PIO_TEST_CKPT"])
+    mgr.save(1, {"w": np.full(4, 1.0)})   # clean
+    os.environ["PIO_FAULTS"] = "checkpoint.pre_replace"
+    mgr.save(1, {"w": np.full(4, 2.0)})   # dies between aside and publish
+""")
+
+
+@pytest.mark.e2e
+def test_overwrite_crash_salvages_old_step(tmp_path):
+    """save() over an existing step renames it aside before publishing; a
+    crash in that window must not lose the old step — the next manager
+    init salvages it (r2 review: rmtree-then-replace had a loss window)."""
+    worker = tmp_path / "ow.py"
+    worker.write_text(OVERWRITE_WORKER)
+    ckpt = tmp_path / "ckpt_ow"
+    env = dict(os.environ)
+    env.pop("PIO_FAULTS", None)
+    env.update(PIO_TEST_REPO=str(REPO), PIO_TEST_CKPT=str(ckpt),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, str(worker)], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 137, proc.stderr
+    assert not (ckpt / "step_1" / "meta.json").exists()  # publish never ran
+    assert (ckpt / "step_1.old" / "meta.json").exists()
+
+    from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(ckpt))  # salvage on init
+    tree, _ = mgr.restore(1)
+    np.testing.assert_array_equal(tree["w"], np.full(4, 1.0))
+    assert not (ckpt / "step_1.old").exists()
+
+
 SERVER_CMD = "predictionio_tpu.tools.console"
 
 
@@ -139,11 +178,21 @@ def _start_event_server(tmp_path, db, faults=""):
         [sys.executable, "-m", SERVER_CMD, "eventserver", "--ip",
          "127.0.0.1", "--port", "0"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    import selectors
+
     port = None
     seen = []
     deadline = time.time() + 60
     assert proc.stdout is not None
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
     while time.time() < deadline:
+        # bounded wait: a server that stays alive without printing must
+        # fail the test at the deadline, not hang readline() forever
+        if not sel.select(timeout=min(1.0, max(0.0, deadline - time.time()))):
+            if proc.poll() is not None:
+                break
+            continue
         line = proc.stdout.readline()
         if line == "" and proc.poll() is not None:  # died during startup
             break
@@ -151,6 +200,7 @@ def _start_event_server(tmp_path, db, faults=""):
         if "listening on" in line:
             port = int(line.rsplit(":", 1)[1])
             break
+    sel.close()
     assert port, ("event server never reported its port; output:\n"
                   + "".join(seen))
     return proc, port
