@@ -24,7 +24,7 @@ log = logging.getLogger(__name__)
 
 _SRCS = [
     os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
-    for name in ("pio_native.cpp", "pio_scan.cpp")
+    for name in ("pio_native.cpp", "pio_scan.cpp", "pio_import.cpp")
 ]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -113,6 +113,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.pio_scan_free.argtypes = [ctypes.c_void_p]
         lib.pio_scan_error.restype = ctypes.c_char_p
         lib.pio_scan_error.argtypes = []
+        llp = ctypes.POINTER(ctypes.c_longlong)
+        lib.pio_import_file.restype = ctypes.c_int
+        lib.pio_import_file.argtypes = [
+            cstr, cstr, ctypes.c_longlong, ctypes.c_longlong,
+            llp, llp, ctypes.POINTER(llp), llp, llp]
+        lib.pio_import_free_lines.restype = None
+        lib.pio_import_free_lines.argtypes = [llp]
         _lib = lib
         return _lib
 
@@ -233,3 +240,54 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
         ro += rpad
         eo += rpad * cap
     return buckets
+
+
+def import_events_native(json_path: str, db_path: str, app_id: int,
+                         channel_id) -> Optional[tuple]:
+    """JSON-lines → sqlite event rows via the C++ parser (pio_import.cpp).
+
+    Returns (imported, skipped, fallback_line_numbers, resume_from_line)
+    or None when the native path is unavailable or failed before
+    committing anything (caller runs the Python path for everything).
+
+    - fallback lines: 1-based numbers of lines whose Python-identical
+      rendering the parser does not guarantee — re-process just those.
+    - resume_from_line > 0: the import failed mid-file AFTER durably
+      committing everything before that line; the counts cover only
+      lines < resume_from_line, and the caller must run lines >= it
+      through the Python path (a full re-run would duplicate the
+      committed rows).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    imported = ctypes.c_longlong(0)
+    skipped = ctypes.c_longlong(0)
+    lines_p = ctypes.POINTER(ctypes.c_longlong)()
+    n_fb = ctypes.c_longlong(0)
+    resume = ctypes.c_longlong(0)
+    rc = lib.pio_import_file(
+        json_path.encode(), db_path.encode(), app_id,
+        -1 if channel_id is None else channel_id,
+        ctypes.byref(imported), ctypes.byref(skipped),
+        ctypes.byref(lines_p), ctypes.byref(n_fb), ctypes.byref(resume))
+    if rc == 6:
+        # committed rows are durable; the fallback-line list could not be
+        # allocated, so those lines were NOT imported and cannot be
+        # pinpointed — surface loudly rather than silently redoing (a redo
+        # would duplicate the committed rows)
+        log.error(
+            "native import: %d line(s) were not imported and their "
+            "positions were lost (allocation failure); the other %d events "
+            "are committed. Re-import those lines from the source file.",
+            n_fb.value, imported.value)
+        return imported.value, skipped.value, [], 0
+    if rc != 0:
+        log.warning("native import: rc=%d — using the Python path", rc)
+        return None
+    try:
+        fallback = [lines_p[i] for i in range(n_fb.value)]
+    finally:
+        if n_fb.value:
+            lib.pio_import_free_lines(lines_p)
+    return imported.value, skipped.value, fallback, resume.value

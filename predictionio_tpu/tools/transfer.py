@@ -34,6 +34,72 @@ def _resolve_app(storage: Storage, app_name: str, channel_name: Optional[str]):
     return app.id, channel_id
 
 
+def _native_import(storage: Storage, input_path: str, app_id: int,
+                   channel_id: Optional[int]) -> Optional[tuple[int, int]]:
+    """C++ fast path (native/pio_import.cpp): parse + insert straight into
+    the sqlite store; lines the parser can't render Python-identically
+    come back as line numbers and go through the Python path below.
+    Returns None when inapplicable (non-sqlite-file store, no toolchain,
+    hard failure) — the caller then runs the Python path for everything."""
+    from predictionio_tpu import native as _native
+    from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+    backend = storage._backend(storage.config.eventdata)
+    # exact type: dialect subclasses (e.g. Postgres) share the class but
+    # not the db file
+    if type(backend) is not SQLiteBackend or backend.path == ":memory:":
+        return None
+    res = _native.import_events_native(input_path, backend.path, app_id,
+                                       channel_id)
+    if res is None:
+        return None
+    imported, skipped, fallback_lines, resume_from = res
+    # the native importer may have rebuilt indexes it dropped for a
+    # fresh-table bulk load; a crash in that window is healed here (and at
+    # every backend init) because the schema DDL is IF NOT EXISTS
+    with backend._cursor() as cur:
+        from predictionio_tpu.storage.sqlite import _SCHEMA
+
+        cur.executescript(_SCHEMA)
+    want = set(fallback_lines)
+    if want or resume_from:
+        if want:
+            log.info("import: %d line(s) use constructs outside the "
+                     "native fast path; processing them in Python",
+                     len(want))
+        if resume_from:
+            log.warning("import: native path stopped mid-file; resuming "
+                        "from line %d in Python", resume_from)
+        le = storage.l_events()
+        batch: list[Event] = []
+        CHUNK = 5000
+        with open(input_path) as f:
+            for lineno, line in enumerate(f, 1):
+                redo = lineno in want or (resume_from
+                                          and lineno >= resume_from)
+                if not redo:
+                    continue
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = Event.from_dict(json.loads(line))
+                    validate_event(event)
+                    event.event_id = None
+                    batch.append(event)
+                except (json.JSONDecodeError, EventValidationError,
+                        ValueError, TypeError, KeyError) as e:
+                    skipped += 1
+                    log.warning("import: skipping line %d: %s", lineno, e)
+                if len(batch) >= CHUNK:
+                    imported += len(le.insert_batch(batch, app_id,
+                                                    channel_id))
+                    batch.clear()
+        if batch:
+            imported += len(le.insert_batch(batch, app_id, channel_id))
+    return imported, skipped
+
+
 def file_to_events(
     input_path: str,
     app_name: str,
@@ -44,6 +110,9 @@ def file_to_events(
     skipped with a warning, matching the reference's tolerant import."""
     storage = storage or Storage.get()
     app_id, channel_id = _resolve_app(storage, app_name, channel_name)
+    native_result = _native_import(storage, input_path, app_id, channel_id)
+    if native_result is not None:
+        return native_result
     le = storage.l_events()
     imported = skipped = 0
     batch: list[Event] = []
