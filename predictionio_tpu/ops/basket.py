@@ -1,0 +1,266 @@
+"""Market-basket co-occurrence mining, TPU-first.
+
+Compute path for the Complementary Purchase template (upstream gallery
+template «template-scala-parallel-complementarypurchase» [U] — its Spark
+job self-joins basket RDDs to count itemset co-occurrence). The TPU
+formulation: baskets become one-hot rows and co-occurrence is a Gram
+matrix on the MXU —
+
+    B ∈ {0,1}^[n_baskets, n_items]   (built on device by scatter from COO)
+    C = BᵀB                          (C[i,j] = #baskets containing both)
+
+B is never materialized whole: baskets stream through in row chunks under
+`lax.fori_loop`, each chunk contributing one [n_items, n_items] matmul
+(bf16 inputs, f32 accumulation — counts are exact integers well inside
+bf16·bf16→f32 range per chunk). The diagonal carries item supports.
+
+Association scores from C (n = total baskets):
+    support(i,j)    = C[i,j] / n
+    confidence(i→j) = C[i,j] / C[i,i]
+    lift(i→j)       = C[i,j]·n / (C[i,i]·C[j,j])
+
+The dense [n_items, n_items] Gram bounds the catalog this path serves
+(`max_dense_items`, default 8192 ≈ 256 MB f32); larger catalogs use the
+numpy sparse-pair fallback (same math, hash-map counts on host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BasketRules:
+    """Pairwise rules i → j, pre-filtered and top-k'd per antecedent."""
+
+    cond_items: np.ndarray  # [R] int32 — antecedent item row
+    cons_items: np.ndarray  # [R, k] int32 — consequent rows, -1 padded
+    scores: np.ndarray  # [R, k] float32 — ranking score (lift or conf)
+    support: np.ndarray  # [R, k] float32
+    confidence: np.ndarray  # [R, k] float32
+    lift: np.ndarray  # [R, k] float32
+    n_baskets: int = 0
+
+    def lookup(self, cond_row: int) -> Optional[int]:
+        """Index into the rule table for an antecedent row, or None."""
+        i = np.searchsorted(self.cond_items, cond_row)
+        if i < len(self.cond_items) and self.cond_items[i] == cond_row:
+            return int(i)
+        return None
+
+
+def cooccurrence_matrix(
+    basket_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_baskets: int,
+    n_items: int,
+    chunk: int = 1024,
+) -> np.ndarray:
+    """C[i, j] = number of baskets containing both i and j (diagonal =
+    per-item support counts). Chunked one-hot + MXU Gram on device."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(basket_idx) == 0:
+        return np.zeros((n_items, n_items), np.float32)
+    basket_idx = np.asarray(basket_idx, np.int32)
+    item_idx = np.asarray(item_idx, np.int32)
+    # CSR by basket so each chunk scatters only its own entries
+    order = np.argsort(basket_idx, kind="stable")
+    b_sorted = basket_idx[order]
+    i_sorted = item_idx[order]
+    counts = np.bincount(b_sorted, minlength=n_baskets)
+    starts = np.concatenate(([0], np.cumsum(counts)))
+
+    n_chunks = -(-n_baskets // chunk)
+    # pad entries to a rectangular [n_chunks, max_entries] walk: simpler
+    # and XLA-friendly — each chunk gets (entry_rows, entry_cols) slices
+    max_e = 0
+    for c in range(n_chunks):
+        lo = starts[c * chunk]
+        hi = starts[min((c + 1) * chunk, n_baskets)]
+        max_e = max(max_e, hi - lo)
+    rows = np.zeros((n_chunks, max_e), np.int32)
+    cols = np.zeros((n_chunks, max_e), np.int32)
+    valid = np.zeros((n_chunks, max_e), np.float32)
+    for c in range(n_chunks):
+        lo = starts[c * chunk]
+        hi = starts[min((c + 1) * chunk, n_baskets)]
+        e = hi - lo
+        rows[c, :e] = b_sorted[lo:hi] - c * chunk  # chunk-local basket row
+        cols[c, :e] = i_sorted[lo:hi]
+        valid[c, :e] = 1.0
+
+    rows_d = jnp.asarray(rows)
+    cols_d = jnp.asarray(cols)
+    valid_d = jnp.asarray(valid)
+
+    def body(c, acc):
+        # one-hot incidence for this chunk's baskets; padding entries
+        # scatter to row `chunk` (dropped) so they contribute nothing
+        r = jnp.where(valid_d[c] > 0, rows_d[c], chunk)
+        m = jnp.zeros((chunk + 1, n_items), jnp.float32)
+        # max: duplicate (basket, item) pairs must stay 0/1, not count 2
+        m = m.at[r, cols_d[c]].max(valid_d[c])
+        m = m[:chunk].astype(jnp.bfloat16)
+        return acc + jax.lax.dot_general(
+            m, m, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=())
+    def run():
+        acc0 = jnp.zeros((n_items, n_items), jnp.float32)
+        return jax.lax.fori_loop(0, n_chunks, body, acc0)
+
+    return np.asarray(run())
+
+
+def cooccurrence_matrix_host(
+    basket_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_baskets: int,
+    n_items: int,
+) -> dict:
+    """Sparse host fallback for catalogs too large for the dense Gram:
+    {(i, j): count} for i < j plus {i: support} — same math."""
+    from collections import Counter, defaultdict
+
+    per_basket: dict = defaultdict(set)
+    for b, i in zip(basket_idx, item_idx):
+        per_basket[int(b)].add(int(i))
+    support: Counter = Counter()
+    pairs: Counter = Counter()
+    for items in per_basket.values():
+        s = sorted(items)
+        support.update(s)
+        for a_i in range(len(s)):
+            for b_i in range(a_i + 1, len(s)):
+                pairs[(s[a_i], s[b_i])] += 1
+    return {"support": support, "pairs": pairs}
+
+
+def mine_rules(
+    basket_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_baskets: int,
+    n_items: int,
+    min_support: float = 0.0,
+    min_confidence: float = 0.0,
+    min_lift: float = 1.0,
+    top_k: int = 10,
+    score: str = "lift",
+    max_dense_items: int = 8192,
+) -> BasketRules:
+    """Pairwise association rules i → j, thresholded and top-k'd.
+
+    `score` ("lift" | "confidence") ranks each antecedent's consequents.
+    min_support applies to the PAIR's support (fraction of baskets),
+    matching the upstream template's minSupport semantics [U].
+    """
+    if score not in ("lift", "confidence"):
+        raise ValueError(f"score must be 'lift' or 'confidence': {score!r}")
+    n = max(n_baskets, 1)
+    if n_items <= max_dense_items:
+        C = cooccurrence_matrix(basket_idx, item_idx, n_baskets, n_items)
+    else:
+        sp = cooccurrence_matrix_host(basket_idx, item_idx, n_baskets,
+                                      n_items)
+        return _rules_from_sparse(sp, n, n_items, min_support,
+                                  min_confidence, min_lift, top_k, score)
+
+    diag = np.diag(C).copy()
+    Cn = C.copy()
+    np.fill_diagonal(Cn, 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        supp = Cn / n
+        conf = np.where(diag[:, None] > 0, Cn / diag[:, None], 0.0)
+        lift = np.where(
+            (diag[:, None] > 0) & (diag[None, :] > 0),
+            Cn * n / (diag[:, None] * diag[None, :]), 0.0)
+    # Cn > 0: a rule requires actual co-occurrence (self-pairs and
+    # never-together pairs must not surface when thresholds are 0 — the
+    # sparse fallback only ever sees real pairs)
+    ok = ((Cn > 0) & (supp >= min_support) & (conf >= min_confidence)
+          & (lift >= min_lift))
+    rank = np.where(ok, lift if score == "lift" else conf, -np.inf)
+
+    cond_rows = np.nonzero(ok.any(axis=1))[0].astype(np.int32)
+    k = min(top_k, n_items)
+    cons = np.full((len(cond_rows), k), -1, np.int32)
+    sc = np.zeros((len(cond_rows), k), np.float32)
+    s_out = np.zeros((len(cond_rows), k), np.float32)
+    c_out = np.zeros((len(cond_rows), k), np.float32)
+    l_out = np.zeros((len(cond_rows), k), np.float32)
+    for out_i, i in enumerate(cond_rows):
+        # deterministic order: score desc, item id asc (ties at the top-k
+        # boundary must resolve identically to the sparse fallback)
+        top = np.lexsort((np.arange(n_items), -rank[i]))[:k]
+        m = rank[i][top] > -np.inf
+        top = top[m]
+        cons[out_i, : len(top)] = top
+        sc[out_i, : len(top)] = rank[i][top]
+        s_out[out_i, : len(top)] = supp[i][top]
+        c_out[out_i, : len(top)] = conf[i][top]
+        l_out[out_i, : len(top)] = lift[i][top]
+    return BasketRules(cond_rows, cons, sc, s_out, c_out, l_out, n_baskets)
+
+
+def _rules_from_sparse(sp: dict, n: int, n_items: int, min_support: float,
+                       min_confidence: float, min_lift: float, top_k: int,
+                       score: str) -> BasketRules:
+    support = sp["support"]
+    per_cond: dict = {}
+    for (a, b), cnt in sp["pairs"].items():
+        for i, j in ((a, b), (b, a)):
+            s = cnt / n
+            conf = cnt / support[i] if support[i] else 0.0
+            lift = (cnt * n / (support[i] * support[j])
+                    if support[i] and support[j] else 0.0)
+            if s >= min_support and conf >= min_confidence and lift >= min_lift:
+                per_cond.setdefault(i, []).append(
+                    (lift if score == "lift" else conf, j, s, conf, lift))
+    cond_rows = np.asarray(sorted(per_cond), np.int32)
+    k = top_k
+    cons = np.full((len(cond_rows), k), -1, np.int32)
+    sc = np.zeros((len(cond_rows), k), np.float32)
+    s_out = np.zeros((len(cond_rows), k), np.float32)
+    c_out = np.zeros((len(cond_rows), k), np.float32)
+    l_out = np.zeros((len(cond_rows), k), np.float32)
+    for out_i, i in enumerate(cond_rows):
+        # same deterministic order as the dense path: score desc, id asc
+        entries = sorted(per_cond[int(i)],
+                         key=lambda e: (-e[0], e[1]))[:k]
+        for e_i, (rank_v, j, s, conf, lift) in enumerate(entries):
+            cons[out_i, e_i] = j
+            sc[out_i, e_i] = rank_v
+            s_out[out_i, e_i] = s
+            c_out[out_i, e_i] = conf
+            l_out[out_i, e_i] = lift
+    return BasketRules(cond_rows, cons, sc, s_out, c_out, l_out, n)
+
+
+def sessionize(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    times: np.ndarray,
+    window_s: float,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Events → baskets: a user's purchases closer than `window_s` apart
+    share a basket (the upstream template's basketWindow [U]). Returns
+    (basket_idx, item_idx, n_baskets), vectorized numpy."""
+    if len(user_idx) == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32), 0)
+    order = np.lexsort((np.asarray(times), np.asarray(user_idx)))
+    u = np.asarray(user_idx)[order]
+    i = np.asarray(item_idx)[order]
+    t = np.asarray(times, np.float64)[order]
+    new_user = np.concatenate(([True], u[1:] != u[:-1]))
+    gap = np.concatenate(([True], (t[1:] - t[:-1]) > window_s))
+    new_basket = new_user | gap
+    basket = np.cumsum(new_basket) - 1
+    return basket.astype(np.int32), i.astype(np.int32), int(basket[-1]) + 1

@@ -1,0 +1,37 @@
+"""Complementary Purchase template — market-basket association rules.
+
+Parity with the upstream gallery template
+«template-scala-parallel-complementarypurchase» [U]: `buy` events are
+sessionized into baskets, pairwise "bought i → also buys j" rules are
+mined with support/confidence/lift thresholds (co-occurrence counted as a
+one-hot Gram on the MXU — ops/basket.py), and cart queries return top
+complements per condition item.
+"""
+
+from predictionio_tpu.templates.complementarypurchase.engine import (
+    AssociationAlgorithm,
+    AssociationParams,
+    ComplementaryPurchaseEngine,
+    CPModel,
+    DataSource,
+    DataSourceParams,
+    Preparator,
+    PreparatorParams,
+    PreparedData,
+    Query,
+    TrainingData,
+)
+
+__all__ = [
+    "ComplementaryPurchaseEngine",
+    "CPModel",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparatorParams",
+    "PreparedData",
+    "TrainingData",
+    "AssociationAlgorithm",
+    "AssociationParams",
+    "Query",
+]

@@ -1,0 +1,194 @@
+"""Complementary Purchase template: buy events → baskets → pairwise
+association rules (support/confidence/lift) → cart queries. Also covers
+ops/basket.py directly: the MXU Gram co-occurrence vs the host sparse
+fallback, sessionization windows, and threshold semantics."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.ops import basket as basket_ops
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = ("predictionio_tpu.templates.complementarypurchase."
+           "ComplementaryPurchaseEngine")
+
+
+class TestBasketOps:
+    def test_cooccurrence_device_matches_host(self):
+        rng = np.random.default_rng(0)
+        n_baskets, n_items, n = 300, 40, 2500
+        b = rng.integers(0, n_baskets, n).astype(np.int32)
+        i = rng.integers(0, n_items, n).astype(np.int32)
+        C = basket_ops.cooccurrence_matrix(b, i, n_baskets, n_items)
+        sp = basket_ops.cooccurrence_matrix_host(b, i, n_baskets, n_items)
+        # diagonal = supports
+        for item, cnt in sp["support"].items():
+            assert C[item, item] == cnt
+        # off-diagonal = pair counts, symmetric
+        for (a, c), cnt in sp["pairs"].items():
+            assert C[a, c] == cnt and C[c, a] == cnt
+        # zero where host saw no pair
+        dense_pairs = int((np.triu(C, 1) > 0).sum())
+        assert dense_pairs == len(sp["pairs"])
+
+    def test_duplicate_purchases_count_once_per_basket(self):
+        # same (basket, item) twice must contribute 1, not 2
+        b = np.array([0, 0, 0], np.int32)
+        i = np.array([1, 1, 2], np.int32)
+        C = basket_ops.cooccurrence_matrix(b, i, 1, 3)
+        assert C[1, 1] == 1 and C[1, 2] == 1
+
+    def test_mine_rules_thresholds_and_ranking(self):
+        # 10 baskets: {0,1} together in 6, {0,2} in 2, item 3 alone in 2
+        b, i = [], []
+        for k in range(6):
+            b += [k, k]
+            i += [0, 1]
+        for k in range(6, 8):
+            b += [k, k]
+            i += [0, 2]
+        for k in range(8, 10):
+            b += [k]
+            i += [3]
+        rules = basket_ops.mine_rules(
+            np.array(b, np.int32), np.array(i, np.int32), 10, 4,
+            min_support=0.25, min_confidence=0.0, min_lift=0.0, top_k=5)
+        # pair (0,1): support .6 passes; (0,2): support .2 filtered
+        r0 = rules.lookup(0)
+        assert r0 is not None
+        assert list(rules.cons_items[r0][rules.cons_items[r0] >= 0]) == [1]
+        # confidence(0→1) = 6/8; lift = .6/(.8*.6) = 1.25
+        assert rules.confidence[r0, 0] == pytest.approx(0.75)
+        assert rules.lift[r0, 0] == pytest.approx(1.25)
+        assert rules.support[r0, 0] == pytest.approx(0.6)
+        # item 3 never co-occurs: no rules
+        assert rules.lookup(3) is None
+
+    def test_sparse_fallback_matches_dense(self):
+        rng = np.random.default_rng(1)
+        b = rng.integers(0, 50, 400).astype(np.int32)
+        i = rng.integers(0, 20, 400).astype(np.int32)
+        dense = basket_ops.mine_rules(b, i, 50, 20, top_k=4, min_lift=0.0)
+        sparse = basket_ops.mine_rules(b, i, 50, 20, top_k=4, min_lift=0.0,
+                                       max_dense_items=1)
+        assert list(dense.cond_items) == list(sparse.cond_items)
+        for r in range(len(dense.cond_items)):
+            d_set = {(int(j), round(float(s), 5))
+                     for j, s in zip(dense.cons_items[r], dense.scores[r])
+                     if j >= 0}
+            s_set = {(int(j), round(float(s), 5))
+                     for j, s in zip(sparse.cons_items[r], sparse.scores[r])
+                     if j >= 0}
+            assert d_set == s_set
+
+    def test_sessionize_window(self):
+        u = np.array([7, 7, 7, 9], np.int32)
+        i = np.array([0, 1, 2, 0], np.int32)
+        t = np.array([0.0, 100.0, 5000.0, 50.0])
+        b, items, n = basket_ops.sessionize(u, i, t, window_s=3600.0)
+        assert n == 3  # u7: [0,1] then [2] (gap>1h); u9: [0]
+        assert b[0] == b[1] and b[1] != b[2]
+
+
+def ingest_buys(storage, app_name="CPApp"):
+    """Baskets with planted structure: bread+butter bought together often;
+    milk bought alone."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    t0 = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc)
+
+    def buy(u, item, minutes):
+        le.insert(Event(
+            event="buy", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=item,
+            event_time=t0 + datetime.timedelta(minutes=minutes)), app_id)
+
+    for u in range(12):
+        buy(u, "bread", u * 300)
+        buy(u, "butter", u * 300 + 5)  # same basket (5 min later)
+        if u % 3 == 0:
+            buy(u, "jam", u * 300 + 10)
+        buy(u, "milk", u * 300 + 2000)  # separate basket (gap > 1h)
+    return app_id
+
+
+def variant_dict(app_name="CPApp"):
+    return {
+        "id": "cp-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "preparator": {"params": {"basketWindow": 3600}},
+        "algorithms": [{"name": "association", "params": {
+            "minSupport": 0.05, "minConfidence": 0.1, "minLift": 1.0,
+            "numRulesPerCond": 5}}],
+    }
+
+
+class TestComplementaryPurchaseEndToEnd:
+    def test_train_and_query(self, memory_storage):
+        ingest_buys(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        r = engine.predict(ep, models, {"items": ["bread"], "num": 3})
+        assert r["rules"], r
+        rule = r["rules"][0]
+        assert rule["cond"] == ["bread"]
+        top = rule["itemScores"][0]
+        assert top["item"] == "butter"  # every bread basket has butter
+        assert top["confidence"] == pytest.approx(1.0)
+        assert top["lift"] > 1.0
+        # milk is in a different basket: never a complement of bread
+        assert "milk" not in {s["item"] for s in rule["itemScores"]}
+
+    def test_multi_item_cart_and_unknowns(self, memory_storage):
+        ingest_buys(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        models = engine.train(ctx, ep)
+        r = engine.predict(ep, models, {"items": ["bread", "nope", "milk"],
+                                        "num": 2})
+        conds = [rule["cond"][0] for rule in r["rules"]]
+        assert "bread" in conds
+        assert "nope" not in conds  # unknown item contributes no rule
+        # milk co-occurs with nothing → no rule block for it
+        assert "milk" not in conds
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name="EmptyCP"))
+        variant = EngineVariant.from_dict(variant_dict("EmptyCP"))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no buy events"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)
+
+    def test_template_scaffold(self, tmp_path):
+        from predictionio_tpu.templates.registry import scaffold
+
+        d = scaffold("complementarypurchase", str(tmp_path / "cp"),
+                     app_name="CPApp")
+        import json
+        import os
+
+        ej = json.load(open(os.path.join(d, "engine.json")))
+        assert ej["engineFactory"] == FACTORY
+        assert ej["preparator"]["params"]["basketWindow"] == 3600

@@ -20,10 +20,12 @@ from predictionio_tpu.workflow.workflow_utils import (
 
 
 class TestRegistry:
-    def test_all_five_reference_templates_present(self):
+    def test_reference_templates_present(self):
+        # the five SURVEY §2.4 templates plus the complementary-purchase
+        # gallery template added in round 2
         assert set(BUILTIN_TEMPLATES) == {
             "recommendation", "similarproduct", "classification",
-            "ecommerce", "textclassification",
+            "ecommerce", "textclassification", "complementarypurchase",
         }
 
     def test_unknown_template_raises(self):
