@@ -58,9 +58,17 @@ def cooccurrence_matrix(
     n_baskets: int,
     n_items: int,
     chunk: int = 1024,
+    max_basket_items: int = 512,
 ) -> np.ndarray:
     """C[i, j] = number of baskets containing both i and j (diagonal =
-    per-item support counts). Chunked one-hot + MXU Gram on device."""
+    per-item support counts). Chunked one-hot + MXU Gram on device.
+
+    `max_basket_items` truncates pathological baskets (a crawler "basket"
+    with 100k purchases would otherwise set the rectangular chunk walk's
+    padded width for EVERY chunk — r2 review): baskets keep their first
+    N distinct-position entries, with a warning. Association rules from
+    bot-sized baskets are noise, not signal.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -73,6 +81,19 @@ def cooccurrence_matrix(
     b_sorted = basket_idx[order]
     i_sorted = item_idx[order]
     counts = np.bincount(b_sorted, minlength=n_baskets)
+    if counts.max(initial=0) > max_basket_items:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "cooccurrence_matrix: truncating %d basket(s) larger than %d "
+            "items", int((counts > max_basket_items).sum()),
+            max_basket_items)
+        starts_full = np.concatenate(([0], np.cumsum(counts)))
+        rank = np.arange(len(b_sorted)) - starts_full[b_sorted]
+        keep = rank < max_basket_items
+        b_sorted = b_sorted[keep]
+        i_sorted = i_sorted[keep]
+        counts = np.bincount(b_sorted, minlength=n_baskets)
     starts = np.concatenate(([0], np.cumsum(counts)))
 
     n_chunks = -(-n_baskets // chunk)
@@ -173,40 +194,52 @@ def mine_rules(
         return _rules_from_sparse(sp, n, n_items, min_support,
                                   min_confidence, min_lift, top_k, score)
 
+    # row-wise pass: materializing full [n_items, n_items] supp/conf/lift
+    # planes alongside C would peak ~7× the documented Gram budget (r2
+    # review); per-condition rows keep the peak at C + O(n_items)
     diag = np.diag(C).copy()
-    Cn = C.copy()
-    np.fill_diagonal(Cn, 0.0)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        supp = Cn / n
-        conf = np.where(diag[:, None] > 0, Cn / diag[:, None], 0.0)
-        lift = np.where(
-            (diag[:, None] > 0) & (diag[None, :] > 0),
-            Cn * n / (diag[:, None] * diag[None, :]), 0.0)
-    # Cn > 0: a rule requires actual co-occurrence (self-pairs and
-    # never-together pairs must not surface when thresholds are 0 — the
-    # sparse fallback only ever sees real pairs)
-    ok = ((Cn > 0) & (supp >= min_support) & (conf >= min_confidence)
-          & (lift >= min_lift))
-    rank = np.where(ok, lift if score == "lift" else conf, -np.inf)
+    # candidate condition rows: any co-occurrence beyond the diagonal
+    nz_per_row = np.count_nonzero(C, axis=1)
+    candidates = np.nonzero(nz_per_row - (diag > 0) > 0)[0]
 
-    cond_rows = np.nonzero(ok.any(axis=1))[0].astype(np.int32)
     k = min(top_k, n_items)
+    ids = np.arange(n_items)
+    cond_list, rows_out = [], []
+    for i in candidates:
+        cn = C[i].copy()
+        cn[i] = 0.0
+        supp = cn / n
+        with np.errstate(divide="ignore", invalid="ignore"):
+            conf = cn / diag[i] if diag[i] > 0 else np.zeros_like(cn)
+            lift = np.where(diag > 0, cn * n / (diag[i] * diag), 0.0) \
+                if diag[i] > 0 else np.zeros_like(cn)
+        # cn > 0: a rule requires actual co-occurrence (self-pairs and
+        # never-together pairs must not surface when thresholds are 0 —
+        # the sparse fallback only ever sees real pairs)
+        ok = ((cn > 0) & (supp >= min_support) & (conf >= min_confidence)
+              & (lift >= min_lift))
+        if not ok.any():
+            continue
+        rank = np.where(ok, lift if score == "lift" else conf, -np.inf)
+        # deterministic order: score desc, item id asc (ties at the top-k
+        # boundary must resolve identically to the sparse fallback)
+        top = np.lexsort((ids, -rank))[:k]
+        top = top[rank[top] > -np.inf]
+        cond_list.append(i)
+        rows_out.append((top, rank[top], supp[top], conf[top], lift[top]))
+
+    cond_rows = np.asarray(cond_list, np.int32)
     cons = np.full((len(cond_rows), k), -1, np.int32)
     sc = np.zeros((len(cond_rows), k), np.float32)
     s_out = np.zeros((len(cond_rows), k), np.float32)
     c_out = np.zeros((len(cond_rows), k), np.float32)
     l_out = np.zeros((len(cond_rows), k), np.float32)
-    for out_i, i in enumerate(cond_rows):
-        # deterministic order: score desc, item id asc (ties at the top-k
-        # boundary must resolve identically to the sparse fallback)
-        top = np.lexsort((np.arange(n_items), -rank[i]))[:k]
-        m = rank[i][top] > -np.inf
-        top = top[m]
+    for out_i, (top, r_v, s_v, c_v, l_v) in enumerate(rows_out):
         cons[out_i, : len(top)] = top
-        sc[out_i, : len(top)] = rank[i][top]
-        s_out[out_i, : len(top)] = supp[i][top]
-        c_out[out_i, : len(top)] = conf[i][top]
-        l_out[out_i, : len(top)] = lift[i][top]
+        sc[out_i, : len(top)] = r_v
+        s_out[out_i, : len(top)] = s_v
+        c_out[out_i, : len(top)] = c_v
+        l_out[out_i, : len(top)] = l_v
     return BasketRules(cond_rows, cons, sc, s_out, c_out, l_out, n_baskets)
 
 

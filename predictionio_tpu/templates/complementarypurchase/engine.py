@@ -204,8 +204,7 @@ class AssociationAlgorithm(Algorithm):
 
 
 class ComplementaryPurchaseEngine(EngineFactory):
-    @classmethod
-    def apply(cls) -> Engine:
+    def apply(self) -> Engine:
         return Engine(
             data_source_class_map=DataSource,
             preparator_class_map=Preparator,

@@ -1,0 +1,30 @@
+"""Product Ranking template — rank a given item list for a user.
+
+Parity with the upstream gallery template
+«template-scala-parallel-productranking» [U]: same ALS training as the
+Recommendation template; serving re-orders the query's candidate items by
+the user's predicted preference, falling back to the original order
+(`isOriginal: true`) for unknown users.
+"""
+
+from predictionio_tpu.templates.productranking.engine import (
+    DataSource,
+    DataSourceParams,
+    Preparator,
+    PreparedData,
+    ProductRankingEngine,
+    Query,
+    RankingALSAlgorithm,
+    TrainingData,
+)
+
+__all__ = [
+    "ProductRankingEngine",
+    "RankingALSAlgorithm",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "Query",
+]

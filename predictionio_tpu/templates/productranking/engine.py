@@ -1,0 +1,91 @@
+"""Product Ranking engine template (DASE components).
+
+Parity with the upstream gallery template
+«template-scala-parallel-productranking» [U]: rank a GIVEN list of items
+for a user (e.g. re-order a landing page or a search result) by the
+user's predicted preference, instead of searching the whole catalog.
+
+Reuses the Recommendation template's data path and ALS training wholesale
+(same events, same `ops/als.py` mesh-sharded train); only serving
+differs: the query names the candidate items, scores come from one tiny
+host-side dot product, and — matching the upstream contract — when the
+model cannot rank (unknown user) the original item order comes back with
+`"isOriginal": true`. Items unknown to the model keep their incoming
+relative order after the ranked ones, at score 0.
+
+Wire shapes:
+    query:  {"user": "u1", "items": ["i3", "i1", "i9"]}
+    result: {"itemScores": [{"item": "i1", "score": 3.2}, ...],
+             "isOriginal": false}
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from predictionio_tpu.controller import Engine, EngineFactory, FirstServing
+from predictionio_tpu.models.als_model import ALSModel
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithm as _RecommendationALS,
+    DataSource,
+    DataSourceParams,
+    Preparator,
+    PreparedData,
+    TrainingData,
+)
+
+Query = dict
+PredictedResult = dict
+
+
+class RankingALSAlgorithm(_RecommendationALS):
+    """Recommendation's ALS train + ranking-specific serving."""
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        items = [str(i) for i in (query.get("items") or [])]
+        user = str(query.get("user", ""))
+        urow = model.user_ids.get(user)
+        if urow is None or not items:
+            # upstream contract: can't personalize → echo the original
+            # order and say so
+            return {"itemScores": [{"item": i, "score": 0.0}
+                                   for i in items],
+                    "isOriginal": True}
+        uvec = model.user_factors[int(urow)]
+        known_rows = [model.item_ids.get(i) for i in items]
+        scored = []
+        unknown = []
+        for pos, (item, row) in enumerate(zip(items, known_rows)):
+            if row is None:
+                unknown.append((pos, item))
+            else:
+                scored.append(
+                    (float(uvec @ model.item_factors[int(row)]), pos, item))
+        # ranked items first (score desc, stable by incoming position),
+        # then unknown items in their original relative order at score 0
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        out = [{"item": item, "score": s} for s, _, item in scored]
+        out += [{"item": item, "score": 0.0} for _, item in unknown]
+        return {"itemScores": out, "isOriginal": False}
+
+
+class ProductRankingEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={"als": RankingALSAlgorithm},
+            serving_class_map=FirstServing,
+        )
+
+
+__all__ = [
+    "ProductRankingEngine",
+    "RankingALSAlgorithm",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "Query",
+]

@@ -100,6 +100,20 @@ BUILTIN_TEMPLATES: dict[str, TemplateInfo] = {
             sample_query={"text": "a great product"},
         ),
         TemplateInfo(
+            name="productranking",
+            description="Product Ranking (re-order a given item list for "
+                        "a user via ALS)",
+            engine_factory=("predictionio_tpu.templates.productranking."
+                            "ProductRankingEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 10, "numIterations": 20, "lambda": 0.01,
+                    "seed": 3}}],
+            },
+            sample_query={"user": "u1", "items": ["i1", "i2", "i3"]},
+        ),
+        TemplateInfo(
             name="complementarypurchase",
             description="Complementary purchase (market-basket association "
                         "rules from buy events)",

@@ -1,0 +1,107 @@
+"""Product Ranking template: rank a GIVEN item list for a user (same ALS
+training as the Recommendation template; ranking-specific serving with
+the upstream isOriginal fallback contract)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.productranking.ProductRankingEngine"
+
+
+def ingest_ratings(storage, app_name="RankApp"):
+    """u_even users love even items (rating 5) and hate odd items (1);
+    u_odd users the reverse — rankings are then fully predictable."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    for u in range(24):
+        for i in range(8):
+            love = (i % 2 == 0) == (u % 2 == 0)
+            le.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": 5.0 if love else 1.0})),
+                app_id)
+    return app_id
+
+
+def variant_dict(app_name="RankApp"):
+    return {
+        "id": "rank-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 15, "lambda": 0.05, "seed": 1}}],
+    }
+
+
+def _trained(storage):
+    variant = EngineVariant.from_dict(variant_dict())
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    ctx = WorkflowContext(storage=storage, seed=1)
+    models = engine.train(ctx, ep)
+    return engine, ep, models
+
+
+class TestProductRanking:
+    def test_ranks_candidates_by_preference(self, memory_storage):
+        ingest_ratings(memory_storage)
+        engine, ep, models = _trained(memory_storage)
+        r = engine.predict(ep, models, {
+            "user": "u0", "items": ["i1", "i2", "i3", "i4"]})
+        assert r["isOriginal"] is False
+        got = [s["item"] for s in r["itemScores"]]
+        assert set(got) == {"i1", "i2", "i3", "i4"}
+        # u0 is an even-lover: both even items must outrank both odd items
+        assert set(got[:2]) == {"i2", "i4"}
+        scores = [s["score"] for s in r["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_returns_original_order(self, memory_storage):
+        ingest_ratings(memory_storage)
+        engine, ep, models = _trained(memory_storage)
+        r = engine.predict(ep, models, {
+            "user": "stranger", "items": ["i3", "i1", "i2"]})
+        assert r["isOriginal"] is True
+        assert [s["item"] for s in r["itemScores"]] == ["i3", "i1", "i2"]
+
+    def test_unknown_items_keep_relative_order_at_end(self, memory_storage):
+        ingest_ratings(memory_storage)
+        engine, ep, models = _trained(memory_storage)
+        r = engine.predict(ep, models, {
+            "user": "u1", "items": ["new2", "i1", "new1", "i2"]})
+        assert r["isOriginal"] is False
+        got = [s["item"] for s in r["itemScores"]]
+        assert got[:2] == ["i1", "i2"]  # u1 odd-lover: i1 over i2
+        assert got[2:] == ["new2", "new1"]  # unknowns keep incoming order
+        assert all(s["score"] == 0.0 for s in r["itemScores"][2:])
+
+    def test_full_workflow_and_persistence(self, memory_storage):
+        ingest_ratings(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        r = engine.predict(ep, models, {"user": "u2", "items": ["i0", "i1"]})
+        assert [s["item"] for s in r["itemScores"]] == ["i0", "i1"]
+
+    def test_empty_items(self, memory_storage):
+        ingest_ratings(memory_storage)
+        engine, ep, models = _trained(memory_storage)
+        r = engine.predict(ep, models, {"user": "u0", "items": []})
+        assert r == {"itemScores": [], "isOriginal": True}

@@ -21,11 +21,12 @@ from predictionio_tpu.workflow.workflow_utils import (
 
 class TestRegistry:
     def test_reference_templates_present(self):
-        # the five SURVEY §2.4 templates plus the complementary-purchase
-        # gallery template added in round 2
+        # the five SURVEY §2.4 templates plus the gallery templates
+        # added in round 2
         assert set(BUILTIN_TEMPLATES) == {
             "recommendation", "similarproduct", "classification",
             "ecommerce", "textclassification", "complementarypurchase",
+            "productranking",
         }
 
     def test_unknown_template_raises(self):
