@@ -52,6 +52,35 @@ class BasketRules:
         return None
 
 
+def _dedup_and_cap(basket_idx, item_idx, n_baskets: int,
+                   max_basket_items: int, caller: str):
+    """Shared pre-pass for BOTH count paths: dedup (basket, item) pairs
+    (incidence is 0/1 — repeat purchases must not count twice OR crowd
+    real items out of the cap), then truncate oversized baskets to
+    `max_basket_items` distinct items (lowest item ids — deterministic)
+    with a warning."""
+    basket_idx = np.asarray(basket_idx, np.int64)
+    item_idx = np.asarray(item_idx, np.int64)
+    n_items_span = int(item_idx.max(initial=-1)) + 1
+    pair = np.unique(basket_idx * max(n_items_span, 1) + item_idx)
+    b_sorted = (pair // max(n_items_span, 1)).astype(np.int32)
+    i_sorted = (pair % max(n_items_span, 1)).astype(np.int32)
+    counts = np.bincount(b_sorted, minlength=n_baskets)
+    if counts.max(initial=0) > max_basket_items:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s: truncating %d basket(s) larger than %d distinct items",
+            caller, int((counts > max_basket_items).sum()),
+            max_basket_items)
+        starts_full = np.concatenate(([0], np.cumsum(counts)))
+        rank = np.arange(len(b_sorted)) - starts_full[b_sorted]
+        keep = rank < max_basket_items
+        b_sorted = b_sorted[keep]
+        i_sorted = i_sorted[keep]
+    return b_sorted, i_sorted
+
+
 def cooccurrence_matrix(
     basket_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -65,35 +94,20 @@ def cooccurrence_matrix(
 
     `max_basket_items` truncates pathological baskets (a crawler "basket"
     with 100k purchases would otherwise set the rectangular chunk walk's
-    padded width for EVERY chunk — r2 review): baskets keep their first
-    N distinct-position entries, with a warning. Association rules from
-    bot-sized baskets are noise, not signal.
+    padded width for EVERY chunk — r2 review): oversized baskets keep N
+    DISTINCT items (duplicates are deduped before the cap, so repeat
+    purchases never crowd out real items), with a warning. Association
+    rules from bot-sized baskets are noise, not signal.
     """
     import jax
     import jax.numpy as jnp
 
     if len(basket_idx) == 0:
         return np.zeros((n_items, n_items), np.float32)
-    basket_idx = np.asarray(basket_idx, np.int32)
-    item_idx = np.asarray(item_idx, np.int32)
-    # CSR by basket so each chunk scatters only its own entries
-    order = np.argsort(basket_idx, kind="stable")
-    b_sorted = basket_idx[order]
-    i_sorted = item_idx[order]
+    b_sorted, i_sorted = _dedup_and_cap(basket_idx, item_idx, n_baskets,
+                                        max_basket_items,
+                                        "cooccurrence_matrix")
     counts = np.bincount(b_sorted, minlength=n_baskets)
-    if counts.max(initial=0) > max_basket_items:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "cooccurrence_matrix: truncating %d basket(s) larger than %d "
-            "items", int((counts > max_basket_items).sum()),
-            max_basket_items)
-        starts_full = np.concatenate(([0], np.cumsum(counts)))
-        rank = np.arange(len(b_sorted)) - starts_full[b_sorted]
-        keep = rank < max_basket_items
-        b_sorted = b_sorted[keep]
-        i_sorted = i_sorted[keep]
-        counts = np.bincount(b_sorted, minlength=n_baskets)
     starts = np.concatenate(([0], np.cumsum(counts)))
 
     n_chunks = -(-n_baskets // chunk)
@@ -146,11 +160,18 @@ def cooccurrence_matrix_host(
     item_idx: np.ndarray,
     n_baskets: int,
     n_items: int,
+    max_basket_items: int = 512,
 ) -> dict:
     """Sparse host fallback for catalogs too large for the dense Gram:
-    {(i, j): count} for i < j plus {i: support} — same math."""
+    {(i, j): count} for i < j plus {i: support} — same math, and the SAME
+    basket cap as the dense path (an unbounded bot basket would otherwise
+    enumerate O(n²) pairs here — r2 review)."""
     from collections import Counter, defaultdict
 
+    if len(basket_idx):
+        basket_idx, item_idx = _dedup_and_cap(
+            basket_idx, item_idx, n_baskets, max_basket_items,
+            "cooccurrence_matrix_host")
     per_basket: dict = defaultdict(set)
     for b, i in zip(basket_idx, item_idx):
         per_basket[int(b)].add(int(i))
@@ -176,6 +197,7 @@ def mine_rules(
     top_k: int = 10,
     score: str = "lift",
     max_dense_items: int = 8192,
+    max_basket_items: int = 512,
 ) -> BasketRules:
     """Pairwise association rules i → j, thresholded and top-k'd.
 
@@ -187,10 +209,12 @@ def mine_rules(
         raise ValueError(f"score must be 'lift' or 'confidence': {score!r}")
     n = max(n_baskets, 1)
     if n_items <= max_dense_items:
-        C = cooccurrence_matrix(basket_idx, item_idx, n_baskets, n_items)
+        C = cooccurrence_matrix(basket_idx, item_idx, n_baskets, n_items,
+                                max_basket_items=max_basket_items)
     else:
         sp = cooccurrence_matrix_host(basket_idx, item_idx, n_baskets,
-                                      n_items)
+                                      n_items,
+                                      max_basket_items=max_basket_items)
         return _rules_from_sparse(sp, n, n_items, min_support,
                                   min_confidence, min_lift, top_k, score)
 

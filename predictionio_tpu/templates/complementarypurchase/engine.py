@@ -166,6 +166,7 @@ class AssociationParams(Params):
     numRulesPerCond: int = 10  # top-k consequents kept per condition item
     score: str = "lift"  # "lift" | "confidence" ranking
     maxDenseItems: int = 8192  # catalog bound for the on-device Gram
+    maxBasketItems: int = 512  # distinct items kept per basket (bot guard)
 
 
 class AssociationAlgorithm(Algorithm):
@@ -182,7 +183,8 @@ class AssociationAlgorithm(Algorithm):
             pd.basket_idx, pd.item_idx, pd.n_baskets, len(pd.item_ids),
             min_support=p.minSupport, min_confidence=p.minConfidence,
             min_lift=p.minLift, top_k=p.numRulesPerCond, score=p.score,
-            max_dense_items=p.maxDenseItems)
+            max_dense_items=p.maxDenseItems,
+            max_basket_items=p.maxBasketItems)
         n_rules = int((rules.cons_items >= 0).sum())
         log.info("AssociationAlgorithm: %d rules over %d condition items "
                  "(%d baskets)", n_rules, len(rules.cond_items),

@@ -1,0 +1,36 @@
+"""Lead Scoring template — conversion probability from session features.
+
+Parity with the upstream gallery template
+«template-scala-parallel-leadscoring» [U]: a visit's first-view
+attributes (landing page, referrer, browser) predict whether the session
+converts; the upstream's RandomForest is substituted with the
+framework's jitted softmax regression (documented in the engine module).
+"""
+
+from predictionio_tpu.templates.leadscoring.engine import (
+    DataSource,
+    DataSourceParams,
+    LeadScoringAlgorithm,
+    LeadScoringEngine,
+    LeadScoringModel,
+    LeadScoringParams,
+    Preparator,
+    PreparedData,
+    Query,
+    Session,
+    TrainingData,
+)
+
+__all__ = [
+    "LeadScoringEngine",
+    "LeadScoringModel",
+    "LeadScoringAlgorithm",
+    "LeadScoringParams",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "Session",
+    "Query",
+]

@@ -1,0 +1,209 @@
+"""Lead Scoring engine template (DASE components).
+
+Parity with the upstream gallery template
+«template-scala-parallel-leadscoring» [U]: score how likely a visit
+converts (a `buy` happens in the session) from the session's first-view
+attributes — landing page, referrer, browser. The upstream trains an
+MLlib RandomForest on those three categorical features; here the
+classifier is the jitted softmax regression from `ops/classify.py`
+(the framework's LBFGS-role trainer) over one-hot encodings — a
+documented substitution, same feature contract and query shape.
+
+Events:
+    view: {"event": "view", "entityType": "user", properties:
+           {"sessionId": "s1", "landingPageId": "lp1",
+            "referrerId": "r1", "browser": "Chrome"}}
+    buy:  {"event": "buy", "entityType": "user", properties:
+           {"sessionId": "s1"}}
+
+Wire shapes:
+    query:  {"landingPageId": "lp1", "referrerId": "r1",
+             "browser": "Chrome"}
+    result: {"score": 0.73}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource as BaseDataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator as BasePreparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.ops.classify import LogRegModel, logreg_train
+
+log = logging.getLogger(__name__)
+
+Query = dict
+PredictedResult = dict
+
+_FEATURE_FIELDS = ("landingPageId", "referrerId", "browser")
+
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    appName: str = ""
+    viewEvents: list = dataclasses.field(default_factory=lambda: ["view"])
+    buyEvents: list = dataclasses.field(default_factory=lambda: ["buy"])
+
+
+@dataclasses.dataclass
+class Session:
+    features: tuple  # (landingPageId, referrerId, browser)
+    converted: bool
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    sessions: list  # of Session
+
+    def sanity_check(self):
+        if not self.sessions:
+            raise ValueError(
+                "TrainingData has no sessions; ingest view events with "
+                "sessionId/landingPageId/referrerId/browser properties.")
+        if all(s.converted for s in self.sessions) or not any(
+                s.converted for s in self.sessions):
+            log.warning("TrainingData: all sessions share one label; the "
+                        "score will be degenerate")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        store = PEventStore(ctx.storage)
+        sessions: dict[str, tuple] = {}
+        for ev in store.find(app_name=self.params.appName,
+                             event_names=list(self.params.viewEvents)):
+            sid = ev.properties.get("sessionId")
+            if sid is None:
+                continue
+            sid = str(sid)  # numeric ids must compare like their stores
+            if not sid or sid in sessions:
+                continue  # first view defines the session's features
+            sessions[sid] = tuple(
+                str(ev.properties.get(f, "")) for f in _FEATURE_FIELDS)
+        converted = set()
+        for ev in store.find(app_name=self.params.appName,
+                             event_names=list(self.params.buyEvents)):
+            sid = ev.properties.get("sessionId")
+            if sid is not None and str(sid):
+                converted.add(str(sid))
+        out = [Session(features=f, converted=sid in converted)
+               for sid, f in sessions.items()]
+        log.info("DataSource: %d sessions (%d converted), app %r",
+                 len(out), sum(s.converted for s in out),
+                 self.params.appName)
+        return TrainingData(sessions=out)
+
+
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray  # [n_sessions, D] one-hot blocks
+    labels: np.ndarray  # [n_sessions] int32 (1 = converted)
+    vocabs: list  # per feature field: {value: column offset within block}
+    offsets: list  # per feature field: block start column
+
+
+class Preparator(BasePreparator):
+    """One-hot encode the three categorical session features."""
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
+        vocabs: list[dict] = []
+        offsets: list[int] = []
+        d = 0
+        for f_i in range(len(_FEATURE_FIELDS)):
+            values = sorted({s.features[f_i] for s in td.sessions})
+            vocabs.append({v: j for j, v in enumerate(values)})
+            offsets.append(d)
+            d += len(values)
+        x = np.zeros((len(td.sessions), d), np.float32)
+        y = np.zeros(len(td.sessions), np.int32)
+        for r, s in enumerate(td.sessions):
+            for f_i, v in enumerate(s.features):
+                x[r, offsets[f_i] + vocabs[f_i][v]] = 1.0
+            y[r] = 1 if s.converted else 0
+        return PreparedData(features=x, labels=y, vocabs=vocabs,
+                            offsets=offsets)
+
+
+@dataclasses.dataclass
+class LeadScoringModel:
+    lr: LogRegModel
+    vocabs: list
+    offsets: list
+    base_rate: float  # training conversion rate (unseen-feature fallback)
+
+    def score(self, landing: str, referrer: str, browser: str) -> float:
+        d = self.lr.weights.shape[0]
+        x = np.zeros((1, d), np.float32)
+        known = 0
+        for f_i, v in enumerate((landing, referrer, browser)):
+            j = self.vocabs[f_i].get(str(v))
+            if j is not None:
+                x[0, self.offsets[f_i] + j] = 1.0
+                known += 1
+        if known == 0:
+            # wholly unseen visit: the honest prior, not a logit of zeros
+            return self.base_rate
+        logits = self.lr.logits(x)[0]
+        e = np.exp(logits - logits.max())
+        return float(e[1] / e.sum())
+
+
+@dataclasses.dataclass
+class LeadScoringParams(Params):
+    iterations: int = 300
+    stepSize: float = 0.1
+    regParam: float = 0.01
+
+
+class LeadScoringAlgorithm(Algorithm):
+    params_class = LeadScoringParams
+
+    def __init__(self, params: LeadScoringParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> LeadScoringModel:
+        lr = logreg_train(
+            pd.features, pd.labels, n_classes=2,
+            iterations=self.params.iterations,
+            learning_rate=self.params.stepSize,
+            reg=self.params.regParam, mesh=ctx.mesh)
+        rate = float(pd.labels.mean()) if len(pd.labels) else 0.0
+        ctx.metrics.emit("train/leadscoring", sessions=len(pd.labels),
+                         conversion_rate=rate)
+        return LeadScoringModel(lr=lr, vocabs=pd.vocabs,
+                                offsets=pd.offsets, base_rate=rate)
+
+    def predict(self, model: LeadScoringModel, query: Query) -> PredictedResult:
+        return {"score": model.score(
+            str(query.get("landingPageId", "")),
+            str(query.get("referrerId", "")),
+            str(query.get("browser", "")))}
+
+
+class LeadScoringEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={"leadscoring": LeadScoringAlgorithm},
+            serving_class_map=FirstServing,
+        )

@@ -52,20 +52,18 @@ class RankingALSAlgorithm(_RecommendationALS):
                                    for i in items],
                     "isOriginal": True}
         uvec = model.user_factors[int(urow)]
-        known_rows = [model.item_ids.get(i) for i in items]
+        # unknown items enter the ranking at score 0 (upstream contract),
+        # NOT appended after known ones — an explicit-feedback model can
+        # score disliked items negative, and the response must stay
+        # score-descending (ties keep incoming order)
         scored = []
-        unknown = []
-        for pos, (item, row) in enumerate(zip(items, known_rows)):
-            if row is None:
-                unknown.append((pos, item))
-            else:
-                scored.append(
-                    (float(uvec @ model.item_factors[int(row)]), pos, item))
-        # ranked items first (score desc, stable by incoming position),
-        # then unknown items in their original relative order at score 0
+        for pos, item in enumerate(items):
+            row = model.item_ids.get(item)
+            score = (0.0 if row is None
+                     else float(uvec @ model.item_factors[int(row)]))
+            scored.append((score, pos, item))
         scored.sort(key=lambda t: (-t[0], t[1]))
         out = [{"item": item, "score": s} for s, _, item in scored]
-        out += [{"item": item, "score": 0.0} for _, item in unknown]
         return {"itemScores": out, "isOriginal": False}
 
 

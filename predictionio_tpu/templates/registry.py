@@ -114,6 +114,21 @@ BUILTIN_TEMPLATES: dict[str, TemplateInfo] = {
             sample_query={"user": "u1", "items": ["i1", "i2", "i3"]},
         ),
         TemplateInfo(
+            name="leadscoring",
+            description="Lead Scoring (conversion probability from session "
+                        "features via softmax regression)",
+            engine_factory=("predictionio_tpu.templates.leadscoring."
+                            "LeadScoringEngine"),
+            engine_json={
+                "datasource": {"params": {"appName": "MyApp"}},
+                "algorithms": [{"name": "leadscoring", "params": {
+                    "iterations": 300, "stepSize": 0.1,
+                    "regParam": 0.01}}],
+            },
+            sample_query={"landingPageId": "lp1", "referrerId": "r1",
+                          "browser": "Chrome"},
+        ),
+        TemplateInfo(
             name="complementarypurchase",
             description="Complementary purchase (market-basket association "
                         "rules from buy events)",

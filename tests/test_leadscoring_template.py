@@ -1,0 +1,102 @@
+"""Lead Scoring template: session first-view features → conversion
+probability (softmax regression in the upstream RandomForest's role)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+
+FACTORY = "predictionio_tpu.templates.leadscoring.LeadScoringEngine"
+
+
+def ingest_sessions(storage, app_name="LeadApp"):
+    """Planted structure: landing page "promo" converts ~90%, "home" ~10%,
+    independent of the other features."""
+    app_id = storage.meta_apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    rng = np.random.default_rng(7)
+    n = 0
+    for lp, rate in (("promo", 0.9), ("home", 0.1)):
+        for k in range(60):
+            sid = f"s{n}"
+            n += 1
+            le.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{n}",
+                properties=DataMap({
+                    "sessionId": sid, "landingPageId": lp,
+                    "referrerId": f"r{k % 3}",
+                    "browser": ["Chrome", "Firefox"][k % 2]})), app_id)
+            if rng.random() < rate:
+                le.insert(Event(
+                    event="buy", entity_type="user", entity_id=f"u{n}",
+                    target_entity_type="item", target_entity_id="i1",
+                    properties=DataMap({"sessionId": sid})), app_id)
+    return app_id
+
+
+def variant_dict(app_name="LeadApp"):
+    return {
+        "id": "lead-test",
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "leadscoring", "params": {
+            "iterations": 300, "stepSize": 0.2, "regParam": 0.01}}],
+    }
+
+
+class TestLeadScoring:
+    def test_train_and_score_separates_pages(self, memory_storage):
+        ingest_sessions(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        instance = CoreWorkflow.run_train(engine, ep, variant, ctx)
+        assert instance.status == "COMPLETED"
+
+        blob = memory_storage.model_data_models().get(instance.id).models
+        models = engine.deserialize_models(blob, instance.id, ep)
+        hi = engine.predict(ep, models, {
+            "landingPageId": "promo", "referrerId": "r0",
+            "browser": "Chrome"})["score"]
+        lo = engine.predict(ep, models, {
+            "landingPageId": "home", "referrerId": "r0",
+            "browser": "Chrome"})["score"]
+        assert 0.0 <= lo < hi <= 1.0
+        assert hi > 0.6 and lo < 0.4  # planted 0.9 vs 0.1 rates
+
+    def test_unseen_features_fall_back_to_base_rate(self, memory_storage):
+        ingest_sessions(memory_storage)
+        variant = EngineVariant.from_dict(variant_dict())
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1)
+        models = engine.train(ctx, ep)
+        s = engine.predict(ep, models, {
+            "landingPageId": "never-seen", "referrerId": "nope",
+            "browser": "Netscape"})["score"]
+        # the honest prior: overall training conversion rate (~0.5 here)
+        assert 0.3 < s < 0.7
+        # partially-known queries still use the model
+        s2 = engine.predict(ep, models, {
+            "landingPageId": "promo", "referrerId": "nope",
+            "browser": "Netscape"})["score"]
+        assert s2 > 0.5
+
+    def test_empty_app_fails_sanity_check(self, memory_storage):
+        memory_storage.meta_apps().insert(App(id=0, name="EmptyLead"))
+        variant = EngineVariant.from_dict(variant_dict("EmptyLead"))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage)
+        with pytest.raises(ValueError, match="no sessions"):
+            CoreWorkflow.run_train(engine, ep, variant, ctx)

@@ -26,7 +26,7 @@ class TestRegistry:
         assert set(BUILTIN_TEMPLATES) == {
             "recommendation", "similarproduct", "classification",
             "ecommerce", "textclassification", "complementarypurchase",
-            "productranking",
+            "productranking", "leadscoring",
         }
 
     def test_unknown_template_raises(self):
