@@ -47,6 +47,7 @@ from predictionio_tpu.controller.base import (
 )
 from predictionio_tpu.controller.engine import Engine, EngineFactory, EngineParams
 from predictionio_tpu.controller.metrics import (
+    AUC,
     AverageMetric,
     Metric,
     OptionAverageMetric,
@@ -89,6 +90,7 @@ __all__ = [
     "EngineFactory",
     "EngineParams",
     "Metric",
+    "AUC",
     "AverageMetric",
     "OptionAverageMetric",
     "StdevMetric",

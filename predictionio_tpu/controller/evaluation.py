@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import logging
 from typing import Any, Optional, Sequence
 
@@ -112,14 +113,15 @@ class MetricEvaluator:
                     scores = [metric.calculate(q, p, a) for q, p, a in qpa]
                     fold_scores[metric.name] = metric.aggregate(scores)
                 per_fold.append(fold_scores)
-            agg = {
-                m.name: (
-                    sum(f[m.name] for f in per_fold) / len(per_fold)
-                    if per_fold
-                    else float("nan")
-                )
-                for m in metrics
-            }
+            # a fold where a metric is undefined (NaN — e.g. AUC on a
+            # one-class test split) must not poison the candidate's mean:
+            # average over the folds where the metric IS defined
+            def _mean_defined(name: str) -> float:
+                vals = [f[name] for f in per_fold
+                        if not math.isnan(f[name])]
+                return sum(vals) / len(vals) if vals else float("nan")
+
+            agg = {m.name: _mean_defined(m.name) for m in metrics}
             all_results.append(MetricScores(ep, agg, per_fold))
         best = all_results[0]
         for r in all_results[1:]:

@@ -75,3 +75,56 @@ class ZeroMetric(Metric[Any, Any, Any]):
 
     def calculate(self, query, predicted, actual) -> float:
         return 0.0
+
+
+class AUC(Metric[Any, dict, dict]):
+    """Area under the ROC curve for binary scoring engines (the
+    «BinaryClassificationMetrics.areaUnderROC» role [U] — MLlib computes
+    it outside the Metric zoo; here it joins the zoo).
+
+    The Metric contract routes one float per (query, predicted, actual)
+    through `calculate` and hands the list to `aggregate`, but AUC is a
+    set-level statistic over (score, label) pairs — so `calculate`
+    buffers the pair internally and returns None, and `aggregate`
+    computes the rank-based AUC (Mann-Whitney U with tie correction)
+    over the buffered fold and clears it. This fits the evaluator's
+    per-fold calculate-all-then-aggregate call pattern exactly
+    (MetricEvaluator.evaluate); interleaving two folds' calculate calls
+    without an intervening aggregate would mix them.
+
+    `predicted[score_key]` is the engine's score; `actual[label_key]`
+    must be 0/1 (or truthy/falsy).
+    """
+
+    def __init__(self, score_key: str = "score", label_key: str = "label"):
+        self.score_key = score_key
+        self.label_key = label_key
+        self._pairs: list[tuple[float, int]] = []
+
+    def calculate(self, query, predicted, actual) -> Optional[float]:
+        self._pairs.append((float(predicted[self.score_key]),
+                            1 if actual[self.label_key] else 0))
+        return None
+
+    def aggregate(self, scores: Sequence[Optional[float]]) -> float:
+        pairs, self._pairs = self._pairs, []
+        n_pos = sum(label for _, label in pairs)
+        n_neg = len(pairs) - n_pos
+        if n_pos == 0 or n_neg == 0:
+            return float("nan")  # AUC undefined on a one-class fold
+        # average ranks with tie correction, rank-sum over positives
+        order = sorted(range(len(pairs)), key=lambda i: pairs[i][0])
+        ranks = [0.0] * len(pairs)
+        i = 0
+        while i < len(order):
+            j = i
+            while (j + 1 < len(order)
+                   and pairs[order[j + 1]][0] == pairs[order[i]][0]):
+                j += 1
+            avg_rank = (i + j) / 2.0 + 1.0
+            for k in range(i, j + 1):
+                ranks[order[k]] = avg_rank
+            i = j + 1
+        rank_sum_pos = sum(r for r, (_, label) in zip(ranks, pairs) if label)
+        u = rank_sum_pos - n_pos * (n_pos + 1) / 2.0
+        return float(u / (n_pos * n_neg))

@@ -57,6 +57,7 @@ class DataSourceParams(Params):
     appName: str = ""
     viewEvents: list = dataclasses.field(default_factory=lambda: ["view"])
     buyEvents: list = dataclasses.field(default_factory=lambda: ["buy"])
+    evalK: int = 0  # >0 enables read_eval with k session folds
 
 
 @dataclasses.dataclass
@@ -111,6 +112,24 @@ class DataSource(BaseDataSource):
                  len(out), sum(s.converted for s in out),
                  self.params.appName)
         return TrainingData(sessions=out)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold over sessions («DataSource.readEval» [U]): fold i tests
+        on every k-th session. Queries carry the session's features,
+        actuals its conversion label — scored with `metrics.AUC`."""
+        from predictionio_tpu.e2.evaluation import cross_validation_splits
+
+        k = self.params.evalK
+        if k <= 1:
+            raise ValueError(
+                "DataSourceParams.evalK must be >= 2 for evaluation")
+        td = self.read_training(ctx)
+        return cross_validation_splits(
+            td.sessions, k,
+            create_training=lambda train: TrainingData(sessions=train),
+            to_query_actual=lambda s: (
+                dict(zip(_FEATURE_FIELDS, s.features)),
+                {"label": 1 if s.converted else 0}))
 
 
 @dataclasses.dataclass

@@ -1,0 +1,49 @@
+"""Lead Scoring evaluation: AUC over k session folds, across a small
+regularization grid (the upstream template evaluates its forest with
+MLlib's BinaryClassificationMetrics [U]; here AUC lives in the metric
+zoo — controller/metrics.AUC)."""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import (
+    AUC,
+    EngineParams,
+    Evaluation,
+    EngineParamsGenerator as BaseGenerator,
+)
+from predictionio_tpu.templates.leadscoring.engine import (
+    DataSourceParams,
+    LeadScoringEngine,
+    LeadScoringParams,
+)
+
+
+class RegGridGenerator(BaseGenerator):
+    """Grid over regParam — subclass or construct with your own values."""
+
+    def __init__(self, app_name: str, eval_k: int = 3,
+                 reg_params=(0.001, 0.01, 0.1)):
+        self.engine_params_list = [
+            EngineParams(
+                data_source_params=DataSourceParams(appName=app_name,
+                                                    evalK=eval_k),
+                algorithm_params_list=[
+                    ("leadscoring", LeadScoringParams(regParam=r))],
+            )
+            for r in reg_params
+        ]
+
+
+class LeadScoringEvaluation(Evaluation, RegGridGenerator):
+    """CLI entry point (`pio eval ...leadscoring.evaluation.
+    LeadScoringEvaluation`): app name from PIO_EVAL_APP_NAME (default
+    "MyApp1"), same convention as the Recommendation evaluation."""
+
+    engine = LeadScoringEngine().apply()
+    metric = AUC()
+
+    def __init__(self):
+        import os
+
+        RegGridGenerator.__init__(
+            self, os.environ.get("PIO_EVAL_APP_NAME", "MyApp1"))
