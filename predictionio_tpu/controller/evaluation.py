@@ -102,6 +102,13 @@ class MetricEvaluator:
         metrics = evaluation.all_metrics()
         primary = metrics[0]
         all_results: list[MetricScores] = []
+        # stateful metrics (e.g. AUC) buffer between calculate and
+        # aggregate; an aborted fold must not leak its partial buffer
+        # into a later evaluation that reuses the metric instance
+        for metric in metrics:
+            reset = getattr(metric, "reset", None)
+            if callable(reset):
+                reset()
         for i, ep in enumerate(engine_params_list):
             log.info("MetricEvaluator: engine params %d/%d", i + 1,
                      len(engine_params_list))

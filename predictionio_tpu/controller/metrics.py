@@ -93,13 +93,18 @@ class AUC(Metric[Any, dict, dict]):
     without an intervening aggregate would mix them.
 
     `predicted[score_key]` is the engine's score; `actual[label_key]`
-    must be 0/1 (or truthy/falsy).
+    must be 0/1 (or truthy/falsy). `reset()` drops a partially-buffered
+    fold (call it if an evaluation aborted mid-fold and the instance is
+    reused — aggregate() also clears, so completed folds never leak).
     """
 
     def __init__(self, score_key: str = "score", label_key: str = "label"):
         self.score_key = score_key
         self.label_key = label_key
         self._pairs: list[tuple[float, int]] = []
+
+    def reset(self) -> None:
+        self._pairs = []
 
     def calculate(self, query, predicted, actual) -> Optional[float]:
         self._pairs.append((float(predicted[self.score_key]),

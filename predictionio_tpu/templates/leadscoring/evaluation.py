@@ -40,10 +40,11 @@ class LeadScoringEvaluation(Evaluation, RegGridGenerator):
     "MyApp1"), same convention as the Recommendation evaluation."""
 
     engine = LeadScoringEngine().apply()
-    metric = AUC()
 
     def __init__(self):
         import os
 
+        self.metric = AUC()  # per-instance: AUC buffers state across folds
         RegGridGenerator.__init__(
-            self, os.environ.get("PIO_EVAL_APP_NAME", "MyApp1"))
+            self, os.environ.get("PIO_EVAL_APP_NAME", "MyApp1"),
+            eval_k=int(os.environ.get("PIO_EVAL_K", "3")))
