@@ -106,9 +106,7 @@ class MetricEvaluator:
         # aggregate; an aborted fold must not leak its partial buffer
         # into a later evaluation that reuses the metric instance
         for metric in metrics:
-            reset = getattr(metric, "reset", None)
-            if callable(reset):
-                reset()
+            metric.reset()
         for i, ep in enumerate(engine_params_list):
             log.info("MetricEvaluator: engine params %d/%d", i + 1,
                      len(engine_params_list))

@@ -36,6 +36,11 @@ class Metric(abc.ABC, Generic[Q, R, A]):
     def name(self) -> str:
         return type(self).__name__
 
+    def reset(self) -> None:
+        """Drop any buffered evaluation state. No-op for the stateless
+        default; stateful metrics (AUC) override — the evaluator calls it
+        before each run so an aborted fold can't leak into the next."""
+
     def compare(self, a: float, b: float) -> int:
         """>0 if a better than b."""
         if math.isnan(a):

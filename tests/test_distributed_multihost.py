@@ -88,6 +88,7 @@ def test_two_process_global_mesh(tmp_path):
     assert results[0]["rows"] == [0, 8] and results[1]["rows"] == [8, 16]
 
 
+
 TRAIN_ENV_KEYS = dict(
     PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="SQL",
     PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="SQL",
@@ -96,48 +97,44 @@ TRAIN_ENV_KEYS = dict(
 )
 
 
-@pytest.mark.e2e
-def test_two_process_pio_train_cli(tmp_path):
-    """The real pod contract end-to-end: TWO `bin/pio train` processes
-    federate via PIO_COORDINATOR_* into one 8-device world over a shared
-    file store; every rank trains (collectives need all of them), rank 0
-    alone persists the model + COMPLETED instance, and the persisted
-    model loads and answers a query."""
-    import sqlite3
+def _seed_ratings(db, app_name, n_events, n_users, n_items, seed):
+    """App + random rate events straight through the storage layer."""
+    import numpy as np
 
-    db = tmp_path / "pio.db"
-    # seed app + ratings through the storage layer
-    import sys as _sys
-
-    _sys.path.insert(0, str(REPO))
     from predictionio_tpu.data.datamap import DataMap
     from predictionio_tpu.data.events import Event
     from predictionio_tpu.storage.base import App
     from predictionio_tpu.storage.sqlite import SQLiteBackend
 
     backend = SQLiteBackend(str(db))
-    app_id = backend.apps().insert(App(id=0, name="MHApp"))
-    import numpy as np
-
-    rng = np.random.default_rng(3)
-    rows = [Event(event="rate", entity_type="user", entity_id=str(u),
-                  target_entity_type="item", target_entity_id=str(i),
-                  properties=DataMap({"rating": float(r)}))
-            for u, i, r in zip(rng.integers(0, 48, 3000),
-                               rng.integers(0, 32, 3000),
-                               rng.integers(1, 6, 3000))]
-    backend.events().insert_batch(rows, app_id=app_id)
+    app_id = backend.apps().insert(App(id=0, name=app_name))
+    rng = np.random.default_rng(seed)
+    backend.events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=str(u),
+               target_entity_type="item", target_entity_id=str(i),
+               properties=DataMap({"rating": float(r)}))
+         for u, i, r in zip(rng.integers(0, n_users, n_events),
+                            rng.integers(0, n_items, n_events),
+                            rng.integers(1, 6, n_events))],
+        app_id=app_id)
     backend.close()
 
-    engine_json = tmp_path / "engine.json"
-    engine_json.write_text(json.dumps({
-        "id": "mh", "engineFactory":
+
+def _write_engine_json(path, app_name, engine_id, rank, iters):
+    path.write_text(json.dumps({
+        "id": engine_id, "engineFactory":
             "predictionio_tpu.templates.recommendation.RecommendationEngine",
-        "datasource": {"params": {"appName": "MHApp"}},
+        "datasource": {"params": {"appName": app_name}},
         "algorithms": [{"name": "als", "params": {
-            "rank": 8, "numIterations": 3, "lambda": 0.05, "seed": 1}}],
+            "rank": rank, "numIterations": iters, "lambda": 0.05,
+            "seed": 1}}],
     }))
 
+
+def _run_two_rank_train(engine_json, db, basedir, extra_env=None):
+    """Launch TWO `bin/pio train` ranks federated via PIO_COORDINATOR_*;
+    returns their outputs after asserting both exited 0. THE pod-contract
+    harness — tests state only what differs (e.g. the MODELDATA source)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -148,7 +145,7 @@ def test_two_process_pio_train_cli(tmp_path):
         env.update(
             TRAIN_ENV_KEYS,
             PIO_STORAGE_SOURCES_SQL_PATH=str(db),
-            PIO_FS_BASEDIR=str(tmp_path),
+            PIO_FS_BASEDIR=str(basedir),
             PIO_JAX_PLATFORM="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
             PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
@@ -156,6 +153,7 @@ def test_two_process_pio_train_cli(tmp_path):
             PIO_PROCESS_ID=str(pid),
             PYTHONPATH=f"{REPO}{os.pathsep}" + os.environ.get("PYTHONPATH", ""),
         )
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [str(REPO / "bin" / "pio"), "train",
              "--engine-json", str(engine_json)],
@@ -170,7 +168,24 @@ def test_two_process_pio_train_cli(tmp_path):
                 p.wait(timeout=30)
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o
-    assert "Training completed" in outs[0]  # rank 0 persists + reports
+    return outs
+
+
+@pytest.mark.e2e
+def test_two_process_pio_train_cli(tmp_path):
+    """The real pod contract end-to-end: TWO `bin/pio train` processes
+    federate via PIO_COORDINATOR_* into one 8-device world over a shared
+    file store; every rank trains (collectives need all of them), rank 0
+    alone persists the model + COMPLETED instance, and the persisted
+    model loads and answers a query."""
+    import sqlite3
+
+    db = tmp_path / "pio.db"
+    _seed_ratings(db, "MHApp", 3000, 48, 32, seed=3)
+    engine_json = tmp_path / "engine.json"
+    _write_engine_json(engine_json, "MHApp", "mh", rank=8, iters=3)
+
+    outs = _run_two_rank_train(engine_json, db, tmp_path)
 
     conn = sqlite3.connect(db)
     completed = conn.execute(
@@ -180,6 +195,9 @@ def test_two_process_pio_train_cli(tmp_path):
     models = conn.execute("SELECT count(*) FROM models").fetchone()[0]
     assert models == 1
     conn.close()
+    # rank 0 reported the REAL persisted instance id (rank 1 prints a
+    # worker placeholder)
+    assert f"Engine instance ID: {completed[0][0]}" in outs[0]
 
     # the persisted model must load and answer a query (single process)
     from predictionio_tpu.storage.registry import (
@@ -204,3 +222,42 @@ def test_two_process_pio_train_cli(tmp_path):
         assert 1 <= len(r["itemScores"]) <= 3
     finally:
         storage.close()
+
+
+@pytest.mark.e2e
+def test_two_process_train_persists_to_object_store(tmp_path):
+    """Multi-host deployments without a shared filesystem point MODELDATA
+    at the s3 source (docs/operations.md); rank 0's model blob must land
+    in the object store and load back."""
+    import sqlite3
+
+    from predictionio_tpu.storage.objectstore import S3Client
+    from predictionio_tpu.storage.objectstore_server import ObjectStoreServer
+
+    srv = ObjectStoreServer(str(tmp_path / "objects")).start()
+    try:
+        db = tmp_path / "pio.db"
+        _seed_ratings(db, "MHS3App", 1500, 32, 24, seed=5)
+        engine_json = tmp_path / "engine.json"
+        _write_engine_json(engine_json, "MHS3App", "mhs3", rank=6, iters=2)
+
+        _run_two_rank_train(engine_json, db, tmp_path, extra_env={
+            "PIO_STORAGE_SOURCES_OBJ_TYPE": "s3",
+            "PIO_STORAGE_SOURCES_OBJ_PATH":
+                f"s3://pio/models?endpoint=http://127.0.0.1:{srv.port}",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ",
+        })
+
+        conn = sqlite3.connect(db)
+        (instance_id,) = conn.execute(
+            "SELECT id FROM engine_instances WHERE status='COMPLETED'"
+        ).fetchone()
+        conn.close()
+        # exactly one model object, named by the instance, fetchable
+        blobs = os.listdir(tmp_path / "objects" / "pio" / "models")
+        assert blobs == [f"{instance_id}.model"]
+        data = S3Client(f"http://127.0.0.1:{srv.port}", "pio").get_object(
+            f"models/{instance_id}.model")
+        assert data and len(data) > 1000
+    finally:
+        srv.shutdown()
