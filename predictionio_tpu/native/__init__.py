@@ -89,12 +89,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                  np.ctypeslib.ndpointer(np.int32),
                                  np.ctypeslib.ndpointer(np.int64),
                                  np.ctypeslib.ndpointer(np.float32))
+        f64 = ctypes.c_double
         lib.pio_plan_buckets.restype = i64
         lib.pio_plan_buckets.argtypes = [
-            i32p, i64, ctypes.c_int32, i64, i64, i64, i64p, i64p]
+            i32p, i64, ctypes.c_int32, i64, i64, i64, f64, i64p, i64p]
         lib.pio_fill_buckets.restype = i64
         lib.pio_fill_buckets.argtypes = [
-            i32p, i32p, f32p, i64, ctypes.c_int32, i64, i64, i64, i64,
+            i32p, i32p, f32p, i64, ctypes.c_int32, i64, i64, i64, f64, i64,
             i64p, i64p, i32p, i32p, f32p, f32p]
         cstr = ctypes.c_char_p
         cstrp = ctypes.POINTER(ctypes.c_char_p)
@@ -187,7 +188,8 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
                          vals: np.ndarray, n_rows: int,
                          row_multiple: int = 8,
                          max_cap: Optional[int] = None,
-                         min_cap: int = 8):
+                         min_cap: int = 8,
+                         cap_growth: float = 1.5):
     """COO → padded buckets via the C++ loader; output matches
     ops.als.bucket_ragged bit for bit. Returns None when the native
     library is unavailable (caller falls back to numpy)."""
@@ -204,7 +206,7 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
     caps = np.zeros(63, dtype=np.int64)
     rpads = np.zeros(63, dtype=np.int64)
     nb = lib.pio_plan_buckets(rows, n, n_rows, row_multiple, mc, min_cap,
-                              caps, rpads)
+                              cap_growth, caps, rpads)
     if nb < 0:
         # out-of-range row ids: defer to the numpy path so behavior is
         # identical with and without a toolchain
@@ -218,7 +220,7 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
     vals_out = np.empty(total_elems, dtype=np.float32)
     mask_out = np.empty(total_elems, dtype=np.float32)
     rc = lib.pio_fill_buckets(rows, cols, vals, n, n_rows, row_multiple,
-                              mc, min_cap, nb, caps, rpads,
+                              mc, min_cap, cap_growth, nb, caps, rpads,
                               rows_out, cols_out, vals_out, mask_out)
     if rc != 0:
         log.warning("native: fill/plan disagreement (rc=%d) — fallback", rc)

@@ -19,26 +19,43 @@
 // Build: g++ -O3 -shared -fPIC (see native/__init__.py; no deps).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <vector>
 
 namespace {
 
-int64_t pow2_cap(int64_t count, int64_t min_cap, int64_t max_cap) {
+// Cap ladder: min_cap, then ceil(prev*growth/8)*8 — growth 2.0 reproduces
+// the round-1 power-of-two caps exactly; smaller growth (e.g. 1.5) trades
+// more bucket shapes (compile time) for less padding in the gather
+// (measured 1.08x epoch at 2M rank-64, BASELINE.md). The arithmetic is
+// IEEE double, identical to the numpy path's — bit-identical caps.
+std::vector<int64_t> build_ladder(int64_t max_count, int64_t min_cap,
+                                  double growth) {
+    std::vector<int64_t> ladder{min_cap};
+    while (ladder.back() < max_count) {
+        int64_t next =
+            static_cast<int64_t>(std::ceil(ladder.back() * growth / 8.0)) * 8;
+        if (next <= ladder.back()) next = ladder.back() + 8;
+        ladder.push_back(next);
+    }
+    return ladder;
+}
+
+int64_t ladder_cap(const std::vector<int64_t>& ladder, int64_t count,
+                   int64_t max_cap) {
     int64_t c = count < 1 ? 1 : count;
-    int64_t cap = 1;
-    while (cap < c) cap <<= 1;
-    if (cap < min_cap) cap = min_cap;
+    auto it = std::lower_bound(ladder.begin(), ladder.end(), c);
+    int64_t cap = it == ladder.end() ? ladder.back() : *it;
     if (max_cap > 0 && cap > max_cap) cap = max_cap;
     return cap;
 }
 
-// caps are powers of two in [min_cap, 2^62]: index by trailing-zero count
-constexpr int kMaxCapSlots = 63;
-
 struct Plan {
     std::vector<int64_t> counts;        // per row id, truncated to max_cap
+    std::vector<int64_t> ladder;        // cap ladder (growth-dependent)
     std::vector<int64_t> caps;          // distinct caps ascending
     std::vector<int64_t> rpads;         // padded row count per bucket
     std::vector<int64_t> nrows_real;    // real rows per bucket
@@ -49,36 +66,32 @@ struct Plan {
 // (keeps behavior identical with and without a toolchain)
 bool build_plan(const int32_t* rows, int64_t n, int32_t n_rows,
                 int64_t row_multiple, int64_t max_cap, int64_t min_cap,
-                Plan& plan) {
+                double growth, Plan& plan) {
     plan.counts.assign(static_cast<size_t>(n_rows) + 1, 0);
+    int64_t max_count = 1;
     for (int64_t k = 0; k < n; ++k) {
         int32_t r = rows[k];
         if (r < 0 || r >= n_rows) return false;
         plan.counts[r] += 1;
     }
-    int64_t rows_per_cap[kMaxCapSlots] = {0};
+    for (int32_t r = 0; r < n_rows; ++r) {
+        if (max_cap > 0 && plan.counts[r] > max_cap) plan.counts[r] = max_cap;
+        if (plan.counts[r] > max_count) max_count = plan.counts[r];
+    }
+    plan.ladder = build_ladder(max_count, min_cap, growth);
+    std::map<int64_t, int64_t> rows_per_cap;  // ordered: caps ascending
     for (int32_t r = 0; r < n_rows; ++r) {
         if (plan.counts[r] == 0) continue;
-        if (max_cap > 0 && plan.counts[r] > max_cap) plan.counts[r] = max_cap;
-        int64_t cap = pow2_cap(plan.counts[r], min_cap, max_cap);
-        int slot = 0;
-        while ((int64_t(1) << slot) < cap) ++slot;
-        rows_per_cap[slot] += 1;
+        rows_per_cap[ladder_cap(plan.ladder, plan.counts[r], max_cap)] += 1;
     }
     plan.caps.clear();
     plan.rpads.clear();
     plan.nrows_real.clear();
-    for (int slot = 0; slot < kMaxCapSlots; ++slot) {
-        if (rows_per_cap[slot] == 0) continue;
-        int64_t r = rows_per_cap[slot];
+    for (const auto& kv : rows_per_cap) {
+        int64_t r = kv.second;
         int64_t rm = row_multiple > 0 ? row_multiple : 1;
-        int64_t rpad = ((r + rm - 1) / rm) * rm;
-        int64_t cap = int64_t(1) << slot;
-        // a non-power-of-two max_cap clamps the top bucket's width (the
-        // Python path's caps = min(pow2, max_cap))
-        if (max_cap > 0 && cap > max_cap) cap = max_cap;
-        plan.caps.push_back(cap);
-        plan.rpads.push_back(rpad);
+        plan.caps.push_back(kv.first);
+        plan.rpads.push_back(((r + rm - 1) / rm) * rm);
         plan.nrows_real.push_back(r);
     }
     return true;
@@ -93,11 +106,16 @@ extern "C" {
 // (each sized >= 63).
 int64_t pio_plan_buckets(const int32_t* rows, int64_t n, int32_t n_rows,
                          int64_t row_multiple, int64_t max_cap,
-                         int64_t min_cap, int64_t* out_caps,
+                         int64_t min_cap, double growth, int64_t* out_caps,
                          int64_t* out_rpads) {
     Plan plan;
-    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, plan))
+    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, growth,
+                    plan))
         return -1;
+    // the caller allocates 63-slot output buffers (the old power-of-two
+    // bound); a small growth factor on heavy-tailed data can exceed that
+    // — bail to the numpy path rather than write past the buffers
+    if (plan.caps.size() > 63) return -1;
     for (size_t b = 0; b < plan.caps.size(); ++b) {
         out_caps[b] = plan.caps[b];
         out_rpads[b] = plan.rpads[b];
@@ -114,32 +132,33 @@ int64_t pio_plan_buckets(const int32_t* rows, int64_t n, int32_t n_rows,
 int64_t pio_fill_buckets(const int32_t* rows, const int32_t* cols,
                          const float* vals, int64_t n, int32_t n_rows,
                          int64_t row_multiple, int64_t max_cap,
-                         int64_t min_cap, int64_t n_buckets,
+                         int64_t min_cap, double growth, int64_t n_buckets,
                          const int64_t* caps, const int64_t* rpads,
                          int32_t* rows_out, int32_t* cols_out,
                          float* vals_out, float* mask_out) {
     Plan plan;
-    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, plan))
+    if (!build_plan(rows, n, n_rows, row_multiple, max_cap, min_cap, growth,
+                    plan))
         return -1;
     if (static_cast<int64_t>(plan.caps.size()) != n_buckets) return -1;
     for (int64_t b = 0; b < n_buckets; ++b) {
         if (plan.caps[b] != caps[b] || plan.rpads[b] != rpads[b]) return -1;
     }
 
-    // bucket index per cap slot + flat offsets
-    int64_t bucket_of_slot[kMaxCapSlots];
-    for (int s = 0; s < kMaxCapSlots; ++s) bucket_of_slot[s] = -1;
+    // flat offsets; bucket lookup is by cap value (caps ascending)
     std::vector<int64_t> row_off(n_buckets), elem_off(n_buckets);
     int64_t ro = 0, eo = 0;
     for (int64_t b = 0; b < n_buckets; ++b) {
-        int slot = 0;
-        while ((int64_t(1) << slot) < caps[b]) ++slot;
-        bucket_of_slot[slot] = b;
         row_off[b] = ro;
         elem_off[b] = eo;
         ro += rpads[b];
         eo += rpads[b] * caps[b];
     }
+    auto bucket_of_cap = [&](int64_t cap) -> int64_t {
+        auto it = std::lower_bound(plan.caps.begin(), plan.caps.end(), cap);
+        if (it == plan.caps.end() || *it != cap) return -1;
+        return static_cast<int64_t>(it - plan.caps.begin());
+    };
 
     // sentinel-fill rows_out; zero the element buffers
     for (int64_t i = 0; i < ro; ++i) rows_out[i] = n_rows;
@@ -153,10 +172,8 @@ int64_t pio_fill_buckets(const int32_t* rows, const int32_t* cols,
     std::vector<int64_t> row_bucket(static_cast<size_t>(n_rows), -1);
     for (int32_t r = 0; r < n_rows; ++r) {
         if (plan.counts[r] == 0) continue;
-        int64_t cap = pow2_cap(plan.counts[r], min_cap, max_cap);
-        int slot = 0;
-        while ((int64_t(1) << slot) < cap) ++slot;
-        int64_t b = bucket_of_slot[slot];
+        int64_t b = bucket_of_cap(
+            ladder_cap(plan.ladder, plan.counts[r], max_cap));
         if (b < 0) return -1;
         row_bucket[r] = b;
         row_slot[r] = next_slot[b]++;

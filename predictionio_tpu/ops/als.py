@@ -57,6 +57,25 @@ class Bucket:
         return self.cols.shape[1]
 
 
+def cap_ladder(max_count: int, min_cap: int, growth: float) -> np.ndarray:
+    """Bucket capacity ladder: min_cap, then ceil(prev·growth/8)·8.
+    growth=2.0 reproduces the power-of-two caps exactly; smaller growth
+    (1.5 default) trades more bucket shapes (compile time) for less
+    padding in the gather — measured 1.08× epoch at 2M rank-64
+    (BASELINE.md). Mirrored bit-identically in native/pio_native.cpp."""
+    import math
+
+    if growth <= 1.0:
+        raise ValueError(f"cap_growth must be > 1.0, got {growth}")
+    ladder = [min_cap]
+    while ladder[-1] < max_count:
+        nxt = int(math.ceil(ladder[-1] * growth / 8.0)) * 8
+        if nxt <= ladder[-1]:
+            nxt = ladder[-1] + 8
+        ladder.append(nxt)
+    return np.asarray(ladder, dtype=np.int64)
+
+
 def bucket_ragged(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -64,6 +83,7 @@ def bucket_ragged(
     n_rows: int,
     row_multiple: int = 8,
     max_cap: Optional[int] = None,
+    cap_growth: float = 1.5,
 ) -> list[Bucket]:
     """COO triplets → per-row padded buckets, bucketed by nnz.
 
@@ -71,6 +91,7 @@ def bucket_ragged(
     `row_multiple` pads each bucket's row count (use mesh data-axis size ×
     8 so shards stay tile-aligned). `max_cap` truncates pathological rows
     (keeping the most recent entries is the caller's job; default no cap).
+    `cap_growth` sets the capacity ladder (see `cap_ladder`).
 
     The hot path runs in the native C++ loader (native/pio_native.cpp,
     bit-identical output) when a toolchain is available; PIO_NATIVE=0 or
@@ -79,7 +100,8 @@ def bucket_ragged(
     from predictionio_tpu import native as _native
 
     nb = _native.bucket_ragged_native(rows, cols, vals, n_rows,
-                                      row_multiple, max_cap, MIN_CAP)
+                                      row_multiple, max_cap, MIN_CAP,
+                                      cap_growth)
     if nb is not None:
         return nb
     rows = np.asarray(rows, dtype=np.int32)
@@ -89,10 +111,12 @@ def bucket_ragged(
     rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
     uniq, start, counts = np.unique(rows_s, return_index=True, return_counts=True)
 
-    caps = np.maximum(MIN_CAP, 2 ** np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64))
+    if max_cap is not None:
+        counts = np.minimum(counts, max_cap)
+    ladder = cap_ladder(int(counts.max(initial=1)), MIN_CAP, cap_growth)
+    caps = ladder[np.searchsorted(ladder, np.maximum(counts, 1))]
     if max_cap is not None:
         caps = np.minimum(caps, max_cap)
-        counts = np.minimum(counts, max_cap)
 
     buckets: list[Bucket] = []
     for cap in np.unique(caps):
@@ -128,6 +152,7 @@ def bucket_ragged_split(
     n_rows: int,
     row_multiple: int = 8,
     split_cap: Optional[int] = None,
+    cap_growth: float = 1.5,
 ) -> tuple[list[Bucket], np.ndarray]:
     """`bucket_ragged`, but rows with more than `split_cap` entries are
     **split into segments** instead of padding the whole matrix out to the
@@ -146,13 +171,15 @@ def bucket_ragged_split(
     id of split-table slot u (empty array when nothing was split).
     """
     if split_cap is None or len(rows) == 0:
-        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple),
+        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple,
+                              cap_growth=cap_growth),
                 np.zeros(0, np.int32))
     rows = np.asarray(rows, dtype=np.int32)
     counts = np.bincount(rows, minlength=n_rows)
     hot = np.nonzero(counts > split_cap)[0].astype(np.int32)
     if hot.size == 0:
-        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple),
+        return (bucket_ragged(rows, cols, vals, n_rows, row_multiple,
+                              cap_growth=cap_growth),
                 np.zeros(0, np.int32))
 
     cols = np.asarray(cols, dtype=np.int32)
@@ -178,7 +205,8 @@ def bucket_ragged_split(
                       + seg[idx_hot]).astype(np.int32)
     n_rows_eff = int(n_rows + nseg.sum())
 
-    buckets = bucket_ragged(rows2, cols_s, vals_s, n_rows_eff, row_multiple)
+    buckets = bucket_ragged(rows2, cols_s, vals_s, n_rows_eff, row_multiple,
+                            cap_growth=cap_growth)
 
     # map pseudo ids back: real row ids + segmap into the split table
     pseudo_to_slot = np.repeat(hot_slot[hot], nseg).astype(np.int32)
@@ -235,6 +263,11 @@ class ALSConfig:
     # (bucket_ragged_split): bounds the dense tile width a hot row can
     # force on its bucket. Power of two; 0 disables splitting.
     split_cap: int = 32768
+    # bucket capacity ladder growth factor (cap_ladder): 2.0 = round-1
+    # power-of-two caps; the 1.5 default pads ~13% fewer entries into the
+    # gather for ~1.08x epoch at 2M rank-64 (BASELINE.md), at the cost of
+    # ~50% more bucket shapes to compile
+    cap_growth: float = 1.5
     # Pallas mode for the SOLVER kernel (ops/pallas_solve.py):
     # "auto"/"off"/"on" are equivalent today (the GJ solver is selected via
     # `solver`); "interpret" runs it in interpreter mode on any backend
@@ -647,9 +680,11 @@ def als_train(
 
     split_cap = cfg.split_cap if cfg.split_cap > 0 else None
     user_buckets, u_split = bucket_ragged_split(
-        user_idx, item_idx, ratings, n_users, row_multiple, split_cap)
+        user_idx, item_idx, ratings, n_users, row_multiple, split_cap,
+        cap_growth=cfg.cap_growth)
     item_buckets, i_split = bucket_ragged_split(
-        item_idx, user_idx, ratings, n_items, row_multiple, split_cap)
+        item_idx, user_idx, ratings, n_items, row_multiple, split_cap,
+        cap_growth=cfg.cap_growth)
     log.info(
         "als_train: %d ratings, %d users (%d buckets, caps %s, %d split), "
         "%d items (%d buckets, caps %s, %d split), rank %d, mesh %s",

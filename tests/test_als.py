@@ -71,13 +71,16 @@ class TestBucketing:
             pad = b.rows >= 50
             assert b.mask[pad].sum() == 0
 
-    def test_power_of_two_caps(self):
+    def test_cap_ladder(self):
         rows = np.asarray([0] * 3 + [1] * 9 + [2] * 17, dtype=np.int32)
         cols = np.arange(29, dtype=np.int32)
         vals = np.ones(29, dtype=np.float32)
+        # growth 2.0 = round-1 power-of-two caps
+        buckets = bucket_ragged(rows, cols, vals, n_rows=3, cap_growth=2.0)
+        assert sorted(b.cap for b in buckets) == [8, 16, 32]
+        # default 1.5 ladder: 8, 16, 24, ... (each ceil(prev*1.5/8)*8)
         buckets = bucket_ragged(rows, cols, vals, n_rows=3)
-        caps = sorted(b.cap for b in buckets)
-        assert caps == [8, 16, 32]  # 3→8 (min), 9→16, 17→32
+        assert sorted(b.cap for b in buckets) == [8, 16, 24]
 
     def test_max_cap_truncates(self):
         rows = np.zeros(100, dtype=np.int32)

@@ -9,14 +9,16 @@ from predictionio_tpu import native
 from predictionio_tpu.ops import als
 
 
-def _python_buckets(rows, cols, vals, n_rows, row_multiple=8, max_cap=None):
+def _python_buckets(rows, cols, vals, n_rows, row_multiple=8, max_cap=None,
+                    cap_growth=1.5):
     """Force the numpy path regardless of native availability."""
     import unittest.mock as mock
 
     with mock.patch.object(native, "bucket_ragged_native",
                            return_value=None):
         return als.bucket_ragged(rows, cols, vals, n_rows,
-                                 row_multiple, max_cap)
+                                 row_multiple, max_cap,
+                                 cap_growth=cap_growth)
 
 
 needs_native = pytest.mark.skipif(not native.native_available(),
@@ -89,8 +91,11 @@ class TestNativeBucketize:
         vals = np.ones(37, np.float32)
         py = _python_buckets(rows, cols, vals, 1)
         nat = native.bucket_ragged_native(rows, cols, vals, 1)
-        assert len(nat) == 1 and nat[0].cap == 64
+        assert len(nat) == 1 and nat[0].cap == 40  # 1.5 ladder: 8,16,24,40
         np.testing.assert_array_equal(py[0].cols, nat[0].cols)
+        nat2 = native.bucket_ragged_native(rows, cols, vals, 1,
+                                           cap_growth=2.0)
+        assert nat2[0].cap == 64  # pow2 ladder
 
     def test_als_train_uses_native_and_converges(self):
         # end-to-end: als_train with the native loader reaches the same
@@ -116,3 +121,22 @@ class TestFallback:
         assert native.bucket_ragged_native(
             np.zeros(1, np.int32), np.zeros(1, np.int32),
             np.ones(1, np.float32), 1) is None
+
+
+@needs_native
+class TestCapGrowthParity:
+    """The C++ ladder must match numpy bit-for-bit at every growth."""
+
+    @pytest.mark.parametrize("growth", [2.0, 1.5, 1.25])
+    def test_ladder_parity(self, growth):
+        rows, cols, vals = synth(5000, 300, 200, seed=11, zipf=True)
+        py = _python_buckets(rows, cols, vals, 300, cap_growth=growth)
+        nat = native.bucket_ragged_native(rows, cols, vals, 300,
+                                          cap_growth=growth)
+        assert nat is not None
+        assert len(py) == len(nat)
+        for pb, nb in zip(py, nat):
+            np.testing.assert_array_equal(pb.rows, nb.rows)
+            np.testing.assert_array_equal(pb.cols, nb.cols)
+            np.testing.assert_array_equal(pb.vals, nb.vals)
+            np.testing.assert_array_equal(pb.mask, nb.mask)

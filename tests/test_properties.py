@@ -104,8 +104,10 @@ def test_bucketizer_roundtrip_and_sorted(data, split_cap):
         # no real row exceeds split_cap entries
         assert b.mask.sum(axis=1).max(initial=0) <= max(
             split_cap, 1 << (split_cap - 1).bit_length())
-        # caps are powers of two
-        assert b.cap & (b.cap - 1) == 0
+        # caps sit on the capacity ladder (default growth 1.5)
+        from predictionio_tpu.ops.als import MIN_CAP, cap_ladder
+
+        assert b.cap in cap_ladder(b.cap, MIN_CAP, 1.5)
     # split table lists exactly the rows whose count exceeds split_cap
     counts = np.bincount(rows, minlength=n_rows) if len(rows) else \
         np.zeros(n_rows, int)
