@@ -81,11 +81,17 @@ class WorkflowContext:
     def mesh(self) -> "jax.sharding.Mesh":
         """The device mesh, built on first use (SURVEY.md §2.6/§2.7: axes
         `data` and `model` are the two parallelism dimensions PredictionIO
-        capability parity needs)."""
-        if self._mesh is None:
-            from predictionio_tpu.parallel.mesh import make_mesh
+        capability parity needs).
 
-            self._mesh = make_mesh(self.mesh_shape)
+        Shape resolution: the explicit `mesh_shape` (the `--mesh` flag),
+        else `PIO_MESH_SHAPE` (the pod-level env contract in
+        parallel/distributed.py — how config 5's data×model shape reaches
+        `pio train` without per-command flags), else all devices on
+        `data`."""
+        if self._mesh is None:
+            from predictionio_tpu.parallel.distributed import global_mesh
+
+            self._mesh = global_mesh(self.mesh_shape)
         return self._mesh
 
     def rng(self, salt: int = 0) -> "jax.Array":

@@ -81,13 +81,15 @@ def initialize_from_env() -> bool:
 
 def global_mesh(mesh_shape: Optional[dict[str, int]] = None):
     """Build the global (all-hosts) mesh; shape from PIO_MESH_SHAPE or all
-    devices on the data axis."""
-    from predictionio_tpu.parallel.mesh import make_mesh
+    devices on the data axis. THE mesh-shape resolution — WorkflowContext
+    delegates here so the env contract lives in one place."""
+    from predictionio_tpu.parallel.mesh import _apply_platform_override, make_mesh
 
     if mesh_shape is None:
         spec = os.environ.get("PIO_MESH_SHAPE")
         if spec:
             mesh_shape = parse_mesh_shape(spec)
+    _apply_platform_override()
     import jax
 
     return make_mesh(mesh_shape, devices=jax.devices())
