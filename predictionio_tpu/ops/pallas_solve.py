@@ -9,13 +9,32 @@ triangular solves). A batched CG solver is worse still (1.5–2.8 s/epoch
 vs 1.07 s): its matvecs re-read the [R, K, K] Gram from HBM every
 iteration.
 
-This kernel instead runs Gauss-Jordan elimination on the *augmented*
-matrix [A | b], vectorized over the batch: a [R_tile, K, K+1] block of
-systems is reduced with K data-independent steps of elementwise VPU work
-(pivot row/column selection via one-hot iota masks, elimination as one
-fused FMA+select pass), so throughput scales with the batch instead of
-the sequential critical path of one factorization. When the elimination
-finishes, A has become I and the augmented column holds x.
+Three kernel layouts, all Gauss-Jordan reductions driven by
+data-independent steps of elementwise VPU work (pivot selection via
+one-hot iota masks, elimination as one fused FMA+select pass), vectorized
+over the batch so throughput scales with the batch instead of the
+sequential critical path of one factorization. Round-3 device-time A/B
+(docs/performance.md) settled which runs when — "auto" picks per rank:
+
+- ``aug`` (round 1; the rank-64 winner): ROW-based GJ on the augmented
+  [R_tile, K, K+1→lane-padded] block; b rides as the last column.
+
+- ``packed``: COLUMN-based GJ on M = [[A], [bᵀ]] with b carried as an
+  extra SUBLANE row. A is symmetric, so reducing A to I by column
+  operations turns the b row into bᵀA⁻¹ = xᵀ. Removing the augmented
+  column from the LANE dim frees it for packing G = ⌊128/K⌋ (≤4) systems
+  per 128-lane block. The ROADMAP r2 #1 hypothesis (rank-64 lane padding
+  = 50% waste → pack 2 systems → 1.3–1.6×) was REFUTED on device time:
+  0.77× at rank 64 — the per-group pivot reductions cost more than the
+  padding they recover. It wins only where the augmented column spills
+  into a whole extra 128-lane tile: rank 128 (256→128 lanes, 1.05×),
+  which "auto" selects.
+
+- ``blocked2``: two pivots per step via an explicit 2×2 pivot-block
+  inverse, testing the latency-bound hypothesis (half the sequential
+  steps, ~8% more elementwise work). Also refuted: 0.89×/0.71× at rank
+  64/128 — the kernel is throughput-bound at what Mosaic achieves, so
+  extra ops cost proportionally and shorter chains buy nothing.
 
 Mosaic lessons baked in (round-1 findings, kept so nobody re-learns them):
 - dynamic slices/stores on the sublane/lane dims miscompile silently
@@ -30,7 +49,7 @@ Gauss-Jordan does ~2·K³ useful FLOPs per system (vs Cholesky's K³/3) but
 they are perfectly batch-parallel VPU FMAs instead of a sequential
 custom-call — measured 3.4× faster than the Cholesky path at rank 64 on
 v5e (110 ms → 32 ms on a [12664, 64, 64] batch; BASELINE.md). No
-pivoting: A = YᵀWY + λ(n)I is SPD with strictly
+pivoting: A = YᵀWY + λ(n)I is SPD (hence symmetric) with strictly
 positive diagonal, the same assumption MLlib's dppsv Cholesky makes.
 All-zero systems (bucket padding rows) short-circuit to x = 0 via the
 pivot guard.
@@ -44,22 +63,33 @@ TPU-native equivalent of that native layer.
 from __future__ import annotations
 
 import functools
+import os
 
 # VMEM budget for blocks in flight: pipelined input blocks + the scratch
-# working copy + x (≈4 augmented blocks of slack). Sets the batch tile.
+# working copy + x (≈4 blocks of slack). Sets the batch tile.
 _VMEM_BUDGET = 12 * 1024 * 1024
 _LANES = 128
+_SUBLANES = 8
 _MAX_RANK = 256
+_MAX_GROUPS = 4
 
 
 def _lane_pad(n: int) -> int:
     return -(-n // _LANES) * _LANES
 
 
-def _row_tile(k: int) -> int:
-    """Batch tile (multiple of 8, ≤128) sized so ~4 augmented blocks fit."""
-    per_row = k * _lane_pad(k + 1) * 4
-    t = _VMEM_BUDGET // (4 * per_row)
+def _sub_pad(n: int) -> int:
+    return -(-n // _SUBLANES) * _SUBLANES
+
+
+def _groups(k: int) -> int:
+    """Systems per 128-lane block in the packed layout."""
+    return max(1, min(_MAX_GROUPS, _LANES // k))
+
+
+def _row_tile(per_row_bytes: int, budget: int = _VMEM_BUDGET) -> int:
+    """Batch tile (multiple of 8, ≤128) sized so ~4 blocks fit in VMEM."""
+    t = budget // (4 * per_row_bytes)
     return max(8, min(128, t // 8 * 8))
 
 
@@ -68,7 +98,157 @@ def gj_applicable(rank: int) -> bool:
 
 
 @functools.lru_cache(maxsize=32)
-def _build_solver(k: int, r_tile: int, n_tiles: int, interpret: bool):
+def _build_solver_packed(k: int, g: int, sp: int, lanes: int, r_tile: int,
+                         n_tiles: int, interpret: bool):
+    """Column-GJ on [R_tile, sp, lanes] blocks holding g systems each.
+
+    Block layout: sublane i < k = row i of A for every packed system;
+    sublane k = bᵀ; lanes [s·k, (s+1)·k) = system s's columns. After k
+    column-elimination steps A → I and the b row holds xᵀ (A symmetric).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(m_ref, x_ref, scr):
+        scr[:] = m_ref[:]
+        sub = jax.lax.broadcasted_iota(jnp.int32, (1, sp, 1), 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, lanes), 2)
+        # static per-group lane masks; `low` = lanes of groups < g
+        # (prefix regions for the one-extra-reduce group combine)
+        gmask = [(lane >= s * k) & (lane < (s + 1) * k) for s in range(g)]
+
+        def group_broadcast(vals):
+            """Per-group lane sum of `vals`, broadcast back to every lane
+            of its group (prefix sums: g-1 extra masked reduces)."""
+            if g == 1:
+                return jnp.sum(vals, axis=2, keepdims=True) \
+                    * jnp.ones_like(vals)
+            pref = [jnp.sum(jnp.where(lane < s * k, vals, 0.0), axis=2,
+                            keepdims=True) for s in range(1, g)]
+            pref.append(jnp.sum(vals, axis=2, keepdims=True))
+            out = jnp.zeros_like(vals)
+            prev = 0.0
+            for s in range(g):
+                out = jnp.where(gmask[s], pref[s] - prev, out)
+                prev = pref[s]
+            return out
+
+        def step(j, _):
+            m = scr[:]
+            # one pivot lane per packed system
+            piv = gmask[0] & (lane == j)
+            for s in range(1, g):
+                piv = piv | (gmask[s] & (lane == s * k + j))
+            p = group_broadcast(jnp.where(piv, m, 0.0))
+            # f = row j of M (per lane c: M[j, c]); its pivot-lane entry
+            # is the pivot d = M[j, j] — recovered from f, not from a
+            # second full-block reduce
+            f = jnp.sum(jnp.where(sub == j, m, 0.0), axis=1, keepdims=True)
+            d = group_broadcast(jnp.where(piv, f, 0.0))
+            # all-zero (padding) systems: guard the pivot so they solve
+            # to x = 0 instead of poisoning the tile with inf/NaN
+            d = jnp.where(jnp.abs(d) < 1e-30, 1.0, d)
+            pn = p / d
+            # pivot columns become the normalized column; every other
+            # column eliminates its row-j entry
+            scr[:] = jnp.where(piv, pn, m - pn * f)
+            return 0
+
+        jax.lax.fori_loop(0, k, step, 0, unroll=False)
+        # xᵀ = the b row after elimination, one segment per system
+        is_b = jax.lax.broadcasted_iota(jnp.int32, (1, sp, 1), 1) == k
+        x_ref[:] = jnp.sum(jnp.where(is_b, scr[:], 0.0), axis=1)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((r_tile, sp, lanes), lambda t: (t, 0, 0))],
+        out_specs=pl.BlockSpec((r_tile, lanes), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * r_tile, lanes),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r_tile, sp, lanes), jnp.float32)],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_solver_blocked2(k: int, r_tile: int, n_tiles: int,
+                           interpret: bool):
+    """Row-GJ on the augmented layout, TWO pivots per step via an explicit
+    2×2 pivot-block inverse (k must be even).
+
+    Built to TEST the latency-bound hypothesis (K sequential steps of
+    chained masked reductions → halve the chain for ~8% more elementwise
+    work). The hypothesis was REFUTED: 0.89×/0.71× vs single-pivot at
+    rank 64/128 on device time (docs/performance.md round-3 A/B) — the
+    kernel is VPU-throughput-bound. Kept selectable for re-measurement on
+    future hardware/Mosaic generations.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kp = _lane_pad(k + 1)
+
+    def kernel(aug_ref, x_ref, scr):
+        scr[:] = aug_ref[:]
+        sub = jax.lax.broadcasted_iota(jnp.int32, (1, k, 1), 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, kp), 2)
+
+        def step(s, _):
+            j0 = 2 * s
+            j1 = j0 + 1
+            a = scr[:]  # [R, K, KP]
+            r0m = sub == j0
+            r1m = sub == j1
+            c0m = lane == j0
+            c1m = lane == j1
+            row0 = jnp.sum(jnp.where(r0m, a, 0.0), axis=1, keepdims=True)
+            row1 = jnp.sum(jnp.where(r1m, a, 0.0), axis=1, keepdims=True)
+            # 2×2 pivot block P = [[p00, p01], [p10, p11]]
+            p00 = jnp.sum(jnp.where(c0m, row0, 0.0), axis=2, keepdims=True)
+            p01 = jnp.sum(jnp.where(c1m, row0, 0.0), axis=2, keepdims=True)
+            p10 = jnp.sum(jnp.where(c0m, row1, 0.0), axis=2, keepdims=True)
+            p11 = jnp.sum(jnp.where(c1m, row1, 0.0), axis=2, keepdims=True)
+            det = p00 * p11 - p01 * p10
+            # padding systems arrive all-zero: solve to x = 0. A zero
+            # diagonal pivot with a live off-diagonal cannot happen for
+            # SPD A (leading principal minors are positive).
+            det = jnp.where(jnp.abs(det) < 1e-30, 1.0, det)
+            # normalized pivot rows: P⁻¹ @ [row0; row1]
+            n0 = (p11 * row0 - p01 * row1) / det
+            n1 = (p00 * row1 - p10 * row0) / det
+            col0 = jnp.sum(jnp.where(c0m, a, 0.0), axis=2, keepdims=True)
+            col1 = jnp.sum(jnp.where(c1m, a, 0.0), axis=2, keepdims=True)
+            pivm = r0m | r1m
+            col0 = jnp.where(pivm, 0.0, col0)
+            col1 = jnp.where(pivm, 0.0, col1)
+            upd = a - col0 * n0 - col1 * n1
+            scr[:] = jnp.where(r0m, n0, jnp.where(r1m, n1, upd))
+            return 0
+
+        jax.lax.fori_loop(0, k // 2, step, 0, unroll=False)
+        is_b = lane == k
+        x_ref[:] = jnp.sum(jnp.where(is_b, scr[:], 0.0), axis=2)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((r_tile, k, kp), lambda g: (g, 0, 0))],
+        out_specs=pl.BlockSpec((r_tile, k), lambda g: (g, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * r_tile, k), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r_tile, k, kp), jnp.float32)],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_solver_aug(k: int, r_tile: int, n_tiles: int, interpret: bool):
+    """Row-GJ on augmented [R_tile, K, lane_pad(K+1)] blocks (round-1
+    layout, kept for on-chip A/B against the packed kernel)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -89,20 +269,15 @@ def _build_solver(k: int, r_tile: int, n_tiles: int, interpret: bool):
                           keepdims=True)  # [R, 1, KP] pivot row
             d = jnp.sum(jnp.where(is_col, row, 0.0), axis=2,
                         keepdims=True)  # [R, 1, 1] pivot
-            # all-zero (padding) systems: guard the pivot so they solve
-            # to x = 0 instead of poisoning the tile with inf/NaN
             d = jnp.where(jnp.abs(d) < 1e-30, 1.0, d)
             row = row / d
             col = jnp.sum(jnp.where(is_col, a, 0.0), axis=2,
                           keepdims=True)  # [R, K, 1] pivot column
-            # row j eliminates every *other* row; storing the scaled
-            # pivot row rides the same select pass
             col = jnp.where(is_row, 0.0, col)
             scr[:] = jnp.where(is_row, row, a - col * row)
             return 0
 
         jax.lax.fori_loop(0, k, step, 0, unroll=False)
-        # x = the augmented column, folded back to [R, K] (K on lanes)
         is_b = lane == k
         x_ref[:] = jnp.sum(jnp.where(is_b, scr[:], 0.0), axis=2)
 
@@ -117,22 +292,76 @@ def _build_solver(k: int, r_tile: int, n_tiles: int, interpret: bool):
     )
 
 
-def gj_solve(a, b, interpret: bool = False):
-    """Solve x = A⁻¹ b for a batch of SPD systems.
-
-    a: [R, K, K] f32 — SPD (λ-regularized normal equations); all-zero
-       systems (bucket padding rows) yield x = 0.
-    b: [R, K] f32
-    returns x: [R, K] f32
-    """
+def _solve_packed(a, b, interpret: bool):
     import jax.numpy as jnp
 
     r, k, _ = a.shape
-    r_tile = _row_tile(k)
-    r_pad = -(-r // r_tile) * r_tile
+    g = _groups(k)
+    lanes = _lane_pad(g * k)
+    sp = _sub_pad(k + 1)
+    # tighter budget than the aug layout: the taller block (+x out block)
+    # tripped the 16 MB scoped-vmem ceiling at the 12 MB/4-block sizing
+    r_tile = _row_tile(sp * lanes * 4, budget=10 * 1024 * 1024)
+    rg = -(-r // g)  # packed row-groups needed
+    rg_pad = -(-rg // r_tile) * r_tile
+
+    m = jnp.concatenate(
+        [a.astype(jnp.float32), b.astype(jnp.float32)[:, None, :]], axis=1)
+    m = jnp.pad(m, ((0, rg_pad * g - r), (0, sp - (k + 1)), (0, 0)))
+    # [rg, g, sp, k] → [rg, sp, g·k]: consecutive systems share a block
+    m = (m.reshape(rg_pad, g, sp, k).transpose(0, 2, 1, 3)
+         .reshape(rg_pad, sp, g * k))
+    m = jnp.pad(m, ((0, 0), (0, 0), (0, lanes - g * k)))
+    x = _build_solver_packed(k, g, sp, lanes, r_tile, rg_pad // r_tile,
+                             interpret)(m)
+    x = x[:, :g * k].reshape(rg_pad * g, k)
+    return x[:r]
+
+
+def _solve_aug(a, b, interpret: bool, blocked: bool = False):
+    import jax.numpy as jnp
+
+    r, k, _ = a.shape
     kp = _lane_pad(k + 1)
+    r_tile = _row_tile(k * kp * 4)
+    r_pad = -(-r // r_tile) * r_tile
     aug = jnp.concatenate(
         [a.astype(jnp.float32), b.astype(jnp.float32)[..., None]], axis=-1)
     aug = jnp.pad(aug, ((0, r_pad - r), (0, 0), (0, kp - (k + 1))))
-    x = _build_solver(k, r_tile, r_pad // r_tile, interpret)(aug)
+    build = _build_solver_blocked2 if blocked else _build_solver_aug
+    x = build(k, r_tile, r_pad // r_tile, interpret)(aug)
     return x[:r]
+
+
+def gj_solve(a, b, interpret: bool = False, layout: str = ""):
+    """Solve x = A⁻¹ b for a batch of SPD systems.
+
+    a: [R, K, K] f32 — SPD, hence symmetric (λ-regularized normal
+       equations; the packed layout's column elimination relies on the
+       symmetry); all-zero systems (bucket padding rows) yield x = 0.
+    b: [R, K] f32
+    layout: "auto" (default) picks "packed" exactly when the augmented
+       column would spill into an extra 128-lane tile (k a multiple of
+       128 — measured 1.05× at rank 128) and "aug" otherwise (lane
+       packing and the 2-pivot variant both LOST on device time at rank
+       64/32 — docs/performance.md round-3 table). "aug", "packed",
+       "blocked2" force a layout; PIO_GJ_LAYOUT overrides when unset.
+    returns x: [R, K] f32
+    """
+    layout = layout or os.environ.get("PIO_GJ_LAYOUT", "auto")
+    k = a.shape[1]
+    if layout == "auto":
+        layout = ("packed" if _lane_pad(k + 1) > _lane_pad(_groups(k) * k)
+                  else "aug")
+    if layout == "packed":
+        return _solve_packed(a, b, interpret)
+    if layout == "blocked2":
+        # forced layouts exist for honest A/Bs — never silently measure a
+        # different kernel than the label claims
+        if k % 2:
+            raise ValueError(f"layout='blocked2' needs even rank, got {k}")
+        return _solve_aug(a, b, interpret, blocked=True)
+    if layout != "aug":
+        raise ValueError(f"unknown gj_solve layout {layout!r} "
+                         "(want auto/aug/packed/blocked2)")
+    return _solve_aug(a, b, interpret)

@@ -55,6 +55,50 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def xplane_device_time_s(profile_dir: str) -> float:
+    """Summed on-device execution time (seconds) of every XLA module
+    dispatch recorded in `profile_dir`'s xplane capture.
+
+    The device-plane 'XLA Modules' line carries one event per executed
+    module with its on-chip duration — wall-clock minus tunnel/dispatch/
+    host time, which on this platform swings ~2× run to run (BASELINE.md
+    round-1 variance note). This is what makes committed perf records
+    window-robust (VERDICT r2 #6)."""
+    import glob
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    total_ps = 0
+    for path in glob.glob(
+            os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True):
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            if not plane.name.startswith("/device:"):
+                continue
+            for line in plane.lines:
+                if line.name == "XLA Modules":
+                    total_ps += sum(e.duration_ps for e in line.events)
+    return total_ps / 1e12
+
+
+def trace_device_time_s(fn) -> float:
+    """Run `fn()` under a fresh profiler trace; return its device time."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    d = tempfile.mkdtemp(prefix="pio_devtime_")
+    try:
+        with jax.profiler.trace(d):
+            fn()
+        return xplane_device_time_s(d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def set_debug_flags(nan_check: bool = False,
                     check_asserts: bool = False) -> None:
     """Numeric sanitizers for the train loop. `nan_check` recompiles jitted

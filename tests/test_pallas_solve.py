@@ -33,6 +33,29 @@ class TestGJSolve:
         rel = np.abs(x - ref).max() / np.abs(ref).max()
         assert rel < 1e-4, rel
 
+    @pytest.mark.parametrize("layout", ["aug", "packed", "blocked2"])
+    @pytest.mark.parametrize("r,k", [(33, 64), (9, 128), (7, 100)])
+    def test_every_layout_matches(self, layout, r, k):
+        """All three kernel layouts (docs/performance.md round-3 A/B) stay
+        numerically exact; 'auto' routing is free to change between them."""
+        rng = np.random.default_rng(4)
+        a, b = _spd_batch(rng, r, k)
+        x = np.asarray(gj_solve(jnp.asarray(a), jnp.asarray(b),
+                                interpret=True, layout=layout))
+        ref = np.linalg.solve(a, b[..., None])[..., 0]
+        rel = np.abs(x - ref).max() / np.abs(ref).max()
+        assert rel < 1e-4, (layout, rel)
+
+    def test_packed_groups_pack_small_ranks(self):
+        """Ranks ≤64 share 128-lane blocks in the packed layout; the
+        unpack must restore original system order."""
+        rng = np.random.default_rng(5)
+        a, b = _spd_batch(rng, 21, 16)
+        x = np.asarray(gj_solve(jnp.asarray(a), jnp.asarray(b),
+                                interpret=True, layout="packed"))
+        ref = np.linalg.solve(a, b[..., None])[..., 0]
+        assert np.abs(x - ref).max() / np.abs(ref).max() < 1e-4
+
     def test_all_zero_system_solves_to_zero(self):
         """Bucket padding rows arrive as A=0, b=0 and must not NaN."""
         rng = np.random.default_rng(1)
