@@ -197,23 +197,46 @@ def bench_north_star(scale: str = "20m"):
     Same-window best-of-3 methodology as the quickstart bench."""
     from predictionio_tpu.ops.als import ALSConfig, als_train
     from predictionio_tpu.quality import datasets
+    from predictionio_tpu.utils.profiling import trace_device_time_s
 
     split = datasets.synth_explicit(scale, seed=0)
     cfg = ALSConfig(rank=64, iterations=5, reg=0.05, seed=0,
                     compute_dtype="bfloat16", solver="auto")
+
+    def train(config=cfg):
+        return als_train(split.train_u, split.train_i, split.train_r,
+                         split.n_users, split.n_items, config)
+
     # warm-up compiles; the timed reps reuse the executable and the
     # device-resident buckets
-    als_train(split.train_u, split.train_i, split.train_r,
-              split.n_users, split.n_items, cfg)
+    train()
     epoch_s = min(
-        float(np.median(als_train(
-            split.train_u, split.train_i, split.train_r,
-            split.n_users, split.n_items, cfg).epoch_times))
-        for _ in range(3))
+        float(np.median(train().epoch_times)) for _ in range(3))
+    # the same run's ON-DEVICE time per epoch (xplane 'XLA Modules'):
+    # wall through the axon tunnel swings ~2× window to window
+    # (BASELINE.md round-2 1.213 s vs 0.893 s), device time doesn't —
+    # this is the window-robust number cross-round records compare on
+    # (VERDICT r2 #6). An iterations=0 trace measures the non-epoch
+    # device work (factor init modules) so it isn't booked to epochs.
+    import dataclasses
+    overhead_s = trace_device_time_s(
+        lambda: train(dataclasses.replace(cfg, iterations=0)))
+    device_epoch_s = (min(trace_device_time_s(train) for _ in range(2))
+                      - overhead_s) / cfg.iterations
+    if device_epoch_s <= 0:
+        # wrong backend or broken profiler capture: still emit the wall
+        # record (the JSON line the driver consumes) rather than discard
+        # minutes of measurement; null marks the device number as absent
+        print(f"WARNING: device trace captured no epoch time (overhead "
+              f"{overhead_s}s) — wrong backend or broken profiler capture",
+              file=sys.stderr)
+        device_epoch_s = None
     print(json.dumps({
         "metric": f"als_epoch_time_ml{scale}_rank64",
         "value": round(epoch_s, 3),
         "unit": "s",
+        "device_epoch_s": (None if device_epoch_s is None
+                           else round(device_epoch_s, 3)),
         "vs_baseline": round(CPU_REF_EPOCH_S[scale] / epoch_s, 1),
         "baseline": "mllib-faithful BLAS CPU reference epoch "
                     f"({CPU_REF_EPOCH_S[scale]} s, quality/mllib_als.py)",
