@@ -69,6 +69,19 @@ class WorkflowContext:
 
         return os.path.join(self.checkpoint_dir, algo_name)
 
+    def algorithm_cache_dir(self, algo_name: str) -> Optional[str]:
+        """Per-algorithm on-disk cache directory for derived training
+        inputs (e.g. the ALS bucketize result — VERDICT r2 #5). Lives
+        under the storage basedir so re-running `pio train` in a fresh
+        process hits it; PIO_BUCKET_CACHE=0 disables."""
+        import os
+
+        from predictionio_tpu.utils.fs import fs_basedir
+
+        if os.environ.get("PIO_BUCKET_CACHE", "1") == "0":
+            return None
+        return os.path.join(fs_basedir(), "cache", algo_name)
+
     @property
     def storage(self):
         if self._storage is None:

@@ -32,9 +32,9 @@ _lib_failed = False
 
 
 def _build_dir() -> str:
-    base = os.environ.get(
-        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_tpu"))
-    return os.path.join(base, "native")
+    from predictionio_tpu.utils.fs import fs_basedir
+
+    return os.path.join(fs_basedir(), "native")
 
 
 def _compile() -> Optional[str]:

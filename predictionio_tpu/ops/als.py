@@ -286,6 +286,118 @@ class ALSConfig:
 _CHUNK_BUDGET_BYTES = 1 << 30
 
 
+_BUCKET_CACHE_VERSION = 1
+
+
+def _bucket_cache_keep() -> int:
+    """Fingerprints retained per cache dir. The dir is shared by every
+    ALS-family template on the host, so hosts alternating more than this
+    many distinct datasets thrash back to full rebucketizes — raise
+    PIO_BUCKET_CACHE_KEEP if that's your workload (each 20M-scale entry
+    is ~0.5 GB on disk, hence a bound at all)."""
+    import os
+
+    return max(1, int(os.environ.get("PIO_BUCKET_CACHE_KEEP", "4")))
+
+
+def _arrays_digest(*arrays, extra: str = "") -> str:
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(extra.encode())
+    return h.hexdigest()
+
+
+def _bucket_cache_save(cache_dir: str, key: str,
+                       user_buckets: list, u_split: np.ndarray,
+                       item_buckets: list, i_split: np.ndarray) -> None:
+    """Persist both sides' buckets as one npz, atomically (tmp+rename —
+    a crashed writer leaves no half-written cache), then GC old
+    fingerprints by mtime."""
+    import os
+    import tempfile
+
+    arrays: dict[str, np.ndarray] = {"u_split": u_split, "i_split": i_split}
+    for side, buckets in (("u", user_buckets), ("i", item_buckets)):
+        for n, b in enumerate(buckets):
+            arrays[f"{side}{n}_rows"] = b.rows
+            arrays[f"{side}{n}_cols"] = b.cols
+            arrays[f"{side}{n}_vals"] = b.vals
+            arrays[f"{side}{n}_mask"] = b.mask
+            if b.segmap is not None:
+                arrays[f"{side}{n}_segmap"] = b.segmap
+    os.makedirs(cache_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)  # uncompressed: load speed is the point
+        os.replace(tmp, os.path.join(cache_dir, f"{key}.npz"))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    import time
+
+    entries = []
+    for e in os.scandir(cache_dir):
+        try:  # a concurrent rank's GC may unlink between scandir and stat
+            mtime = e.stat().st_mtime
+        except OSError:
+            continue
+        if e.name.endswith(".npz"):
+            entries.append((mtime, e.path))
+        elif e.name.endswith(".tmp") and mtime < time.time() - 3600:
+            # a SIGKILLed writer's orphan; anything this old is dead
+            # (live writers hold their tmp for seconds)
+            try:
+                os.unlink(e.path)
+            except OSError:
+                pass
+    entries.sort(reverse=True)
+    for _, stale in entries[_bucket_cache_keep():]:
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+
+
+def _bucket_cache_load(cache_dir: str, key: str):
+    """(user_buckets, u_split, item_buckets, i_split) or None on miss."""
+    import os
+
+    import zipfile
+
+    path = os.path.join(cache_dir, f"{key}.npz")
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            sides = []
+            for side in ("u", "i"):
+                buckets = []
+                n = 0
+                while f"{side}{n}_rows" in z:
+                    buckets.append(Bucket(
+                        rows=z[f"{side}{n}_rows"],
+                        cols=z[f"{side}{n}_cols"],
+                        vals=z[f"{side}{n}_vals"],
+                        mask=z[f"{side}{n}_mask"],
+                        segmap=(z[f"{side}{n}_segmap"]
+                                if f"{side}{n}_segmap" in z else None),
+                    ))
+                    n += 1
+                sides.append(buckets)
+            os.utime(path)  # freshen for the keep-newest GC
+            return sides[0], z["u_split"], sides[1], z["i_split"]
+    except (OSError, ValueError, KeyError, EOFError,
+            zipfile.BadZipFile) as e:
+        log.warning("bucket cache at %s unreadable (%s) — rebucketing",
+                    path, e)
+        return None
+
+
 def _bucket_chunk_rows(r: int, c: int, k: int, row_multiple: int) -> int:
     """Rows per chunk for a [r, c] bucket at rank k (== r when no chunking
     is needed). Multiple of row_multiple so shards stay tile-aligned."""
@@ -598,6 +710,7 @@ def als_train(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 1,
     resume: bool = True,
+    bucket_cache_dir: Optional[str] = None,
 ) -> ALSResult:
     """Train ALS factors from COO ratings.
 
@@ -612,6 +725,12 @@ def als_train(
     Checkpointing chunks the single-dispatch scan into
     `checkpoint_every`-sized dispatches; with it off the whole run stays
     one dispatch.
+
+    bucket_cache_dir: when set, the host bucketize result is cached on
+    disk under a fingerprint of the training data + every bucketizer
+    input (VERDICT r2 #5 — bucketize is ~14 s of a 20M `pio train` and
+    identical across re-trains on unchanged events); new events or a
+    changed mesh/splitCap/cap_growth miss and rebucketize.
     """
     import jax
     import jax.numpy as jnp
@@ -679,12 +798,53 @@ def als_train(
             cfg = dataclasses.replace(cfg, solver="chol")
 
     split_cap = cfg.split_cap if cfg.split_cap > 0 else None
-    user_buckets, u_split = bucket_ragged_split(
-        user_idx, item_idx, ratings, n_users, row_multiple, split_cap,
-        cap_growth=cfg.cap_growth)
-    item_buckets, i_split = bucket_ragged_split(
-        item_idx, user_idx, ratings, n_items, row_multiple, split_cap,
-        cap_growth=cfg.cap_growth)
+
+    # hash the (large) training arrays at most once per train; both the
+    # bucket-cache key and the checkpoint fingerprint derive from it
+    _digest_memo: list[str] = []
+
+    def data_digest() -> str:
+        if not _digest_memo:
+            _digest_memo.append(_arrays_digest(user_idx, item_idx, ratings))
+        return _digest_memo[0]
+
+    cached = None
+    bucket_key = None
+    if bucket_cache_dir:
+        import hashlib
+
+        # fingerprint = training data + every input the bucketizer reads;
+        # new events or a changed mesh shape / splitCap / growth miss
+        bucket_key = hashlib.blake2b(
+            (data_digest() + repr((n_users, n_items, row_multiple,
+                                   split_cap, cfg.cap_growth,
+                                   _BUCKET_CACHE_VERSION))).encode(),
+            digest_size=16).hexdigest()
+        cached = _bucket_cache_load(bucket_cache_dir, bucket_key)
+    if cached is not None:
+        user_buckets, u_split, item_buckets, i_split = cached
+        log.info("als_train: bucket cache hit %s (host bucketize skipped)",
+                 bucket_key)
+    else:
+        user_buckets, u_split = bucket_ragged_split(
+            user_idx, item_idx, ratings, n_users, row_multiple, split_cap,
+            cap_growth=cfg.cap_growth)
+        item_buckets, i_split = bucket_ragged_split(
+            item_idx, user_idx, ratings, n_items, row_multiple, split_cap,
+            cap_growth=cfg.cap_growth)
+        if bucket_cache_dir:
+            try:
+                # atomic write: concurrent ranks race safely (same bytes)
+                _bucket_cache_save(bucket_cache_dir, bucket_key,
+                                   user_buckets, u_split, item_buckets,
+                                   i_split)
+                log.info("als_train: bucket cache miss — saved %s",
+                         bucket_key)
+            except OSError as e:
+                # the cache is a pure optimization: a full/read-only disk
+                # must not fail a train that already bucketized
+                log.warning("als_train: bucket cache save failed (%s) — "
+                            "continuing uncached", e)
     log.info(
         "als_train: %d ratings, %d users (%d buckets, caps %s, %d split), "
         "%d items (%d buckets, caps %s, %d split), rank %d, mesh %s",
@@ -799,11 +959,10 @@ def als_train(
         # same dir) or a changed rank/reg/seed must retrain from scratch,
         # not return yesterday's completed factors.
         fingerprint = hashlib.blake2b(
-            np.ascontiguousarray(user_idx).tobytes()
-            + np.ascontiguousarray(item_idx).tobytes()
-            + np.ascontiguousarray(ratings).tobytes()
-            + repr((n_users, n_items, cfg.rank, cfg.reg, cfg.weighted_reg,
-                    cfg.implicit, cfg.alpha, cfg.seed, cfg.dtype)).encode(),
+            (data_digest()
+             + repr((n_users, n_items, cfg.rank, cfg.reg, cfg.weighted_reg,
+                     cfg.implicit, cfg.alpha, cfg.seed,
+                     cfg.dtype))).encode(),
             digest_size=8,
         ).hexdigest()
         manager = CheckpointManager(checkpoint_dir)

@@ -91,9 +91,9 @@ class StorageConfig:
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "StorageConfig":
         env = dict(os.environ if env is None else env)
-        default_path = env.get(
-            "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_tpu")
-        )
+        from predictionio_tpu.utils.fs import fs_basedir
+
+        default_path = fs_basedir(env)
 
         def source_for(repo: str) -> SourceConfig:
             src = env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "PIO_DEFAULT")

@@ -248,6 +248,7 @@ class ECommAlgorithm(Algorithm):
             pd.user_idx, pd.item_idx, pd.confidence,
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
             cfg=cfg, mesh=ctx.mesh,
+            bucket_cache_dir=ctx.algorithm_cache_dir("als"),
         )
         f = result.item_factors
         norms = np.linalg.norm(f, axis=1, keepdims=True)

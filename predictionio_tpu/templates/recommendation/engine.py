@@ -226,6 +226,7 @@ class ALSAlgorithm(Algorithm):
             cfg=cfg, mesh=ctx.mesh, compute_rmse=p.computeRMSE,
             checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
             checkpoint_every=ctx.checkpoint_every,
+            bucket_cache_dir=ctx.algorithm_cache_dir("als"),
         )
         # epoch_times covers only epochs executed this call (a resumed run
         # skips the first start_epoch epochs); rmse_history covers all

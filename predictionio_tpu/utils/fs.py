@@ -1,0 +1,19 @@
+"""Filesystem conventions shared across subsystems."""
+
+from __future__ import annotations
+
+import os
+
+
+def fs_basedir(env=None) -> str:
+    """THE local working directory (`PIO_FS_BASEDIR`, default
+    `~/.pio_tpu`) — the reference's `pio.home`/`PIO_FS_BASEDIR` analogue
+    («conf/pio-env.sh» [U]). Storage defaults, native build artifacts,
+    and derived-input caches all root here; resolve it only through this
+    helper so the fallback cannot drift between subsystems. `env`
+    overrides the environment consulted (the storage registry's
+    explicit-env contract)."""
+    if env is None:
+        env = os.environ
+    return env.get(
+        "PIO_FS_BASEDIR", os.path.join(os.path.expanduser("~"), ".pio_tpu"))

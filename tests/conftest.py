@@ -10,6 +10,9 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# keep in-process template trains from writing bucket caches into the
+# real ~/.pio_tpu; cache-specific tests re-enable it in subprocess envs
+os.environ["PIO_BUCKET_CACHE"] = "0"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

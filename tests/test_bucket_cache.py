@@ -1,0 +1,107 @@
+"""ALS bucket cache (VERDICT r2 #5): the host bucketize result is reused
+across trains under a fingerprint of the training data + bucketizer
+inputs, skipped on any change, and survives corruption."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSConfig, als_train
+
+
+def _data(seed=0, nnz=800, n_u=40, n_i=30):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_u, nnz).astype(np.int32),
+            rng.integers(0, n_i, nnz).astype(np.int32),
+            rng.uniform(1, 5, nnz).astype(np.float32), n_u, n_i)
+
+
+CFG = ALSConfig(rank=6, iterations=2, reg=0.05, seed=0, solver="chol",
+                split_cap=16)
+
+
+class TestBucketCache:
+    def test_hit_after_miss_and_identical_factors(self, tmp_path, caplog):
+        ui, ii, r, n_u, n_i = _data()
+        cache = str(tmp_path / "cache")
+        with caplog.at_level(logging.INFO, "predictionio_tpu.ops.als"):
+            a = als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=cache)
+            assert any("bucket cache miss" in m for m in caplog.messages)
+            caplog.clear()
+            b = als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=cache)
+            assert any("bucket cache hit" in m for m in caplog.messages)
+        np.testing.assert_array_equal(a.user_factors, b.user_factors)
+        np.testing.assert_array_equal(a.item_factors, b.item_factors)
+
+    @pytest.mark.parametrize("mutate", ["ratings", "split_cap", "growth"])
+    def test_invalidation(self, tmp_path, caplog, mutate):
+        import dataclasses
+
+        ui, ii, r, n_u, n_i = _data()
+        cache = str(tmp_path / "cache")
+        als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=cache)
+        cfg = CFG
+        if mutate == "ratings":  # one new/changed event must invalidate
+            r = r.copy()
+            r[0] += 1.0
+        elif mutate == "split_cap":
+            cfg = dataclasses.replace(CFG, split_cap=24)
+        else:
+            cfg = dataclasses.replace(CFG, cap_growth=2.0)
+        with caplog.at_level(logging.INFO, "predictionio_tpu.ops.als"):
+            als_train(ui, ii, r, n_u, n_i, cfg, bucket_cache_dir=cache)
+        assert any("bucket cache miss" in m for m in caplog.messages)
+        assert not any("bucket cache hit" in m for m in caplog.messages)
+
+    def test_corrupt_cache_rebuckets(self, tmp_path, caplog):
+        ui, ii, r, n_u, n_i = _data()
+        cache = tmp_path / "cache"
+        ref = als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=str(cache))
+        (entry,) = cache.glob("*.npz")
+        entry.write_bytes(b"not an npz")
+        with caplog.at_level(logging.WARNING, "predictionio_tpu.ops.als"):
+            out = als_train(ui, ii, r, n_u, n_i, CFG,
+                            bucket_cache_dir=str(cache))
+        assert any("unreadable" in m for m in caplog.messages)
+        np.testing.assert_array_equal(out.user_factors, ref.user_factors)
+
+    def test_truncated_zip_rebuckets(self, tmp_path, caplog):
+        """Corruption AFTER the zip magic (BadZipFile, not ValueError)
+        must also fall back instead of crashing the train."""
+        ui, ii, r, n_u, n_i = _data()
+        cache = tmp_path / "cache"
+        ref = als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=str(cache))
+        (entry,) = cache.glob("*.npz")
+        entry.write_bytes(entry.read_bytes()[:100])  # keeps PK magic
+        with caplog.at_level(logging.WARNING, "predictionio_tpu.ops.als"):
+            out = als_train(ui, ii, r, n_u, n_i, CFG,
+                            bucket_cache_dir=str(cache))
+        assert any("unreadable" in m for m in caplog.messages)
+        np.testing.assert_array_equal(out.user_factors, ref.user_factors)
+
+    def test_gc_keeps_newest(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_BUCKET_CACHE_KEEP", "2")
+        cache = tmp_path / "cache"
+        for seed in range(4):
+            ui, ii, r, n_u, n_i = _data(seed=seed)
+            als_train(ui, ii, r, n_u, n_i, CFG, bucket_cache_dir=str(cache))
+        assert len(list(cache.glob("*.npz"))) == 2
+
+    def test_mesh_shape_invalidates(self, tmp_path, caplog):
+        """row_multiple depends on the mesh axes; a cache built for one
+        mesh must not feed a differently-aligned one."""
+        import jax
+
+        from predictionio_tpu.parallel.mesh import make_mesh
+
+        ui, ii, r, n_u, n_i = _data()
+        cache = str(tmp_path / "cache")
+        m1 = make_mesh({"data": 1, "model": 1}, devices=jax.devices()[:1])
+        als_train(ui, ii, r, n_u, n_i, CFG, mesh=m1, bucket_cache_dir=cache)
+        m2 = make_mesh({"data": 4, "model": 2})
+        with caplog.at_level(logging.INFO, "predictionio_tpu.ops.als"):
+            out = als_train(ui, ii, r, n_u, n_i, CFG, mesh=m2,
+                            bucket_cache_dir=cache)
+        assert any("bucket cache miss" in m for m in caplog.messages)
+        assert np.isfinite(out.user_factors).all()

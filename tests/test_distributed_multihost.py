@@ -314,6 +314,48 @@ def test_pio_train_cli_model_axis_rank128(tmp_path):
 
 
 @pytest.mark.e2e
+def test_pio_train_bucket_cache_across_processes(tmp_path):
+    """Re-running `pio train` on unchanged events skips the host
+    bucketize via the on-disk cache under PIO_FS_BASEDIR (VERDICT r2 #5);
+    ingesting one more event invalidates it."""
+    db = tmp_path / "pio.db"
+    _seed_ratings(db, "CacheApp", 1200, 32, 24, seed=13)
+    engine_json = tmp_path / "engine.json"
+    _write_engine_json(engine_json, "CacheApp", "cache", rank=8, iters=2)
+
+    env = _train_env(db, tmp_path, 8, PIO_LOG_LEVEL="INFO",
+                     PIO_BUCKET_CACHE="1")  # conftest disables globally
+    cmd = [str(REPO / "bin" / "pio"), "train",
+           "--engine-json", str(engine_json)]
+
+    def train():
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stdout
+        return proc.stdout
+
+    assert "bucket cache miss" in train()
+    assert "bucket cache hit" in train()  # fresh process, same events
+
+    # one new event from a NEW user → the prepared COO grows a row and a
+    # user code → fingerprint changes → rebucketize
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+    backend = SQLiteBackend(str(db))
+    app_id = backend.apps().get_by_name("CacheApp").id
+    backend.events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id="99",
+               target_entity_type="item", target_entity_id="2",
+               properties=DataMap({"rating": 5.0}))], app_id=app_id)
+    backend.close()
+    out = train()
+    assert "bucket cache miss" in out and "bucket cache hit" not in out
+
+
+@pytest.mark.e2e
 def test_two_process_pio_train_model_axis(tmp_path):
     """The 2-process pod world with model>1 (VERDICT r2 #1/weak #1): two
     `bin/pio train` ranks federate into a (data=4, model=2) global mesh
