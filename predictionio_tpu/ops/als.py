@@ -33,6 +33,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from predictionio_tpu.utils import faults
+
 log = logging.getLogger(__name__)
 
 MIN_CAP = 8  # smallest bucket width (sublane-friendly)
@@ -1037,6 +1039,10 @@ def als_train(
         # (block_until_ready can return early behind the axon tunnel)
         float(item_factors[0, 0])
         done += n_steps
+        # elastic-recovery drill point (SURVEY.md §5): a rank hard-dying
+        # between a computed chunk and its checkpoint save is the worst
+        # moment for the rest of the world
+        faults.inject("als.epoch_boundary")
         if compute_rmse:
             rmse_history.extend(float(x) for x in np.asarray(rmses))
         # multi-host: all ranks restore (consistent global start state) and

@@ -20,6 +20,10 @@ Sites in the tree:
   written, before the atomic `os.replace` publishes it
 - `events.batch.pre_commit` — after a batch insert's `executemany`,
   before the transaction commits
+- `als.epoch_boundary` — between a training chunk's execution fence and
+  its checkpoint save; armed per-rank it kills one member of a
+  multi-process world at the worst moment (the elastic-recovery drill,
+  test_failure_paths.py::TestElasticRecovery)
 """
 
 from __future__ import annotations

@@ -48,8 +48,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.e2e
-def test_two_process_global_mesh(tmp_path):
+def _run_global_mesh_world(tmp_path, n_procs, dev_per_proc):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -57,14 +56,14 @@ def test_two_process_global_mesh(tmp_path):
     worker_py = tmp_path / "worker.py"
     worker_py.write_text(WORKER)
     procs = []
-    for pid in range(2):
+    for pid in range(n_procs):
         env = dict(os.environ)
         env.pop("PIO_CONF_DIR", None)
         env.update(
             PIO_JAX_PLATFORM="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={dev_per_proc}",
             PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            PIO_NUM_PROCESSES="2",
+            PIO_NUM_PROCESSES=str(n_procs),
             PIO_PROCESS_ID=str(pid),
             PIO_TEST_REPO=str(REPO),
             PIO_TEST_OUT=str(tmp_path / f"out{pid}.json"),
@@ -75,9 +74,13 @@ def test_two_process_global_mesh(tmp_path):
     outs = [p.communicate(timeout=180)[0] for p in procs]
     for p, o in zip(procs, outs):
         assert p.returncode == 0, o
+    return [json.loads((tmp_path / f"out{i}.json").read_text())
+            for i in range(n_procs)]
 
-    results = [json.loads((tmp_path / f"out{i}.json").read_text())
-               for i in range(2)]
+
+@pytest.mark.e2e
+def test_two_process_global_mesh(tmp_path):
+    results = _run_global_mesh_world(tmp_path, 2, 4)
     expected_sum = float(sum(range(16)) * 4)
     for pid, r in enumerate(results):
         assert r["pid"] == pid
@@ -86,6 +89,22 @@ def test_two_process_global_mesh(tmp_path):
         assert r["mesh"] == {"data": 8, "model": 1}
     # the two ranks fed disjoint halves of the global rows
     assert results[0]["rows"] == [0, 8] and results[1]["rows"] == [8, 16]
+
+
+@pytest.mark.e2e
+def test_four_process_global_mesh(tmp_path):
+    """4-process world (VERDICT r2 #9): bootstrap, global mesh, and
+    disjoint host row-feeding still hold past the 2-process special
+    case (coordinator + 3 remote clients)."""
+    results = _run_global_mesh_world(tmp_path, 4, 2)
+    expected_sum = float(sum(range(16)) * 4)
+    for pid, r in enumerate(results):
+        assert r["pid"] == pid
+        assert r["devices"] == 8 and r["local_devices"] == 2
+        assert r["sum"] == expected_sum
+        assert r["mesh"] == {"data": 8, "model": 1}
+    assert [r["rows"] for r in results] == [[0, 4], [4, 8], [8, 12],
+                                            [12, 16]]
 
 
 
@@ -148,36 +167,49 @@ def _train_env(db, basedir, n_local_devices, **extra):
     return env
 
 
-def _run_two_rank_train(engine_json, db, basedir, extra_env=None):
-    """Launch TWO `bin/pio train` ranks federated via PIO_COORDINATOR_*;
-    returns their outputs after asserting both exited 0."""
+def _run_world_train(engine_json, db, basedir, n_ranks=2, dev_per_rank=4,
+                     extra_env=None, faults_by_rank=None, extra_args=(),
+                     check=True, timeout=300):
+    """Launch an n-rank `bin/pio train` world federated via
+    PIO_COORDINATOR_* — THE pod-contract launcher shared with the
+    failure-path suite. `faults_by_rank` arms PIO_FAULTS on chosen ranks;
+    `check=False` returns (returncodes, outputs) without asserting."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     procs = []
-    for pid in range(2):
+    for pid in range(n_ranks):
         env = _train_env(
-            db, basedir, 4,
+            db, basedir, dev_per_rank,
             PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            PIO_NUM_PROCESSES="2",
+            PIO_NUM_PROCESSES=str(n_ranks),
             PIO_PROCESS_ID=str(pid),
             **(extra_env or {}),
         )
+        env.pop("PIO_FAULTS", None)
+        if faults_by_rank and pid in faults_by_rank:
+            env["PIO_FAULTS"] = faults_by_rank[pid]
         procs.append(subprocess.Popen(
             [str(REPO / "bin" / "pio"), "train",
-             "--engine-json", str(engine_json)],
+             "--engine-json", str(engine_json), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
-    for p, o in zip(procs, outs):
-        assert p.returncode == 0, o
-    return outs
+    if check:
+        for p, o in zip(procs, outs):
+            assert p.returncode == 0, o
+        return outs
+    return [p.returncode for p in procs], outs
+
+
+def _run_two_rank_train(engine_json, db, basedir, extra_env=None):
+    return _run_world_train(engine_json, db, basedir, extra_env=extra_env)
 
 
 @pytest.mark.e2e
@@ -208,7 +240,19 @@ def test_two_process_pio_train_cli(tmp_path):
     # worker placeholder)
     assert f"Engine instance ID: {completed[0][0]}" in outs[0]
 
-    # the persisted model must load and answer a query (single process)
+    # the persisted model must load and answer a query (single process);
+    # seen-item exclusion may leave fewer than `num` candidates — the
+    # claim is that the persisted model answers, not the exact count
+    engine, ep, models_obj = _load_completed_model(db, engine_json)
+    r = engine.predict(ep, models_obj, {"user": "1", "num": 3})
+    assert 1 <= len(r["itemScores"]) <= 3
+
+
+def _load_completed_model(db, engine_json):
+    """Load the single COMPLETED instance's persisted model back through
+    the engine; returns (engine, engine_params, model)."""
+    import sqlite3
+
     from predictionio_tpu.storage.registry import (
         SourceConfig, Storage, StorageConfig,
     )
@@ -216,19 +260,23 @@ def test_two_process_pio_train_cli(tmp_path):
         EngineVariant, extract_engine_params, get_engine,
     )
 
+    conn = sqlite3.connect(db)
+    completed = conn.execute(
+        "SELECT id FROM engine_instances WHERE status='COMPLETED'"
+    ).fetchall()
+    conn.close()
+    assert len(completed) == 1, completed
     src = SourceConfig(name="SQL", type="sqlite", path=str(db))
     storage = Storage(StorageConfig(metadata=src, modeldata=src,
                                     eventdata=src))
     try:
-        variant = EngineVariant.from_dict(json.loads(engine_json.read_text()))
+        variant = EngineVariant.from_dict(
+            json.loads(pathlib.Path(engine_json).read_text()))
         engine = get_engine(variant.engine_factory)
         ep = extract_engine_params(engine, variant)
         blob = storage.model_data_models().get(completed[0][0]).models
-        models_obj = engine.deserialize_models(blob, completed[0][0], ep)
-        r = engine.predict(ep, models_obj, {"user": "1", "num": 3})
-        # seen-item exclusion may leave fewer than `num` candidates; the
-        # claim is that the persisted model answers, not the exact count
-        assert 1 <= len(r["itemScores"]) <= 3
+        models = engine.deserialize_models(blob, completed[0][0], ep)
+        return engine, ep, models
     finally:
         storage.close()
 
@@ -381,35 +429,14 @@ def test_two_process_pio_train_model_axis(tmp_path):
         assert u_split > 0 and i_split > 0, (u_split, i_split)
 
     conn = sqlite3.connect(db)
-    completed = conn.execute(
-        "SELECT id FROM engine_instances WHERE status='COMPLETED'"
-    ).fetchall()
-    assert len(completed) == 1
     models = conn.execute("SELECT count(*) FROM models").fetchone()[0]
     assert models == 1
     conn.close()
 
     # the persisted model answers a query (single process reload)
-    from predictionio_tpu.storage.registry import (
-        SourceConfig, Storage, StorageConfig,
-    )
-    from predictionio_tpu.workflow.workflow_utils import (
-        EngineVariant, extract_engine_params, get_engine,
-    )
-
-    src = SourceConfig(name="SQL", type="sqlite", path=str(db))
-    storage = Storage(StorageConfig(metadata=src, modeldata=src,
-                                    eventdata=src))
-    try:
-        variant = EngineVariant.from_dict(json.loads(engine_json.read_text()))
-        engine = get_engine(variant.engine_factory)
-        ep = extract_engine_params(engine, variant)
-        blob = storage.model_data_models().get(completed[0][0]).models
-        models_obj = engine.deserialize_models(blob, completed[0][0], ep)
-        r = engine.predict(ep, models_obj, {"user": "1", "num": 3})
-        assert 1 <= len(r["itemScores"]) <= 3
-    finally:
-        storage.close()
+    engine, ep, models_obj = _load_completed_model(db, engine_json)
+    r = engine.predict(ep, models_obj, {"user": "1", "num": 3})
+    assert 1 <= len(r["itemScores"]) <= 3
 
 
 @pytest.mark.e2e
