@@ -61,22 +61,34 @@ class ALSModel:
     ) -> list[tuple[str, float]]:
         """Top-num (item id, score) for a user («recommendProducts» [U]).
         Unknown user → empty list (the reference's template behavior)."""
-        row = self.user_ids.get(user)
-        if row is None:
-            return []
+        return self.recommend_products_batch([user], num, exclude_seen)[0]
+
+    def recommend_products_batch(
+        self, users: list, num: int, exclude_seen: bool = True
+    ) -> list[list[tuple[str, float]]]:
+        """Top-num recommendations for MANY users in one scoring call —
+        the bulk path `pio batchpredict` rides. Past
+        `ranking.SERVE_HOST_MAX_BATCH` users this takes the accelerator
+        branch of `recommend_topk` (one [B, n_items] device dispatch)
+        instead of B host matvecs; unknown users get []."""
+        out: list[list[tuple[str, float]]] = [[] for _ in users]
+        known = [(pos, row) for pos, row in
+                 ((pos, self.user_ids.get(str(u))) for pos, u in
+                  enumerate(users)) if row is not None]
+        if not known or num <= 0:
+            return out
+        ids = np.asarray([row for _, row in known], dtype=np.int32)
         exclude = None
         if exclude_seen and self.seen:
-            exclude = {int(row): self.seen.get(int(row), np.empty(0, np.int32))}
+            exclude = {int(row): self.seen.get(int(row),
+                                               np.empty(0, np.int32))
+                       for row in set(ids.tolist())}
         scores, idx = ranking.recommend_topk(
-            self.user_factors, self.item_factors,
-            np.asarray([row], dtype=np.int32), num, exclude,
-        )
+            self.user_factors, self.item_factors, ids, num, exclude)
         inv = self.item_ids.inverse()
-        out = []
-        for s, i in zip(scores[0], idx[0]):
-            if not np.isfinite(s):
-                continue  # fewer than num unseen items exist
-            out.append((inv[int(i)], float(s)))
+        for (pos, _), s_row, i_row in zip(known, scores, idx):
+            out[pos] = [(inv[int(i)], float(s))
+                        for s, i in zip(s_row, i_row) if np.isfinite(s)]
         return out
 
     def similar_products(

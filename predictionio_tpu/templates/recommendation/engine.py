@@ -252,6 +252,25 @@ class ALSAlgorithm(Algorithm):
         recs = model.recommend_products(str(query["user"]), num)
         return {"itemScores": [{"item": i, "score": s} for i, s in recs]}
 
+    def batch_predict(self, model: ALSModel, queries) -> list[PredictedResult]:
+        """Bulk scoring («pio batchpredict» / evaluation): one vectorized
+        top-k over every query's user instead of the base class's
+        per-query predict loop — large batches ride the accelerator
+        branch of ops/ranking.py (VERDICT r2 #4)."""
+        by_num: dict[int, list[int]] = {}
+        for pos, q in enumerate(queries):
+            by_num.setdefault(int(q.get("num", 10)), []).append(pos)
+        out: list[PredictedResult] = [None] * len(queries)  # type: ignore
+        for num, idxs in by_num.items():
+            # group by num so one outlier query can't force every other
+            # query onto its (larger) top-k
+            recs = model.recommend_products_batch(
+                [queries[i]["user"] for i in idxs], num)
+            for i, r in zip(idxs, recs):
+                out[i] = {"itemScores": [{"item": item, "score": s}
+                                         for item, s in r]}
+        return out
+
 
 class RecommendationEngine(EngineFactory):
     def apply(self) -> Engine:
