@@ -51,6 +51,25 @@ def synth_ml100k():
     return ui, ii, r
 
 
+def _make_source(storage_spec: str, tmpdir):
+    """Shared --storage spec parsing: memory | sqlite | sqlite:///path |
+    postgres://... ("sqlite" without a path lands in tmpdir)."""
+    from predictionio_tpu.storage.registry import SourceConfig
+
+    if storage_spec == "memory":
+        return SourceConfig(name="BENCH", type="memory")
+    if storage_spec == "sqlite":
+        return SourceConfig(name="BENCH", type="sqlite",
+                            path=os.path.join(tmpdir, "bench.db"))
+    if storage_spec.startswith("sqlite:///"):
+        return SourceConfig(name="BENCH", type="sqlite",
+                            path=storage_spec[len("sqlite:///"):])
+    if storage_spec.startswith(("postgres://", "postgresql://")):
+        return SourceConfig(name="BENCH", type="postgres",
+                            path=storage_spec)
+    raise SystemExit(f"unsupported --storage spec: {storage_spec!r}")
+
+
 def bench_serving(storage_spec: str = "memory"):
     """Predict QPS + p50 through the real prediction-server HTTP stack
     (BASELINE.json tracked metrics). Full loop: events → train via the
@@ -78,15 +97,9 @@ def bench_serving(storage_spec: str = "memory"):
     )
     from predictionio_tpu.workflow.create_workflow import run_train
 
-    if storage_spec == "memory":
-        src = SourceConfig(name="BENCH", type="memory")
-    elif storage_spec.startswith("sqlite:///"):
-        src = SourceConfig(name="BENCH", type="sqlite",
-                           path=storage_spec[len("sqlite:///"):])
-    elif storage_spec.startswith(("postgres://", "postgresql://")):
-        src = SourceConfig(name="BENCH", type="postgres", path=storage_spec)
-    else:
-        raise SystemExit(f"unsupported --storage spec: {storage_spec!r}")
+    import tempfile as _tf
+
+    src = _make_source(storage_spec, _tf.mkdtemp(prefix="pio_bench_"))
     storage = Storage(StorageConfig(metadata=src, modeldata=src, eventdata=src))
     Storage.reset(storage)
     app_id = storage.meta_apps().insert(App(id=0, name="BenchApp"))
@@ -188,6 +201,112 @@ def bench_serving(storage_spec: str = "memory"):
         "p95_ms": round(p95 * 1e3, 2),
         "concurrency": n_threads,
         "storage": storage_spec,
+        "vs_baseline": None,
+    }))
+
+
+def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
+                 n_threads: int = 8, batch_size: int = 50):
+    """Concurrent front-door ingest (VERDICT r2 #7): N keep-alive clients
+    against the REAL event server's `/events.json` (one event per POST)
+    and `/batch/events.json` (`batch_size` events per POST), on SQLite by
+    default — the single-writer backend whose behavior under write
+    concurrency was unknown. Prints one JSON line with both modes."""
+    import http.client
+    import statistics
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="pio_ingest_bench_")
+    src = _make_source(storage_spec or "sqlite", tmp)
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    Storage.reset(storage)
+    app_id = storage.meta_apps().insert(App(id=0, name="IngestApp"))
+    key = "bench-ingest-key"
+    storage.meta_access_keys().insert(
+        AccessKey(key=key, app_id=app_id, events=[]))
+    server = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    server.start()
+    port = server.port
+
+    def one_event(i):
+        return {"event": "rate", "entityType": "user",
+                "entityId": str(i % 997),
+                "targetEntityType": "item", "targetEntityId": str(i % 101),
+                "properties": {"rating": float(i % 5 + 1)}}
+
+    results = {}
+    for mode, path, payload_of in (
+        ("single", f"/events.json?accessKey={key}",
+         lambda i: json.dumps(one_event(i)).encode()),
+        ("batch", f"/batch/events.json?accessKey={key}",
+         lambda i: json.dumps([one_event(i * batch_size + j)
+                               for j in range(batch_size)]).encode()),
+    ):
+        stop = threading.Event()
+        lat_all: list[list[float]] = []
+        errors: list[BaseException] = []
+
+        def client(lat_out, payload_of=payload_of, path=path):
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                j = 0
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    conn.request("POST", path, payload_of(j),
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status not in (200, 201):
+                        raise RuntimeError(
+                            f"HTTP {resp.status}: {body[:200]!r}")
+                    lat_out.append(time.perf_counter() - t0)
+                    j += 1
+                conn.close()
+            except BaseException as e:
+                errors.append(e)
+                stop.set()
+
+        threads = []
+        for _ in range(n_threads):
+            lat: list[float] = []
+            lat_all.append(lat)
+            threads.append(threading.Thread(target=client, args=(lat,)))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise SystemExit(f"ingest bench ({mode}) failed: {errors[0]}")
+        lat = sorted(x for la in lat_all for x in la)
+        per_req = 1 if mode == "single" else batch_size
+        results[mode] = {
+            "events_per_s": round(len(lat) * per_req / wall, 1),
+            "p50_ms": round(statistics.median(lat) * 1e3, 2),
+            "p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 2),
+        }
+    server.shutdown()
+    storage.close()
+    Storage.reset(None)
+    print(json.dumps({
+        "metric": "event_ingest_events_per_s",
+        "value": results["batch"]["events_per_s"],
+        "unit": "events/s",
+        "single": results["single"],
+        "batch": {**results["batch"], "batch_size": batch_size},
+        "concurrency": n_threads,
+        "storage": storage_spec or "sqlite",
         "vs_baseline": None,
     }))
 
@@ -368,9 +487,13 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--serving", action="store_true",
                     help="predict QPS/p50 through the HTTP stack")
-    ap.add_argument("--storage", default="memory",
-                    help="serving-bench store: memory | sqlite:///path | "
-                         "postgres://...")
+    ap.add_argument("--storage", default=None,
+                    help="backing store: memory | sqlite | sqlite:///path"
+                         " | postgres://... (default: memory for "
+                         "--serving, sqlite for --ingest)")
+    ap.add_argument("--ingest", action="store_true",
+                    help="concurrent event-server ingest events/s "
+                         "(single + batch POSTs)")
     ap.add_argument("--batchpredict", action="store_true",
                     help="bulk scoring qps at ML-20M model scale through "
                          "pio batchpredict (device top-k branch)")
@@ -380,7 +503,9 @@ if __name__ == "__main__":
                     default="20m", help="north-star dataset scale")
     args = ap.parse_args()
     if args.serving:
-        bench_serving(args.storage)
+        bench_serving(args.storage or "memory")
+    elif args.ingest:
+        bench_ingest(args.storage or "sqlite")
     elif args.batchpredict:
         bench_batch_predict()
     elif args.quickstart:

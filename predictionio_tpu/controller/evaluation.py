@@ -102,9 +102,8 @@ class MetricEvaluator:
         metrics = evaluation.all_metrics()
         primary = metrics[0]
         all_results: list[MetricScores] = []
-        # stateful metrics (e.g. AUC) buffer between calculate and
-        # aggregate; an aborted fold must not leak its partial buffer
-        # into a later evaluation that reuses the metric instance
+        # defensive: drop any buffered state a custom stateful metric may
+        # carry between evaluations (the built-in zoo is stateless)
         for metric in metrics:
             metric.reset()
         for i, ep in enumerate(engine_params_list):
@@ -113,10 +112,7 @@ class MetricEvaluator:
             fold_results = engine.eval(ctx, ep)
             per_fold: list[dict[str, float]] = []
             for _, qpa in fold_results:
-                fold_scores = {}
-                for metric in metrics:
-                    scores = [metric.calculate(q, p, a) for q, p, a in qpa]
-                    fold_scores[metric.name] = metric.aggregate(scores)
+                fold_scores = {m.name: m.evaluate_all(qpa) for m in metrics}
                 per_fold.append(fold_scores)
             # a fold where a metric is undefined (NaN — e.g. AUC on a
             # one-class test split) must not poison the candidate's mean:

@@ -23,7 +23,9 @@ class Metric(abc.ABC, Generic[Q, R, A]):
 
     @abc.abstractmethod
     def calculate(self, query: Q, predicted: R, actual: A) -> Optional[float]:
-        """Score one evaluation point. None = excluded (OptionAverage)."""
+        """Score one evaluation point. None = excluded (OptionAverage) —
+        set-level metrics with no per-point score (AUC) return None here
+        and override `evaluate_all` instead."""
 
     def aggregate(self, scores: Sequence[Optional[float]]) -> float:
         """Combine per-point scores into the metric value."""
@@ -32,14 +34,23 @@ class Metric(abc.ABC, Generic[Q, R, A]):
             return float("nan")
         return sum(vals) / len(vals)
 
+    def evaluate_all(self, qpa: Sequence[tuple[Q, R, A]]) -> float:
+        """Metric value over one fold's (query, predicted, actual)
+        points — THE evaluator entry point. The default is the per-point
+        calculate → aggregate pipeline; SET-level statistics (AUC)
+        override this directly, so they need no buffered state between
+        calls (interleaved folds cannot mix)."""
+        return self.aggregate([self.calculate(q, p, a) for q, p, a in qpa])
+
     @property
     def name(self) -> str:
         return type(self).__name__
 
     def reset(self) -> None:
-        """Drop any buffered evaluation state. No-op for the stateless
-        default; stateful metrics (AUC) override — the evaluator calls it
-        before each run so an aborted fold can't leak into the next."""
+        """Drop any buffered evaluation state. The built-in zoo is
+        stateless (a no-op); a custom metric that buffers between calls
+        can override — the evaluator calls it before each run so an
+        aborted evaluation can't leak into the next."""
 
     def compare(self, a: float, b: float) -> int:
         """>0 if a better than b."""
@@ -87,37 +98,35 @@ class AUC(Metric[Any, dict, dict]):
     «BinaryClassificationMetrics.areaUnderROC» role [U] — MLlib computes
     it outside the Metric zoo; here it joins the zoo).
 
-    The Metric contract routes one float per (query, predicted, actual)
-    through `calculate` and hands the list to `aggregate`, but AUC is a
-    set-level statistic over (score, label) pairs — so `calculate`
-    buffers the pair internally and returns None, and `aggregate`
-    computes the rank-based AUC (Mann-Whitney U with tie correction)
-    over the buffered fold and clears it. This fits the evaluator's
-    per-fold calculate-all-then-aggregate call pattern exactly
-    (MetricEvaluator.evaluate); interleaving two folds' calculate calls
-    without an intervening aggregate would mix them.
+    AUC is a SET-level statistic over (score, label) pairs — no per-point
+    score exists, so `calculate` returns None (the Optional contract's
+    "excluded" value, harmless to per-point consumers) and the real
+    computation lives in `evaluate_all` (rank-based AUC, Mann-Whitney U
+    with tie correction). Stateless: nothing buffers between calls, so
+    interleaved or aborted folds cannot mix (ADVICE r2 #4).
 
     `predicted[score_key]` is the engine's score; `actual[label_key]`
-    must be 0/1 (or truthy/falsy). `reset()` drops a partially-buffered
-    fold (call it if an evaluation aborted mid-fold and the instance is
-    reused — aggregate() also clears, so completed folds never leak).
+    must be 0/1 (or truthy/falsy).
     """
 
     def __init__(self, score_key: str = "score", label_key: str = "label"):
         self.score_key = score_key
         self.label_key = label_key
-        self._pairs: list[tuple[float, int]] = []
-
-    def reset(self) -> None:
-        self._pairs = []
 
     def calculate(self, query, predicted, actual) -> Optional[float]:
-        self._pairs.append((float(predicted[self.score_key]),
-                            1 if actual[self.label_key] else 0))
-        return None
+        return None  # no per-point AUC; see evaluate_all
 
     def aggregate(self, scores: Sequence[Optional[float]]) -> float:
-        pairs, self._pairs = self._pairs, []
+        """Loud failure for callers on the per-point protocol: silently
+        averaging calculate()'s Nones would make the metric quietly
+        vanish as NaN."""
+        raise TypeError("AUC is a set-level metric with no per-point "
+                        "scores; call evaluate_all(qpa) instead of "
+                        "calculate/aggregate")
+
+    def evaluate_all(self, qpa) -> float:
+        pairs = [(float(p[self.score_key]), 1 if a[self.label_key] else 0)
+                 for _, p, a in qpa]
         n_pos = sum(label for _, label in pairs)
         n_neg = len(pairs) - n_pos
         if n_pos == 0 or n_neg == 0:

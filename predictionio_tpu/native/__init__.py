@@ -24,7 +24,8 @@ log = logging.getLogger(__name__)
 
 _SRCS = [
     os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
-    for name in ("pio_native.cpp", "pio_scan.cpp", "pio_import.cpp")
+    for name in ("pio_native.cpp", "pio_scan.cpp", "pio_import.cpp",
+                 "pio_export.cpp")
 ]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -121,6 +122,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             llp, llp, ctypes.POINTER(llp), llp, llp]
         lib.pio_import_free_lines.restype = None
         lib.pio_import_free_lines.argtypes = [llp]
+        lib.pio_export_events.restype = ctypes.c_int
+        lib.pio_export_events.argtypes = [
+            cstr, cstr, ctypes.c_longlong, ctypes.c_longlong, llp]
+        lib.pio_export_error.restype = ctypes.c_char_p
+        lib.pio_export_error.argtypes = []
         _lib = lib
         return _lib
 
@@ -276,14 +282,14 @@ def import_events_native(json_path: str, db_path: str, app_id: int,
     if rc == 6:
         # committed rows are durable; the fallback-line list could not be
         # allocated, so those lines were NOT imported and cannot be
-        # pinpointed — surface loudly rather than silently redoing (a redo
-        # would duplicate the committed rows)
-        log.error(
-            "native import: %d line(s) were not imported and their "
-            "positions were lost (allocation failure); the other %d events "
-            "are committed. Re-import those lines from the source file.",
-            n_fb.value, imported.value)
-        return imported.value, skipped.value, [], 0
+        # pinpointed. Raise (→ `pio import` exits nonzero) instead of
+        # returning clean-looking counts with data silently missing; a
+        # silent redo would duplicate the committed rows (ADVICE r2 #1).
+        raise RuntimeError(
+            f"native import: {n_fb.value} line(s) were not imported and "
+            f"their positions were lost (allocation failure); the other "
+            f"{imported.value} events ARE committed. Free memory and "
+            f"re-import the missing lines from the source file.")
     if rc != 0:
         log.warning("native import: rc=%d — using the Python path", rc)
         return None
@@ -293,3 +299,24 @@ def import_events_native(json_path: str, db_path: str, app_id: int,
         if n_fb.value:
             lib.pio_import_free_lines(lines_p)
     return imported.value, skipped.value, fallback, resume.value
+
+
+def export_events_native(db_path: str, out_path: str, app_id: int,
+                         channel_id) -> Optional[int]:
+    """Sqlite event rows → JSON-lines file via the C++ writer
+    (pio_export.cpp), byte-identical to the Python exporter for rows this
+    framework wrote. Returns the exported count, or None when the native
+    path is unavailable or bailed (all-or-nothing: a failed run removes
+    its partial output and the caller re-exports through Python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    count = ctypes.c_longlong(0)
+    rc = lib.pio_export_events(
+        db_path.encode(), out_path.encode(), app_id,
+        -1 if channel_id is None else channel_id, ctypes.byref(count))
+    if rc != 0:
+        log.warning("native export: rc=%d (%s) — using the Python path",
+                    rc, lib.pio_export_error().decode(errors="replace"))
+        return None
+    return count.value

@@ -711,8 +711,27 @@ bool id_to_string(const JValue& v, std::string& out, bool required) {
   return false;
 }
 
+// Per-line "now" stamping: the Python path stamps datetime.now() per
+// event, so stamped times are distinct and ORDER BY event_time,
+// creation_time stays stable. Advancing one microsecond per line keeps
+// that property; the formatted string is cached per distinct value so
+// lines with both times present pay nothing (ADVICE r2 #2).
+struct Stamper {
+  long long base_us;
+  long long cached_us = -1;
+  std::string cached;
+  const std::string& at(long long lineno) {
+    long long v = base_us + lineno;
+    if (v != cached_us) {
+      cached_us = v;
+      format_utc(v, cached);
+    }
+    return cached;
+  }
+};
+
 LineResult process_line(const char* line, size_t len, Rng& rng,
-                        const std::string& now_iso, Row& row) {
+                        Stamper& stamp, long long lineno, Row& row) {
   row = Row();  // the caller reuses one Row across lines
   Parser ps(line, len);
   JValue root;
@@ -816,7 +835,7 @@ LineResult process_line(const char* line, size_t len, Rng& rng,
     if (!parse_iso_utc(v_et->s, us)) return kFallback;
     format_utc(us, row.etime);
   } else {
-    row.etime = now_iso;
+    row.etime = stamp.at(lineno);
   }
   const JValue* v_ct = find(root, "creationTime");
   if (v_ct && v_ct->kind != JValue::Null && !is_falsy(*v_ct)) {
@@ -825,7 +844,7 @@ LineResult process_line(const char* line, size_t len, Rng& rng,
     if (!parse_iso_utc(v_ct->s, us)) return kFallback;
     format_utc(us, row.ctime);
   } else {
-    row.ctime = now_iso;
+    row.ctime = stamp.at(lineno);
   }
 
   char hex[33];
@@ -884,8 +903,7 @@ int pio_import_file(const char* json_path, const char* db_path,
     clock_gettime(CLOCK_REALTIME, &ts);
     now_us = (long long)ts.tv_sec * 1000000LL + ts.tv_nsec / 1000;
   }
-  std::string now_iso;
-  format_utc(now_us, now_iso);
+  Stamper stamp{now_us};
 
   // Fresh-table fast path: when the events table is empty (initial bulk
   // load — the quickstart/benchmark case), drop the secondary indexes and
@@ -904,10 +922,15 @@ int pio_import_file(const char* json_path, const char* db_path,
     }
     if (empty) {
       sqlite3_stmt* ix = nullptr;
+      // only the _SCHEMA-owned idx_events_* indexes: a crash between
+      // drop and rebuild is healed by the next backend init's
+      // IF NOT EXISTS DDL for those, while a user-created index dropped
+      // here would be lost forever (ADVICE r2 #3)
       if (g_api.prepare(db,
                         "SELECT name, sql FROM sqlite_master WHERE "
                         "type='index' AND tbl_name='events' AND sql IS "
-                        "NOT NULL",
+                        "NOT NULL AND name LIKE 'idx\\_events\\_%' "
+                        "ESCAPE '\\'",
                         -1, &ix, nullptr) == kSqliteOk) {
         std::vector<std::string> names;
         while (g_api.step(ix) == 100) {
@@ -959,7 +982,7 @@ int pio_import_file(const char* json_path, const char* db_path,
 
     LineResult r;
     try {
-      r = process_line(line + off, len - off, rng, now_iso, row);
+      r = process_line(line + off, len - off, rng, stamp, lineno, row);
     } catch (const std::bad_alloc&) {
       hard_fail = true;
       break;

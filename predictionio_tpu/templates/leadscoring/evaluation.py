@@ -44,7 +44,7 @@ class LeadScoringEvaluation(Evaluation, RegGridGenerator):
     def __init__(self):
         import os
 
-        self.metric = AUC()  # per-instance: AUC buffers state across folds
+        self.metric = AUC()
         RegGridGenerator.__init__(
             self, os.environ.get("PIO_EVAL_APP_NAME", "MyApp1"),
             eval_k=int(os.environ.get("PIO_EVAL_K", "3")))

@@ -259,7 +259,7 @@ def cmd_import(args) -> int:
 
     try:
         imported, skipped = file_to_events(args.input, args.appname, args.channel)
-    except (ValueError, OSError) as e:
+    except (ValueError, OSError, RuntimeError) as e:
         print(f"Import failed: {e}", file=sys.stderr)
         return 1
     print(f"Imported {imported} events" +

@@ -103,41 +103,41 @@ class TestLeadScoring:
 
 
 class TestAUCMetric:
-    def test_auc_perfect_and_random_and_ties(self):
+    @staticmethod
+    def _auc(pairs):
         from predictionio_tpu.controller.metrics import AUC
 
-        m = AUC()
-        for s, y in [(0.9, 1), (0.8, 1), (0.2, 0), (0.1, 0)]:
-            m.calculate({}, {"score": s}, {"label": y})
-        assert m.aggregate([]) == 1.0  # perfectly separable
+        return AUC().evaluate_all(
+            [({}, {"score": s}, {"label": y}) for s, y in pairs])
 
-        for s, y in [(0.1, 1), (0.2, 1), (0.8, 0), (0.9, 0)]:
-            m.calculate({}, {"score": s}, {"label": y})
-        assert m.aggregate([]) == 0.0  # perfectly wrong
-
+    def test_auc_perfect_and_random_and_ties(self):
+        assert self._auc(
+            [(0.9, 1), (0.8, 1), (0.2, 0), (0.1, 0)]) == 1.0  # separable
+        assert self._auc(
+            [(0.1, 1), (0.2, 1), (0.8, 0), (0.9, 0)]) == 0.0  # all wrong
         # all-tied scores → AUC 0.5 via tie correction
-        for s, y in [(0.5, 1), (0.5, 0), (0.5, 1), (0.5, 0)]:
-            m.calculate({}, {"score": s}, {"label": y})
-        assert m.aggregate([]) == 0.5
-
+        assert self._auc(
+            [(0.5, 1), (0.5, 0), (0.5, 1), (0.5, 0)]) == 0.5
         # one-class fold is undefined
-        m.calculate({}, {"score": 0.7}, {"label": 1})
         import math
 
-        assert math.isnan(m.aggregate([]))
+        assert math.isnan(self._auc([(0.7, 1)]))
+
+    def test_auc_calculate_is_per_point_undefined(self):
+        """AUC has no per-point score: calculate returns None (the
+        Optional contract's excluded value), never a bogus float."""
+        from predictionio_tpu.controller.metrics import AUC
+
+        assert AUC().calculate({}, {"score": 0.9}, {"label": 1}) is None
 
     def test_auc_against_sklearn_formula(self):
         import numpy as np
 
-        from predictionio_tpu.controller.metrics import AUC
-
         rng = np.random.default_rng(0)
         scores = rng.random(200)
         labels = (rng.random(200) < 0.4).astype(int)
-        m = AUC()
-        for s, y in zip(scores, labels):
-            m.calculate({}, {"score": float(s)}, {"label": int(y)})
-        got = m.aggregate([])
+        got = self._auc([(float(s), int(y))
+                         for s, y in zip(scores, labels)])
         # reference: probability a random positive outranks a random
         # negative (ties count half)
         pos = scores[labels == 1]

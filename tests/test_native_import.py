@@ -258,3 +258,61 @@ def test_native_import_speed_sanity(tmp_path):
     assert (imported, skipped) == (n, 0)
     assert len(st.l_events().find(app_id=1, limit=n + 1)) == n
     st.close()
+
+
+def test_stamped_times_are_distinct_and_ordered(tmp_path):
+    """Events missing eventTime/creationTime get per-line 'now' stamps
+    that advance monotonically (ADVICE r2 #2) — a single shared stamp
+    would tie every such event in ORDER BY event_time, creation_time."""
+    path = tmp_path / "stamped.json"
+    with open(path, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"event": "sign-up", "entityType": "user",
+                                "entityId": f"u{i}"}) + "\n")
+    storage, app_id = _mk_storage(tmp_path / "stamped.db")
+    try:
+        imported, skipped = transfer.file_to_events(
+            str(path), "ImpApp", storage=storage)
+        assert (imported, skipped) == (50, 0)
+        conn = sqlite3.connect(tmp_path / "stamped.db")
+        times = [r[0] for r in conn.execute(
+            "SELECT event_time FROM events ORDER BY rowid").fetchall()]
+        conn.close()
+        assert len(set(times)) == 50  # all distinct
+        assert times == sorted(times)  # file order preserved
+    finally:
+        storage.close()
+
+
+def test_bulk_path_preserves_user_created_indexes(tmp_path):
+    """The fresh-table bulk load drops/rebuilds only the _SCHEMA-owned
+    idx_events_* indexes; a user-created index must survive untouched
+    (ADVICE r2 #3 — previously it was dropped and, after a crash in the
+    drop→rebuild window, lost forever)."""
+    db = tmp_path / "uidx.db"
+    storage, app_id = _mk_storage(db)
+    try:
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE INDEX user_custom_idx ON events (pr_id)")
+        conn.commit()
+        conn.close()
+        path = tmp_path / "bulk.json"
+        with open(path, "w") as f:
+            for i in range(100):
+                f.write(json.dumps(
+                    {"event": "rate", "entityType": "user",
+                     "entityId": f"u{i}", "targetEntityType": "item",
+                     "targetEntityId": "i1",
+                     "properties": {"rating": 3.0}}) + "\n")
+        imported, _ = transfer.file_to_events(str(path), "ImpApp",
+                                              storage=storage)
+        assert imported == 100
+        conn = sqlite3.connect(db)
+        names = {r[0] for r in conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='index' "
+            "AND tbl_name='events'").fetchall()}
+        conn.close()
+        assert "user_custom_idx" in names
+        assert any(n.startswith("idx_events_") for n in names)  # rebuilt
+    finally:
+        storage.close()
