@@ -292,6 +292,132 @@ def _build_solver_aug(k: int, r_tile: int, n_tiles: int, interpret: bool):
     )
 
 
+@functools.lru_cache(maxsize=32)
+def _build_solver_aug_multi(k: int, kp: int, r_tile: int, n_tiles: int,
+                            interpret: bool):
+    """Multi-RHS row-GJ: the augmented block carries M RHS columns
+    (lanes k..k+M-1) instead of one; the elimination loop is identical
+    (it already sweeps every lane), and the OUTPUT is the whole reduced
+    block — the RHS region is sliced outside the kernel, trading one
+    extra block write for zero new Mosaic surface."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(aug_ref, x_ref, scr):
+        scr[:] = aug_ref[:]
+        sub = jax.lax.broadcasted_iota(jnp.int32, (1, k, 1), 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, kp), 2)
+
+        def step(j, _):
+            a = scr[:]
+            is_row = sub == j
+            is_col = lane == j
+            row = jnp.sum(jnp.where(is_row, a, 0.0), axis=1, keepdims=True)
+            d = jnp.sum(jnp.where(is_col, row, 0.0), axis=2, keepdims=True)
+            d = jnp.where(jnp.abs(d) < 1e-30, 1.0, d)
+            row = row / d
+            col = jnp.sum(jnp.where(is_col, a, 0.0), axis=2, keepdims=True)
+            col = jnp.where(is_row, 0.0, col)
+            scr[:] = jnp.where(is_row, row, a - col * row)
+            return 0
+
+        jax.lax.fori_loop(0, k, step, 0, unroll=False)
+        x_ref[:] = scr[:]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((r_tile, k, kp), lambda g: (g, 0, 0))],
+        out_specs=pl.BlockSpec((r_tile, k, kp), lambda g: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * r_tile, k, kp),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r_tile, k, kp), jnp.float32)],
+        interpret=interpret,
+    )
+
+
+def gj_solve_multi(a, b, interpret: bool = False):
+    """X = A⁻¹ B for a batch of SPD systems with M right-hand sides.
+
+    a: [R, K, K] f32; b: [R, K, M] f32 → X: [R, K, M] f32. The building
+    block of `schur_solve`'s recursion; cost is set by lane_pad(K+M), so
+    up to 128−K RHS columns ride free next to a K-column system.
+    """
+    import jax.numpy as jnp
+
+    r, k, _ = a.shape
+    m = b.shape[2]
+    kp = _lane_pad(k + m)
+    # full-block output doubles the block traffic vs the single-RHS
+    # kernel: halve the per-block budget to stay inside scoped VMEM
+    r_tile = _row_tile(k * kp * 4, budget=6 * 1024 * 1024)
+    r_pad = -(-r // r_tile) * r_tile
+    aug = jnp.concatenate(
+        [a.astype(jnp.float32), b.astype(jnp.float32)], axis=-1)
+    aug = jnp.pad(aug, ((0, r_pad - r), (0, 0), (0, kp - (k + m))))
+    out = _build_solver_aug_multi(k, kp, r_tile, r_pad // r_tile,
+                                  interpret)(aug)
+    return out[:r, :, k:k + m]
+
+
+def schur_solve(a, b, interpret: bool = False, base: int = 32):
+    """x = A⁻¹ b via recursive Schur complements: the elimination work
+    becomes [R, K/2, K/2] batched MXU matmuls plus multi-RHS GJ kernels
+    at the `base` size.
+
+    Round-3 finding: the elementwise GJ kernel is VPU-throughput-bound
+    (docs/performance.md layout A/B), so the only way to move the solve
+    is onto the MXU — batched matmuls measured 0.63 TFLOP/s at h=32 and
+    2.26 at h=64 vs the kernel's effective 0.35. For SPD A the split
+    pivots are SPD (leading principal blocks and their Schur
+    complements), so no pivoting is needed at any level — the same
+    assumption the base kernel makes.
+
+    a: [R, K, K] f32 SPD; b: [R, K] or [R, K, M] f32.
+    """
+    import jax.numpy as jnp
+
+    single = b.ndim == 2
+    if single:
+        b = b[..., None]
+    x = _schur_rec(a, b, base, interpret)
+    return x[..., 0] if single else x
+
+
+def _schur_rec(a, b, base: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+
+    k = a.shape[1]
+    if k <= base or k % 2:
+        return gj_solve_multi(a, b, interpret)
+    h = k // 2
+
+    def mm(x, y):
+        # HIGHEST: the default TPU matmul precision multiplies in bf16,
+        # which costs ~3 decimal digits on the Schur updates (measured
+        # rel 1.8e-3 vs 3e-7) — elimination must stay full f32
+        return jnp.einsum("rij,rjk->rik", x, y,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+
+    a11 = a[:, :h, :h]
+    a12 = a[:, :h, h:]
+    a21 = a[:, h:, :h]
+    a22 = a[:, h:, h:]
+    b1, b2 = b[:, :h], b[:, h:]
+    # one base call solves A11 against [A12 | B1] together (the RHS
+    # columns ride in the same lane-padded block)
+    w = _schur_rec(a11, jnp.concatenate([a12, b1], axis=2), base, interpret)
+    w12, w1b = w[:, :, :h], w[:, :, h:]
+    s = a22 - mm(a21, w12)  # SPD Schur complement
+    y2 = _schur_rec(s, b2 - mm(a21, w1b), base, interpret)
+    y1 = w1b - mm(w12, y2)
+    return jnp.concatenate([y1, y2], axis=1)
+
+
 def _solve_packed(a, b, interpret: bool):
     import jax.numpy as jnp
 
@@ -340,19 +466,20 @@ def gj_solve(a, b, interpret: bool = False, layout: str = ""):
        equations; the packed layout's column elimination relies on the
        symmetry); all-zero systems (bucket padding rows) yield x = 0.
     b: [R, K] f32
-    layout: "auto" (default) picks "packed" exactly when the augmented
-       column would spill into an extra 128-lane tile (k a multiple of
-       128 — measured 1.05× at rank 128) and "aug" otherwise (lane
-       packing and the 2-pivot variant both LOST on device time at rank
-       64/32 — docs/performance.md round-3 table). "aug", "packed",
-       "blocked2" force a layout; PIO_GJ_LAYOUT overrides when unset.
+    layout: "auto" (default) picks "schur" for rank ≥ 96 (recursive
+       Schur over MXU matmuls — 1.49× vs the best elementwise layout at
+       rank 128) and "aug" otherwise (lane packing, 2-pivot blocking,
+       and schur all LOST at rank ≤ 64 on device time —
+       docs/performance.md round-3 tables). "aug", "packed", "blocked2",
+       "schur" force a layout; PIO_GJ_LAYOUT overrides when unset.
     returns x: [R, K] f32
     """
     layout = layout or os.environ.get("PIO_GJ_LAYOUT", "auto")
     k = a.shape[1]
     if layout == "auto":
-        layout = ("packed" if _lane_pad(k + 1) > _lane_pad(_groups(k) * k)
-                  else "aug")
+        layout = "schur" if k >= 96 else "aug"
+    if layout == "schur":
+        return schur_solve(a, b, interpret)
     if layout == "packed":
         return _solve_packed(a, b, interpret)
     if layout == "blocked2":
