@@ -40,6 +40,9 @@ def bench(k, r):
         chain(ad, bd).block_until_ready()  # compile
         best = min(trace_device_time_s(
             lambda: chain(ad, bd).block_until_ready()) for _ in range(3))
+        if best <= 0:
+            sys.exit("device trace captured nothing (no xplane protos on "
+                     "this image, or wrong backend) — A/B needs device time")
         out[layout] = best / N
         print(f"  k={k:3d} r={r} {layout:6s}: {best/N*1e3:7.2f} ms/solve (device)")
     print(f"  k={k:3d}: blocked2 vs aug {out['aug']/out['blocked2']:.2f}x, "

@@ -391,7 +391,10 @@ def _bucket_cache_load(cache_dir: str, key: str):
                     ))
                     n += 1
                 sides.append(buckets)
-            os.utime(path)  # freshen for the keep-newest GC
+            try:
+                os.utime(path)  # freshen for the keep-newest GC
+            except OSError:
+                pass  # read-only cache dir: loaded fine, just can't freshen
             return sides[0], z["u_split"], sides[1], z["i_split"]
     except (OSError, ValueError, KeyError, EOFError,
             zipfile.BadZipFile) as e:

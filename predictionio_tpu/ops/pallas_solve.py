@@ -490,5 +490,5 @@ def gj_solve(a, b, interpret: bool = False, layout: str = ""):
         return _solve_aug(a, b, interpret, blocked=True)
     if layout != "aug":
         raise ValueError(f"unknown gj_solve layout {layout!r} "
-                         "(want auto/aug/packed/blocked2)")
+                         "(want auto/aug/packed/blocked2/schur)")
     return _solve_aug(a, b, interpret)

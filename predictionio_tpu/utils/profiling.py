@@ -88,13 +88,40 @@ def xplane_device_time_s(profile_dir: str) -> float:
     return max(per_plane_ps, default=0) / 1e12
 
 
+def _xplane_parseable() -> bool:
+    """Whether the TensorFlow xplane protos needed by
+    `xplane_device_time_s` exist on this image (memoized)."""
+    global _XPLANE_OK
+    if _XPLANE_OK is None:
+        try:
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa
+            _XPLANE_OK = True
+        except ImportError:
+            _XPLANE_OK = False
+    return _XPLANE_OK
+
+
+_XPLANE_OK = None
+
+
 def trace_device_time_s(fn) -> float:
-    """Run `fn()` under a fresh profiler trace; return its device time."""
+    """Run `fn()` under a fresh profiler trace; return its device time.
+
+    Returns 0.0 WITHOUT running `fn` when the TensorFlow xplane protos are
+    absent (capture could never be parsed) — callers treat <=0 as
+    "device time unavailable" (bench_north_star emits device_epoch_s=null,
+    benchmarks/gj_layouts.py exits), so skipping the doomed trace saves
+    minutes of profiled reps on a TF-less image."""
     import shutil
     import tempfile
 
     import jax
 
+    if not _xplane_parseable():
+        import warnings
+        warnings.warn("tensorflow.tsl xplane protos unavailable — device "
+                      "time cannot be measured on this image")
+        return 0.0
     d = tempfile.mkdtemp(prefix="pio_devtime_")
     try:
         with jax.profiler.trace(d):
