@@ -453,6 +453,67 @@ def bench_north_star(scale: str = "20m"):
     }))
 
 
+def bench_eval_grid(scale: str = "2m", n_points: int = 4):
+    """Grid-batched eval A/B (VERDICT r3 #1): an `n_points` λ grid at
+    rank 64 trained as ONE device program (ops/als_grid) vs `n_points`
+    sequential `als_train` calls, same window. The done-bar: grid wall
+    ≲1.5× ONE train's wall (vs ~n_points× for sequential)."""
+    import dataclasses
+
+    from predictionio_tpu.ops.als import ALSConfig, als_train
+    from predictionio_tpu.ops.als_grid import als_train_grid
+    from predictionio_tpu.quality import datasets
+
+    split = datasets.synth_explicit(scale, seed=0)
+    base = ALSConfig(rank=64, iterations=5, reg=0.05, seed=0,
+                     compute_dtype="bfloat16", solver="auto")
+    lambdas = [0.01, 0.05, 0.1, 0.2][:n_points]
+    cfgs = [dataclasses.replace(base, reg=lam) for lam in lambdas]
+
+    def one_train(cfg):
+        return als_train(split.train_u, split.train_i, split.train_r,
+                         split.n_users, split.n_items, cfg)
+
+    def grid():
+        # host_factors=False is the eval path's contract (models stay
+        # device-resident for the device-side top-k); the sequential arm
+        # pulls factors per train because that IS its contract
+        return als_train_grid(split.train_u, split.train_i, split.train_r,
+                              split.n_users, split.n_items, cfgs,
+                              host_factors=False)
+
+    # warm every compile up front so the timed A/B compares execution
+    # only: each sequential λ compiles its own executable (reg is static
+    # in ALSConfig), while the whole grid shares one (reg is traced [G])
+    for c in cfgs:
+        one_train(c)
+    grid()
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    # same-window best-of-2, interleaved so tunnel drift hits both arms
+    one_s, grid_s, seq_s = [], [], []
+    for _ in range(2):
+        one_s.append(timed(lambda: one_train(cfgs[0])))
+        grid_s.append(timed(grid))
+        seq_s.append(timed(lambda: [one_train(c) for c in cfgs]))
+    one_wall, grid_wall, seq_wall = min(one_s), min(grid_s), min(seq_s)
+    print(json.dumps({
+        "metric": f"eval_grid_{n_points}pt_ml{scale}_rank64",
+        "value": round(grid_wall, 3),
+        "unit": "s",
+        "one_train_wall_s": round(one_wall, 3),
+        "sequential_grid_wall_s": round(seq_wall, 3),
+        "grid_vs_one_train": round(grid_wall / one_wall, 2),
+        "speedup_vs_sequential": round(seq_wall / grid_wall, 2),
+        "vs_baseline": round(seq_wall / grid_wall, 2),
+        "baseline": f"{n_points} sequential als_train calls, same window",
+    }))
+
+
 def main():
     from predictionio_tpu.ops.als import ALSConfig, als_train
 
@@ -499,8 +560,12 @@ if __name__ == "__main__":
                          "pio batchpredict (device top-k branch)")
     ap.add_argument("--quickstart", action="store_true",
                     help="rank-10 ML-100K epoch (BASELINE config 1)")
+    ap.add_argument("--evalgrid", action="store_true",
+                    help="4-point λ grid as one device program vs "
+                         "sequential trains (ops/als_grid A/B)")
     ap.add_argument("--scale", choices=sorted(CPU_REF_EPOCH_S),
-                    default="20m", help="north-star dataset scale")
+                    default=None, help="dataset scale (default: 20m for "
+                    "the north star, 2m for --evalgrid)")
     args = ap.parse_args()
     if args.serving:
         bench_serving(args.storage or "memory")
@@ -510,5 +575,7 @@ if __name__ == "__main__":
         bench_batch_predict()
     elif args.quickstart:
         main()
+    elif args.evalgrid:
+        bench_eval_grid(args.scale or "2m")
     else:
-        bench_north_star(args.scale)
+        bench_north_star(args.scale or "20m")

@@ -106,6 +106,20 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, R]):
     def batch_predict(self, model: M, queries: Sequence[Q]) -> list[R]:
         return [self.predict(model, q) for q in queries]
 
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, prepared_data: PD,
+                   algos: Sequence["Algorithm"]) -> Optional[list[M]]:
+        """Train N param variants of this algorithm as ONE device program
+        (SURVEY.md §2.6 strategy 4's TPU-native form — the eval param grid
+        batched instead of re-trained per cell).
+
+        Return a model per entry of `algos` (instances of `cls` differing
+        only in params), or None when this grid isn't batchable — the
+        evaluator then falls back to sequential `train` calls. The default
+        is not-batchable; algorithms with a grid-vmappable train (see
+        templates/recommendation ALSAlgorithm → ops/als_grid) override."""
+        return None
+
 
 class Serving(abc.ABC, Generic[Q, R]):
     """`LServing.serve` [U]: combine per-algorithm predictions into one."""

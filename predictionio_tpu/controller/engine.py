@@ -167,6 +167,80 @@ class Engine:
             results.append((td, qpa))
         return results
 
+    # -- grid eval (SURVEY.md §2.6 strategy 4, TPU-native form) ------------
+    def eval_grid(
+        self, ctx: WorkflowContext,
+        engine_params_list: Sequence[EngineParams],
+    ) -> Optional[list[list[tuple[Any, list[tuple[Any, Any, Any]]]]]]:
+        """Evaluate every EngineParams in one pass: folds are read and
+        prepared ONCE (they're identical when the grid varies only
+        algorithm params), and algorithms that implement `train_grid`
+        train all grid cells as one device program. Returns per-ep fold
+        results (same shape `eval` returns, one entry per ep), or None
+        when the grid isn't shareable — differing data-source/preparator/
+        serving selections, or mismatched algorithm name lists — in which
+        case the caller runs the sequential path.
+
+        Falls back gracefully *per algorithm*: a non-batchable algorithm
+        (train_grid → None) still shares the fold read/prepare and trains
+        its cells sequentially inside this pass.
+        """
+        if len(engine_params_list) < 2:
+            return None
+        base = engine_params_list[0]
+
+        def shared_key(ep: EngineParams):
+            from predictionio_tpu.controller.params import params_to_dict
+
+            def d(p):
+                return params_to_dict(p) if p else {}
+
+            return (ep.data_source_name, d(ep.data_source_params),
+                    ep.preparator_name, d(ep.preparator_params),
+                    ep.serving_name, d(ep.serving_params),
+                    [name for name, _ in ep.algorithm_params_list])
+
+        if any(shared_key(ep) != shared_key(base)
+               for ep in engine_params_list[1:]):
+            log.info("Engine.eval_grid: grid varies beyond algorithm "
+                     "params — sequential evaluation")
+            return None
+
+        ds, prep, _, serving = self.components(base)
+        # per-ep algorithm instances, grouped by position in the algo list
+        algos_by_ep = [self.components(ep)[2] for ep in engine_params_list]
+        folds = ds.read_eval(ctx)
+        n_ep = len(engine_params_list)
+        results: list[list] = [[] for _ in range(n_ep)]
+        for fi, (td, qa_pairs) in enumerate(folds):
+            log.info("Engine.eval_grid: fold %d/%d (%d queries, %d grid "
+                     "points)", fi + 1, len(folds), len(qa_pairs), n_ep)
+            pd = prep.prepare(ctx, td)
+            # models[e][j] = model for ep e, algorithm position j
+            models: list[list[Any]] = [[] for _ in range(n_ep)]
+            for j, (name, _) in enumerate(base.algorithm_params_list):
+                instances = [algos_by_ep[e][j][1] for e in range(n_ep)]
+                cls = type(instances[0])
+                grid_models = None
+                if all(type(a) is cls for a in instances):
+                    grid_models = cls.train_grid(ctx, pd, instances)
+                if grid_models is None:
+                    grid_models = [a.train(ctx, pd) for a in instances]
+                for e in range(n_ep):
+                    models[e].append(grid_models[e])
+            queries = [q for q, _ in qa_pairs]
+            for e in range(n_ep):
+                per_algo = [
+                    algo.batch_predict(model, queries)
+                    for (_, algo), model in zip(algos_by_ep[e], models[e])
+                ]
+                qpa = [
+                    (q, serving.serve(q, [preds[j] for preds in per_algo]), a)
+                    for j, (q, a) in enumerate(qa_pairs)
+                ]
+                results[e].append((td, qpa))
+        return results
+
     # -- model persistence (Engine.makeSerializableModels / prepareDeploy,
     #    SURVEY.md §3.1/§3.2) ----------------------------------------------
     def serialize_models(

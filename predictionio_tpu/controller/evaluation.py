@@ -106,10 +106,19 @@ class MetricEvaluator:
         # carry between evaluations (the built-in zoo is stateless)
         for metric in metrics:
             metric.reset()
+        # the TPU-native grid path (SURVEY.md §2.6 strategy 4): folds read
+        # once, batchable algorithms train every grid cell in one device
+        # program (Engine.eval_grid → Algorithm.train_grid → ops/als_grid);
+        # None = grid not shareable, run the reference-shaped sequential
+        # loop («EvaluationWorkflow» outer grid loop [U])
+        grid_results = engine.eval_grid(ctx, engine_params_list)
         for i, ep in enumerate(engine_params_list):
-            log.info("MetricEvaluator: engine params %d/%d", i + 1,
-                     len(engine_params_list))
-            fold_results = engine.eval(ctx, ep)
+            if grid_results is not None:
+                fold_results = grid_results[i]
+            else:
+                log.info("MetricEvaluator: engine params %d/%d", i + 1,
+                         len(engine_params_list))
+                fold_results = engine.eval(ctx, ep)
             per_fold: list[dict[str, float]] = []
             for _, qpa in fold_results:
                 fold_scores = {m.name: m.evaluate_all(qpa) for m in metrics}

@@ -4,6 +4,12 @@ Parity with the Recommendation template's «ALSModel extends PersistentModel»
 and the Similar-Product template's collected feature map (SURVEY.md §2.4
 [U]). Factors live as numpy on the host for low-latency single-query
 serving; bulk paths go through the jitted scorer in ops.ranking.
+
+Exception: grid-eval models (ALSAlgorithm.train_grid, host_factors=False)
+carry DEVICE-resident jax factor arrays — ops.ranking routes them down its
+device branch, `similar_products` coerces to host, and such models are
+eval-scoped: never pickled into the blob store (Engine.eval discards them
+after batch_predict).
 """
 
 from __future__ import annotations
@@ -101,16 +107,21 @@ class ALSModel:
         rows = [r for r in rows if r is not None]
         if not rows:
             return []
-        v = self.item_factors[rows]
+        # device-resident factors (grid eval): one host pull — the math
+        # below mutates `sims` in place, which jax arrays can't
+        item_factors = np.asarray(self.item_factors)
+        v = item_factors[rows]
         v = v / np.maximum(np.linalg.norm(v, axis=1, keepdims=True), 1e-9)
         q = v.mean(axis=0)
-        norms = np.maximum(np.linalg.norm(self.item_factors, axis=1), 1e-9)
-        sims = (self.item_factors @ q) / norms
+        norms = np.maximum(np.linalg.norm(item_factors, axis=1), 1e-9)
+        sims = (item_factors @ q) / norms
         if exclude_self:
             sims[rows] = -np.inf
         top = np.argsort(-sims)[:num]
         inv = self.item_ids.inverse()
         return [(inv[int(i)], float(sims[i])) for i in top if np.isfinite(sims[i])]
 
-    # numpy arrays + BiMaps pickle cleanly; nothing device-resident here,
-    # so the default blob-store persistence (Engine.serialize_models) works.
+    # numpy arrays + BiMaps pickle cleanly, so the default blob-store
+    # persistence (Engine.serialize_models) works for trained models.
+    # Grid-EVAL models are the exception (device-resident factors, see
+    # module docstring) and are never routed into persistence.

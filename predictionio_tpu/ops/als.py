@@ -403,6 +403,69 @@ def _bucket_cache_load(cache_dir: str, key: str):
         return None
 
 
+def bucketize_cached(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    row_multiple: int,
+    split_cap: Optional[int],
+    cap_growth: float,
+    bucket_cache_dir: Optional[str],
+    data_digest=None,
+):
+    """Both sides' `bucket_ragged_split`, behind the on-disk fingerprint
+    cache when `bucket_cache_dir` is set. Shared by `als_train` and the
+    grid evaluator (`ops/als_grid.py`) — the fingerprint covers every
+    bucketizer input and NOT the solver hyperparams, which is exactly why
+    an eval grid over (λ, α) can reuse the single train's cache entry.
+    `data_digest`: optional zero-arg memoized digest of the COO arrays.
+
+    Returns (user_buckets, u_split, item_buckets, i_split)."""
+    if data_digest is None:
+        def data_digest():
+            return _arrays_digest(user_idx, item_idx, ratings)
+    cached = None
+    bucket_key = None
+    if bucket_cache_dir:
+        import hashlib
+
+        # fingerprint = training data + every input the bucketizer reads;
+        # new events or a changed mesh shape / splitCap / growth miss
+        bucket_key = hashlib.blake2b(
+            (data_digest() + repr((n_users, n_items, row_multiple,
+                                   split_cap, cap_growth,
+                                   _BUCKET_CACHE_VERSION))).encode(),
+            digest_size=16).hexdigest()
+        cached = _bucket_cache_load(bucket_cache_dir, bucket_key)
+    if cached is not None:
+        user_buckets, u_split, item_buckets, i_split = cached
+        log.info("als_train: bucket cache hit %s (host bucketize skipped)",
+                 bucket_key)
+    else:
+        user_buckets, u_split = bucket_ragged_split(
+            user_idx, item_idx, ratings, n_users, row_multiple, split_cap,
+            cap_growth=cap_growth)
+        item_buckets, i_split = bucket_ragged_split(
+            item_idx, user_idx, ratings, n_items, row_multiple, split_cap,
+            cap_growth=cap_growth)
+        if bucket_cache_dir:
+            try:
+                # atomic write: concurrent ranks race safely (same bytes)
+                _bucket_cache_save(bucket_cache_dir, bucket_key,
+                                   user_buckets, u_split, item_buckets,
+                                   i_split)
+                log.info("als_train: bucket cache miss — saved %s",
+                         bucket_key)
+            except OSError as e:
+                # the cache is a pure optimization: a full/read-only disk
+                # must not fail a train that already bucketized
+                log.warning("als_train: bucket cache save failed (%s) — "
+                            "continuing uncached", e)
+    return user_buckets, u_split, item_buckets, i_split
+
+
 def _bucket_chunk_rows(r: int, c: int, k: int, row_multiple: int) -> int:
     """Rows per chunk for a [r, c] bucket at rank k (== r when no chunking
     is needed). Multiple of row_multiple so shards stay tile-aligned."""
@@ -691,6 +754,36 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
     return jax.jit(run)
 
 
+def resolve_solver(cfg: ALSConfig) -> ALSConfig:
+    """Resolve `solver='auto'` to a concrete solver for this backend/rank,
+    and downgrade an unusable 'gj' request to 'chol' (with a warning).
+    Shared by `als_train` and the grid evaluator."""
+    import jax
+
+    if cfg.solver == "auto":
+        from predictionio_tpu.ops import pallas_solve
+
+        on_tpu = jax.default_backend() == "tpu"
+        use_gj = (pallas_solve.gj_applicable(cfg.rank)
+                  and (on_tpu or cfg.pallas == "interpret"))
+        cfg = dataclasses.replace(cfg, solver="gj" if use_gj else "chol")
+        log.info("als_train: solver='auto' resolved to %r (backend=%s, "
+                 "rank=%d)", cfg.solver, jax.default_backend(), cfg.rank)
+    elif cfg.solver == "gj":
+        from predictionio_tpu.ops import pallas_solve
+
+        if not pallas_solve.gj_applicable(cfg.rank):
+            log.warning("als_train: solver='gj' rank %d exceeds the VMEM "
+                        "budget; falling back to 'chol'", cfg.rank)
+            cfg = dataclasses.replace(cfg, solver="chol")
+        elif jax.default_backend() != "tpu" and cfg.pallas != "interpret":
+            log.warning("als_train: solver='gj' needs TPU (or "
+                        "pallas='interpret'); falling back to 'chol' on %s",
+                        jax.default_backend())
+            cfg = dataclasses.replace(cfg, solver="chol")
+    return cfg
+
+
 @dataclasses.dataclass
 class ALSResult:
     user_factors: np.ndarray  # [n_users, K]
@@ -779,28 +872,7 @@ def als_train(
             solver="chol" if cfg.solver in ("auto", "gj") else cfg.solver,
             pallas="off")
 
-    if cfg.solver == "auto":
-        from predictionio_tpu.ops import pallas_solve
-
-        on_tpu = jax.default_backend() == "tpu"
-        use_gj = (pallas_solve.gj_applicable(cfg.rank)
-                  and (on_tpu or cfg.pallas == "interpret"))
-        cfg = dataclasses.replace(cfg, solver="gj" if use_gj else "chol")
-        log.info("als_train: solver='auto' resolved to %r (mesh.size=%d, "
-                 "backend=%s, rank=%d)", cfg.solver, mesh.size,
-                 jax.default_backend(), cfg.rank)
-    elif cfg.solver == "gj":
-        from predictionio_tpu.ops import pallas_solve
-
-        if not pallas_solve.gj_applicable(cfg.rank):
-            log.warning("als_train: solver='gj' rank %d exceeds the VMEM "
-                        "budget; falling back to 'chol'", cfg.rank)
-            cfg = dataclasses.replace(cfg, solver="chol")
-        elif jax.default_backend() != "tpu" and cfg.pallas != "interpret":
-            log.warning("als_train: solver='gj' needs TPU (or "
-                        "pallas='interpret'); falling back to 'chol' on %s",
-                        jax.default_backend())
-            cfg = dataclasses.replace(cfg, solver="chol")
+    cfg = resolve_solver(cfg)
 
     split_cap = cfg.split_cap if cfg.split_cap > 0 else None
 
@@ -813,43 +885,9 @@ def als_train(
             _digest_memo.append(_arrays_digest(user_idx, item_idx, ratings))
         return _digest_memo[0]
 
-    cached = None
-    bucket_key = None
-    if bucket_cache_dir:
-        import hashlib
-
-        # fingerprint = training data + every input the bucketizer reads;
-        # new events or a changed mesh shape / splitCap / growth miss
-        bucket_key = hashlib.blake2b(
-            (data_digest() + repr((n_users, n_items, row_multiple,
-                                   split_cap, cfg.cap_growth,
-                                   _BUCKET_CACHE_VERSION))).encode(),
-            digest_size=16).hexdigest()
-        cached = _bucket_cache_load(bucket_cache_dir, bucket_key)
-    if cached is not None:
-        user_buckets, u_split, item_buckets, i_split = cached
-        log.info("als_train: bucket cache hit %s (host bucketize skipped)",
-                 bucket_key)
-    else:
-        user_buckets, u_split = bucket_ragged_split(
-            user_idx, item_idx, ratings, n_users, row_multiple, split_cap,
-            cap_growth=cfg.cap_growth)
-        item_buckets, i_split = bucket_ragged_split(
-            item_idx, user_idx, ratings, n_items, row_multiple, split_cap,
-            cap_growth=cfg.cap_growth)
-        if bucket_cache_dir:
-            try:
-                # atomic write: concurrent ranks race safely (same bytes)
-                _bucket_cache_save(bucket_cache_dir, bucket_key,
-                                   user_buckets, u_split, item_buckets,
-                                   i_split)
-                log.info("als_train: bucket cache miss — saved %s",
-                         bucket_key)
-            except OSError as e:
-                # the cache is a pure optimization: a full/read-only disk
-                # must not fail a train that already bucketized
-                log.warning("als_train: bucket cache save failed (%s) — "
-                            "continuing uncached", e)
+    user_buckets, u_split, item_buckets, i_split = bucketize_cached(
+        user_idx, item_idx, ratings, n_users, n_items, row_multiple,
+        split_cap, cfg.cap_growth, bucket_cache_dir, data_digest)
     log.info(
         "als_train: %d ratings, %d users (%d buckets, caps %s, %d split), "
         "%d items (%d buckets, caps %s, %d split), rank %d, mesh %s",

@@ -1,0 +1,465 @@
+"""Grid-batched ALS: N hyperparameter points trained as ONE device program.
+
+The reference's eval param grid runs one full Spark train per grid cell
+(«core/.../workflow/EvaluationWorkflow.scala :: runEvaluation» [U], outer
+loop over `EngineParams` — SURVEY.md §3.4). Its TPU-native form (SURVEY.md
+§2.6 strategy 4: "param-grid → vmapped multi-seed train") exploits that
+grid cells over (λ, α, seed) share the interaction matrix's sparsity
+pattern — the bucketized data, the gather indices, every shape — and
+differ only in scalars.
+
+Design (why this is NOT a vmap of G independent trains):
+
+- TPU row-gather is **op-throughput-bound** (~40M rows/s on v5e, invariant
+  to table size, dtype, and row width — docs/performance.md §roofline), and
+  the gather of opposing factors is the dominant non-MXU op of an ALS
+  epoch. A vmapped train would pay that gather G times. Instead the G grid
+  points' factor tables are stacked along the feature dim — `[V, G, K]`,
+  gathered as `[V, G·K]` rows — so ONE gather of width G·K feeds every
+  grid point at roughly the cost of a single train's gather.
+- The per-row normal equations grow a batched `g` axis: Gram/RHS einsums
+  `rcgk,rcgl->rgkl` are MXU work (cheap, scales fine), and the SPD solve
+  flattens `[R, G, K, K] → [R·G, K, K]` into the same batched solvers
+  (Pallas GJ/Schur or Cholesky) `als_train` uses — the solver never knows
+  a grid is running.
+- λ and α enter as **traced `[G]` arrays**, not static config floats, so
+  every grid over the same shapes shares one compiled program.
+
+Sharding: bucket rows shard over the mesh `data` axis exactly as in
+`als_train`; factors are replicated ([V, G, K] is G× a single train's
+factors — at eval scale that is megabytes). The `model` factor-sharding
+axis is not supported here (grid eval targets the many-small-trains
+regime, not the pod-scale-factors one); callers fall back to sequential.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.ops.als import (
+    ALSConfig,
+    ALSResult,
+    _bucket_chunk_rows,
+    _walk_bucket_chunks,
+    bucketize_cached,
+    resolve_solver,
+)
+
+log = logging.getLogger(__name__)
+
+# config fields that may vary across grid points (everything else must be
+# equal for the cells to share one device program / one bucketize)
+VARIABLE_FIELDS = ("reg", "alpha", "seed", "iterations")
+
+
+def grid_compatible(cfgs: Sequence[ALSConfig]) -> Optional[str]:
+    """None when `cfgs` can train as one grid program, else the reason
+    they can't (callers log it and fall back to sequential trains).
+
+    `iterations` is listed variable only in the sense that the grid runs
+    max(iterations) and cells wanting fewer are NOT equivalent — so unequal
+    iteration counts are rejected here, with the check kept explicit."""
+    if not cfgs:
+        return "empty grid"
+    base = cfgs[0]
+    static = [f.name for f in dataclasses.fields(ALSConfig)
+              if f.name not in VARIABLE_FIELDS]
+    for i, c in enumerate(cfgs[1:], 1):
+        for name in static:
+            if getattr(c, name) != getattr(base, name):
+                return (f"grid point {i} differs from point 0 in "
+                        f"{name!r} ({getattr(c, name)!r} != "
+                        f"{getattr(base, name)!r})")
+        if c.iterations != base.iterations:
+            return (f"grid point {i} wants {c.iterations} iterations, "
+                    f"point 0 wants {base.iterations}")
+    if base.solver == "cg":
+        return "solver='cg' is not grid-batched"
+    return None
+
+
+def grid_groups(cfgs: Sequence[ALSConfig]) -> list[list[int]]:
+    """Partition grid-cell indices into maximal batchable groups.
+
+    Cells agreeing on every static field (and iteration count) land in one
+    group — e.g. the stock Recommendation eval grid over rank×λ becomes
+    one group per rank, each batching its λ cells. Non-batchable cells
+    (solver='cg') come back as singletons. Group order preserves first
+    appearance; indices within a group keep caller order."""
+    static = [f.name for f in dataclasses.fields(ALSConfig)
+              if f.name not in VARIABLE_FIELDS]
+    groups: dict = {}
+    for idx, c in enumerate(cfgs):
+        if c.solver == "cg":
+            groups[("cg", idx)] = [idx]
+            continue
+        key = tuple(getattr(c, n) for n in static) + (c.iterations,)
+        groups.setdefault(key, []).append(idx)
+    return list(groups.values())
+
+
+def _gather_rows_grid(table, cols, mesh=None):
+    """[R, C] row-id gather from [V, G, K] → [R, C, G, K].
+
+    Single device: the [V, G·K]-flattened `jnp.take` fast path — same
+    lowering als._gather_rows uses, rows just G× wider (free: the gather
+    is op-throughput-bound, not bandwidth-bound). Under a mesh the
+    indexed form shards cleanly over the row dim."""
+    import jax.numpy as jnp
+
+    if mesh is not None and mesh.size > 1:
+        return table[cols]
+    v, g, k = table.shape
+    r, c = cols.shape
+    return jnp.take(table.reshape(v, g * k), cols.reshape(-1), axis=0,
+                    mode="clip").reshape(r, c, g, k)
+
+
+def _solve_buckets_grid(
+    opposing,  # [V, G, K]
+    out_rows: int,
+    buckets_dev: Sequence[tuple],
+    cfg: ALSConfig,  # static fields only (reg/alpha read from arrays)
+    regs,  # [G] f32 traced
+    alphas,  # [G] f32 traced (implicit mode)
+    split_rows=None,
+    row_multiple: int = 8,
+    mesh=None,
+):
+    """One grid half-epoch: per row, solve G normal-equation systems that
+    share the row's gathered entries. Mirrors als._solve_buckets_device
+    with a batched `g` axis; see module docstring for the layout."""
+    import jax
+    import jax.numpy as jnp
+
+    v, g, k = opposing.shape
+    new = jnp.zeros((out_rows, g, k), dtype=opposing.dtype)
+    n_split = 0 if split_rows is None else split_rows.shape[0]
+    if n_split:
+        acc_a = jnp.zeros((n_split, g, k, k), dtype=jnp.float32)
+        acc_b = jnp.zeros((n_split, g, k), dtype=jnp.float32)
+        acc_n = jnp.zeros((n_split,), dtype=jnp.float32)
+
+    interpret = cfg.pallas == "interpret"
+    cdtype = jnp.dtype(cfg.compute_dtype)
+    f32 = jnp.float32
+
+    def chol_solve(a, b):
+        chol = jnp.linalg.cholesky(a)
+        y1 = jax.lax.linalg.triangular_solve(
+            chol, b[..., None], left_side=True, lower=True)
+        return jax.lax.linalg.triangular_solve(
+            chol, y1, left_side=True, lower=True, transpose_a=True)[..., 0]
+
+    def solve_spd(a, b, row_sharded=True):
+        """[R, G, K, K], [R, G, K] → [R, G, K]: flatten the (row, grid)
+        batch into the row-batched solvers als_train uses."""
+        r = a.shape[0]
+        a2 = a.reshape(r * g, k, k)
+        b2 = b.reshape(r * g, k)
+        if cfg.solver == "gj":
+            from predictionio_tpu.ops import pallas_solve
+
+            if mesh is not None and mesh.size > 1 and row_sharded:
+                from jax.sharding import PartitionSpec as P
+
+                from predictionio_tpu.parallel.mesh import DATA_AXIS
+
+                spec = P(DATA_AXIS)
+                solve = jax.shard_map(
+                    lambda a_, b_: pallas_solve.gj_solve(
+                        a_, b_, interpret=interpret),
+                    mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+                    check_vma=False)
+                x2 = solve(a2.astype(f32), b2.astype(f32)).astype(a.dtype)
+            elif mesh is not None and mesh.size > 1:
+                x2 = chol_solve(a2, b2)  # tiny split-accumulator batch
+            else:
+                x2 = pallas_solve.gj_solve(
+                    a2.astype(f32), b2.astype(f32),
+                    interpret=interpret).astype(a.dtype)
+        elif cfg.solver == "chol":
+            x2 = chol_solve(a2, b2)
+        else:
+            x2 = jnp.linalg.solve(a2, b2[..., None])[..., 0]
+        return x2.reshape(r, g, k)
+
+    if cfg.implicit:
+        op_c = opposing.astype(cdtype)
+        gram = jnp.einsum("vgk,vgl->gkl", op_c, op_c,
+                          preferred_element_type=f32)
+
+    def partial_gram(cols_c, vals_c, mask_c):
+        y = _gather_rows_grid(opposing, cols_c, mesh)  # [R, C, G, K]
+        # mask on both einsum sides (m² == m) — keeps XLA from
+        # materializing the raw gather twice (see als.partial_gram)
+        ym = (y * mask_c[..., None, None]).astype(cdtype)
+        if cfg.implicit:
+            conf = alphas[None, None, :] * vals_c[:, :, None]  # [R, C, G]
+            a = jnp.einsum("rcgk,rcg,rcgl->rgkl", ym, conf.astype(cdtype),
+                           ym, preferred_element_type=f32)
+            b = jnp.einsum("rcgk,rcg->rgk", ym, (1.0 + conf).astype(cdtype),
+                           preferred_element_type=f32)
+        else:
+            a = jnp.einsum("rcgk,rcgl->rgkl", ym, ym,
+                           preferred_element_type=f32)
+            b = jnp.einsum("rcgk,rc->rgk", ym, vals_c.astype(cdtype),
+                           preferred_element_type=f32)
+        return a, b
+
+    def finalize(a, b, n, row_sharded=True):
+        if cfg.implicit:
+            a = a + gram[None]
+        # [R, G] regularizer: per-row λ·n_r (ALS-WR) × per-grid-point λ
+        reg_rg = regs[None, :] * (n[:, None] if cfg.weighted_reg
+                                  else jnp.ones_like(n)[:, None])
+        a = a + reg_rg[..., None, None] * jnp.eye(k, dtype=f32)[None, None]
+        return solve_spd(a.astype(opposing.dtype), b.astype(opposing.dtype),
+                         row_sharded)
+
+    def process(rows_c, cols_c, vals_c, mask_c, segmap_c, new, accs):
+        n = mask_c.sum(-1)
+        a, b = partial_gram(cols_c, vals_c, mask_c)
+        rows_eff = rows_c
+        if segmap_c is not None:
+            acc_a, acc_b, acc_n = accs
+            accs = (acc_a.at[segmap_c].add(a, mode="drop"),
+                    acc_b.at[segmap_c].add(b, mode="drop"),
+                    acc_n.at[segmap_c].add(n, mode="drop"))
+            rows_eff = jnp.where(segmap_c < n_split, out_rows, rows_c)
+        x = finalize(a, b, n)
+        new = new.at[rows_eff].set(x.astype(new.dtype), mode="drop")
+        return new, accs
+
+    accs = (acc_a, acc_b, acc_n) if n_split else ()
+    for bucket in buckets_dev:
+        cap = bucket[1].shape[1]
+        # chunk budget: the grid gather is [chunk, C, G, K] — G× a single
+        # train's block, so the budget arithmetic sees an effective rank
+        # of G·K
+        new, accs = _walk_bucket_chunks(
+            bucket, cap, g * k, row_multiple,
+            lambda sliced, carry: process(*sliced, *carry), (new, accs))
+
+    if n_split:
+        x_u = finalize(*accs, row_sharded=False)
+        new = new.at[split_rows].set(x_u.astype(new.dtype), mode="drop")
+    return new
+
+
+def _predict_sq_err_grid(u_factors, i_factors, buckets_dev,
+                         row_multiple: int = 8, mesh=None):
+    """Per-grid-point Σ (uᵀv − r)² over all real entries → ([G], count)."""
+    import jax.numpy as jnp
+
+    v, g, k = u_factors.shape
+
+    def err_chunk(sliced, carry):
+        rows_c, cols_c, vals_c, mask_c, _segmap = sliced
+        total, count = carry
+        u = u_factors[rows_c.clip(0, u_factors.shape[0] - 1)]  # [R, G, K]
+        y = _gather_rows_grid(i_factors, cols_c, mesh)  # [R, C, G, K]
+        pred = jnp.einsum("rgk,rcgk->rcg", u, y)
+        err = (pred - vals_c[:, :, None]) * mask_c[:, :, None]
+        return (total + jnp.sum(err * err, axis=(0, 1)),
+                count + jnp.sum(mask_c))
+
+    total = jnp.zeros((g,), dtype=jnp.float32)
+    count = jnp.zeros((), dtype=jnp.float32)
+    for bucket in buckets_dev:
+        cap = bucket[1].shape[1]
+        total, count = _walk_bucket_chunks(bucket, cap, g * k, row_multiple,
+                                           err_chunk, (total, count))
+    return total, count
+
+
+@functools.lru_cache(maxsize=32)
+def _get_grid_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
+                         n_grid: int, compute_rmse: bool, n_steps: int,
+                         row_multiple: int, mesh=None):
+    """The whole grid train as ONE jitted program (lax.scan over
+    iterations, same single-dispatch discipline as als._get_train_loop).
+    `cfg` carries static fields only — reg/alpha arrive as traced [G]
+    arrays so different grids over the same shapes share the compile."""
+    import jax
+
+    def run(keys, regs, alphas, ub_dev, ib_dev, u_split, i_split):
+        import numpy as _np
+
+        # per-point init matching als_train exactly: item factors
+        # ~ N(0, 1)/√K from each point's seed, user factors zero. Built
+        # INSIDE the one compiled program: a separate jitted closure was
+        # retraced (≈1 s recompile) on every call, and a host-built init
+        # cost seconds of [V, G, K] tunnel transfer (bench_eval_grid A/B).
+        dtype = jax.numpy.dtype(cfg.dtype)
+        per_seed = jax.vmap(
+            lambda kk: jax.random.normal(kk, (n_items, cfg.rank),
+                                         dtype=dtype)
+            / _np.sqrt(cfg.rank))(keys)  # [G, n_items, K]
+        item_f0 = jax.numpy.transpose(per_seed, (1, 0, 2))
+        user_f0 = jax.numpy.zeros((n_users, n_grid, cfg.rank), dtype)
+
+        def body(carry, _):
+            user_f, item_f = carry
+            user_f = _solve_buckets_grid(item_f, n_users, ub_dev, cfg,
+                                         regs, alphas, u_split,
+                                         row_multiple, mesh)
+            item_f = _solve_buckets_grid(user_f, n_items, ib_dev, cfg,
+                                         regs, alphas, i_split,
+                                         row_multiple, mesh)
+            if compute_rmse:
+                total, count = _predict_sq_err_grid(
+                    user_f, item_f, ub_dev, row_multiple, mesh)
+                rmse = jax.numpy.sqrt(
+                    jax.numpy.maximum(total, 0.0)
+                    / jax.numpy.maximum(count, 1.0))
+            else:
+                rmse = jax.numpy.zeros((n_grid,), dtype=jax.numpy.float32)
+            return (user_f, item_f), rmse
+
+        (user_f, item_f), rmses = jax.lax.scan(
+            body, (user_f0, item_f0), xs=None, length=n_steps)
+        return user_f, item_f, rmses
+
+    return jax.jit(run)
+
+
+def als_train_grid(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    cfgs: Sequence[ALSConfig],
+    mesh=None,
+    compute_rmse: bool = False,
+    bucket_cache_dir: Optional[str] = None,
+    host_factors: bool = True,
+) -> list[ALSResult]:
+    """Train every grid point in `cfgs` in one device program; returns one
+    `ALSResult` per point, each numerically matching what a sequential
+    `als_train` with that point's config produces (same init per seed,
+    same math — modulo float reassociation from the batched einsums;
+    tests pin ≤1e-4 relative).
+
+    Callers must check `grid_compatible(cfgs) is None` first (raises here
+    otherwise). Each result's `epoch_times` reports the SHARED wall of the
+    whole grid divided by iterations — the entire point of this path is
+    that G trains cost ~one train's wall, so per-point attribution would
+    be fiction.
+
+    host_factors=False keeps each result's factor matrices as DEVICE
+    arrays (per-point slices of the [V, G, K] stack). The eval path wants
+    this: scoring (ops/ranking top-k) runs on device anyway, and pulling
+    the G-wide stack to host costs G× one train's readback through the
+    axon tunnel (~7 MB/s measured — it was the largest single overhead of
+    the grid A/B). Device results must not be pickled/persisted.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+    reason = grid_compatible(cfgs)
+    if reason:
+        raise ValueError(f"grid not batchable: {reason}")
+    if mesh is None:
+        mesh = make_mesh()
+    if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        raise ValueError(
+            "als_train_grid does not support model-axis factor sharding; "
+            "run grid points sequentially on a model>1 mesh")
+    n_grid = len(cfgs)
+    base = resolve_solver(cfgs[0])
+    # static program config: variable fields pinned so the lru_cache key
+    # (and the traced program) is grid-value-independent
+    cfg = dataclasses.replace(base, reg=0.0, alpha=1.0, seed=0, iterations=0)
+
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    row_multiple = max(8, n_data)
+    if row_multiple % n_data:
+        row_multiple = 8 * n_data
+
+    split_cap = cfg.split_cap if cfg.split_cap > 0 else None
+    user_buckets, u_split, item_buckets, i_split = bucketize_cached(
+        user_idx, item_idx, ratings, n_users, n_items, row_multiple,
+        split_cap, cfg.cap_growth, bucket_cache_dir)
+    log.info(
+        "als_train_grid: %d grid points × (%d ratings, %d users, %d items, "
+        "rank %d, %d iters), mesh %s — one device program",
+        n_grid, len(ratings), n_users, n_items, cfg.rank,
+        cfgs[0].iterations, dict(mesh.shape))
+
+    dtype = jnp.dtype(cfg.dtype)
+    row_shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def put_buckets(buckets, n_rows: int, n_split: int):
+        out = []
+        for b in buckets:
+            r_total, cap = b.cols.shape
+            chunk = _bucket_chunk_rows(r_total, cap, n_grid * cfg.rank,
+                                       row_multiple)
+            pad = (-r_total) % chunk
+            arrs = dict(rows=b.rows, cols=b.cols, vals=b.vals, mask=b.mask,
+                        segmap=b.segmap)
+            if pad:
+                arrs["rows"] = np.concatenate(
+                    [b.rows, np.full(pad, n_rows, np.int32)])
+                for name in ("cols", "vals", "mask"):
+                    a = arrs[name]
+                    arrs[name] = np.concatenate(
+                        [a, np.zeros((pad, cap), a.dtype)])
+                if b.segmap is not None:
+                    arrs["segmap"] = np.concatenate(
+                        [b.segmap, np.full(pad, n_split, np.int32)])
+            out.append(tuple(
+                None if arrs[name] is None
+                else jax.device_put(arrs[name], row_shard)
+                for name in ("rows", "cols", "vals", "mask", "segmap")))
+        return out
+
+    ub_dev = put_buckets(user_buckets, n_users, len(u_split))
+    ib_dev = put_buckets(item_buckets, n_items, len(i_split))
+    u_split_dev = jax.device_put(u_split, rep)
+    i_split_dev = jax.device_put(i_split, rep)
+
+    keys = jnp.stack([jax.random.key(c.seed) for c in cfgs])
+    regs = jnp.asarray([c.reg for c in cfgs], jnp.float32)
+    alphas = jnp.asarray([c.alpha for c in cfgs], jnp.float32)
+
+    iterations = cfgs[0].iterations
+    t_start = time.perf_counter()
+    train = _get_grid_train_loop(n_users, n_items, cfg, n_grid,
+                                 compute_rmse, iterations, row_multiple,
+                                 mesh if mesh.size > 1 else None)
+    user_factors, item_factors, rmses = train(
+        keys, regs, alphas, ub_dev, ib_dev, u_split_dev, i_split_dev)
+    float(item_factors[0, 0, 0])  # execution fence (axon tunnel)
+    wall = time.perf_counter() - t_start
+
+    if host_factors:
+        uf = np.asarray(user_factors)  # [n_users, G, K]
+        vf = np.asarray(item_factors)
+    else:
+        uf, vf = user_factors, item_factors  # device slices below
+    rmse_g = np.asarray(rmses)  # [iters, G]
+    out = []
+    for gi in range(n_grid):
+        out.append(ALSResult(
+            user_factors=uf[:, gi, :],
+            item_factors=vf[:, gi, :],
+            rmse_history=([float(x) for x in rmse_g[:, gi]]
+                          if compute_rmse else []),
+            epoch_times=([wall / iterations] * iterations
+                         if iterations else []),
+            start_epoch=0,
+        ))
+    return out

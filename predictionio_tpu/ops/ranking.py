@@ -82,7 +82,13 @@ def recommend_topk(
         return (np.zeros((len(user_ids), 0), np.float32),
                 np.zeros((len(user_ids), 0), np.int32))
     masked = bool(exclude)
-    if len(user_ids) <= SERVE_HOST_MAX_BATCH:
+    # device-resident factors (the grid-eval path keeps trained factors on
+    # chip — ops/als_grid host_factors=False): always take the device
+    # branch; the host fast path's in-place numpy masking can't touch a
+    # jax array, and a readback would defeat the point of residency
+    on_device = not (isinstance(user_factors, np.ndarray)
+                     and isinstance(item_factors, np.ndarray))
+    if len(user_ids) <= SERVE_HOST_MAX_BATCH and not on_device:
         # Serving fast path: tiny batches score in numpy on the host. A
         # device round trip costs more than the dot product at any catalog
         # size that fits serving, and it keeps the prediction server off

@@ -211,15 +211,7 @@ class ALSAlgorithm(Algorithm):
 
     def train(self, ctx: WorkflowContext, pd: PreparedData) -> ALSModel:
         p = self.params
-        cfg = ALSConfig(
-            rank=p.rank,
-            iterations=p.numIterations,
-            reg=p.lambda_,
-            implicit=p.implicitPrefs,
-            alpha=p.alpha,
-            seed=ctx.seed if p.seed is None else p.seed,
-            split_cap=p.splitCap,
-        )
+        cfg = self._als_config(ctx)
         result = als_train(
             pd.user_idx, pd.item_idx, pd.ratings,
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
@@ -246,6 +238,82 @@ class ALSAlgorithm(Algorithm):
             seen=SeenItems(pd.user_idx, pd.item_idx, len(pd.user_ids)),
             rmse_history=result.rmse_history,
         )
+
+    def _als_config(self, ctx: WorkflowContext) -> ALSConfig:
+        p = self.params
+        return ALSConfig(
+            rank=p.rank,
+            iterations=p.numIterations,
+            reg=p.lambda_,
+            implicit=p.implicitPrefs,
+            alpha=p.alpha,
+            seed=ctx.seed if p.seed is None else p.seed,
+            split_cap=p.splitCap,
+        )
+
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list[ALSModel]]:
+        """Eval param grid as device programs (ops/als_grid): cells
+        varying only in (λ, α, seed) share the bucketized data — and the
+        bucket cache entry the production train already wrote — so an
+        N-point grid costs ~one train's wall instead of N
+        («EvaluationWorkflow» grid loop [U], SURVEY.md §2.6 row 4).
+        Mixed grids partition into maximal batchable groups (the stock
+        rank×λ grid = one program per rank); leftover singletons take the
+        ordinary `train` path."""
+        from predictionio_tpu.ops.als_grid import als_train_grid, grid_groups
+        from predictionio_tpu.parallel.mesh import MODEL_AXIS
+
+        if ctx.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            log.info("ALSAlgorithm.train_grid: model-axis factor sharding "
+                     "requested — training %d grid points sequentially",
+                     len(algos))
+            return None
+        from predictionio_tpu.utils import checks as _checks
+
+        if _checks.enabled():
+            # the grid loop has no checkify path; --check-asserts must run
+            # the checked sequential trains, not silently skip the asserts
+            log.info("ALSAlgorithm.train_grid: --check-asserts armed — "
+                     "training %d grid points sequentially (checked)",
+                     len(algos))
+            return None
+        cfgs = [a._als_config(ctx) for a in algos]
+        groups = grid_groups(cfgs)
+        if max(len(g) for g in groups) == 1:
+            log.info("ALSAlgorithm.train_grid: no two of the %d grid points "
+                     "share shapes — sequential trains", len(algos))
+            return None
+        models: list[Optional[ALSModel]] = [None] * len(algos)
+        seen = SeenItems(pd.user_idx, pd.item_idx, len(pd.user_ids))
+        for group in groups:
+            if len(group) == 1:
+                models[group[0]] = algos[group[0]].train(ctx, pd)
+                continue
+            compute_rmse = any(algos[i].params.computeRMSE for i in group)
+            # host_factors=False: eval models stay device-resident — the
+            # batch_predict top-k runs on device anyway, and the G-wide
+            # factor readback was the grid A/B's largest overhead. These
+            # models are eval-scoped (never pickled/persisted).
+            results = als_train_grid(
+                pd.user_idx, pd.item_idx, pd.ratings,
+                n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+                cfgs=[cfgs[i] for i in group], mesh=ctx.mesh,
+                compute_rmse=compute_rmse,
+                bucket_cache_dir=ctx.algorithm_cache_dir("als"),
+                host_factors=False,
+            )
+            for i, r in zip(group, results):
+                models[i] = ALSModel(
+                    user_factors=r.user_factors,
+                    item_factors=r.item_factors,
+                    user_ids=pd.user_ids,
+                    item_ids=pd.item_ids,
+                    seen=seen,
+                    rmse_history=r.rmse_history,
+                )
+        return models
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         num = int(query.get("num", 10))
