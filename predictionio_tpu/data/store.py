@@ -137,7 +137,28 @@ class EventStore:
         until_time: Optional[datetime] = None,
         required: Optional[list[str]] = None,
     ) -> dict[str, PropertyMap]:
-        """`$set/$unset/$delete`-folded entity state (`aggregateProperties` [U])."""
+        """`$set/$unset/$delete`-folded entity state (`aggregateProperties` [U]).
+
+        Reads through the pushed-down columnar fold when the backend has
+        one (C++ / SQL tiers in `storage/sqlite.py` — no per-event Python
+        object; ~13× the per-event path at 2M property events, see
+        BASELINE.md), falling back to the per-event
+        `data/datamap.py::aggregate_properties` fold, which is the
+        semantics oracle the pushdown tiers are tested against."""
+        storage, app_id, channel_id = self._resolve(app_name, channel_name)
+        agg = storage.l_events().aggregate_properties_columnar(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            required=list(required) if required else None,
+        )
+        if agg is not None:
+            return {
+                eid: PropertyMap(fields, first_updated=first, last_updated=last)
+                for eid, (fields, first, last) in agg.items()
+            }
         events = self.find(
             app_name=app_name,
             channel_name=channel_name,

@@ -25,7 +25,7 @@ log = logging.getLogger(__name__)
 _SRCS = [
     os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
     for name in ("pio_native.cpp", "pio_scan.cpp", "pio_import.cpp",
-                 "pio_export.cpp")
+                 "pio_export.cpp", "pio_aggprops.cpp")
 ]
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -127,6 +127,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
             cstr, cstr, ctypes.c_longlong, ctypes.c_longlong, llp]
         lib.pio_export_error.restype = ctypes.c_char_p
         lib.pio_export_error.argtypes = []
+        lib.pio_agg_open.restype = i64
+        lib.pio_agg_open.argtypes = [
+            cstr, cstr, cstrp, i64, cstrp, i64,
+            ctypes.POINTER(ctypes.c_void_p), i64_out, i64_out]
+        lib.pio_agg_fill.restype = i64
+        lib.pio_agg_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pio_agg_free.restype = None
+        lib.pio_agg_free.argtypes = [ctypes.c_void_p]
+        lib.pio_agg_error.restype = ctypes.c_char_p
+        lib.pio_agg_error.argtypes = []
         _lib = lib
         return _lib
 
@@ -248,6 +258,50 @@ def bucket_ragged_native(rows: np.ndarray, cols: np.ndarray,
         ro += rpad
         eo += rpad * cap
     return buckets
+
+
+def agg_props_native(db_path: str, sql: str, params: list,
+                     required: Optional[list]) -> Optional[list]:
+    """$set/$unset/$delete fold via the C++ reader (pio_aggprops.cpp).
+
+    `sql` must select (entity_id, event, properties, event_time) ordered
+    by (event_time, creation_time) ascending, with `?` placeholders
+    bound from `params` (all bound as text). Returns a list of
+    (entity_id, first_updated_text, last_updated_text, folded_json_text)
+    tuples — one per surviving entity, `required` keys pre-filtered —
+    or None when the native path is unavailable or bailed (the caller
+    falls back to the per-event Python fold, which is bit-identical).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    c_params = (ctypes.c_char_p * max(len(params), 1))(
+        *[str(p).encode() for p in params])
+    req = required or []
+    c_req = (ctypes.c_char_p * max(len(req), 1))(
+        *[str(k).encode() for k in req])
+    handle = ctypes.c_void_p()
+    n = ctypes.c_int64()
+    nbytes = ctypes.c_int64()
+    rc = lib.pio_agg_open(
+        db_path.encode(), sql.encode(), c_params, len(params),
+        c_req, len(req), ctypes.byref(handle), ctypes.byref(n),
+        ctypes.byref(nbytes))
+    if rc != 0:
+        log.info("native aggprops: %s — Python fallback",
+                 lib.pio_agg_error().decode(errors="replace"))
+        return None
+    try:
+        buf = ctypes.create_string_buffer(max(nbytes.value, 1))
+        if lib.pio_agg_fill(handle, buf) != 0:
+            return None
+        parts = buf.raw[:nbytes.value].decode().split("\0")[:-1]
+    finally:
+        lib.pio_agg_free(handle)
+    if len(parts) != 4 * n.value:
+        log.warning("native aggprops: blob shape mismatch — fallback")
+        return None
+    return [tuple(parts[i:i + 4]) for i in range(0, len(parts), 4)]
 
 
 def import_events_native(json_path: str, db_path: str, app_id: int,

@@ -251,6 +251,24 @@ class LEvents(abc.ABC):
         reversed: bool = False,
     ) -> Iterable[Event]: ...
 
+    def aggregate_properties_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        required: Optional[list] = None,
+    ):
+        """Pushed-down `$set/$unset/$delete` fold. Returns
+        dict[entity_id, (fields_dict, first_updated, last_updated)], or
+        None meaning "no pushdown here — use the per-event Python fold"
+        (the default for backends without a SQL pushdown; see
+        `storage/sqlite.py` for the real implementation and
+        `data/store.py::EventStore.aggregate_properties` for the
+        fallback chain)."""
+        return None
+
     def find_columnar(
         self,
         app_id: int,

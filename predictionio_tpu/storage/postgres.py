@@ -336,6 +336,22 @@ class PostgresBackend(SQLiteBackend):
     def _native_scan_path(self):
         return None  # the C++ reader is sqlite-only; use the SQL tier
 
+    # -- property-aggregation pushdown dialect hooks ----------------------
+    def _agg_json_each(self, tbl: str) -> str:
+        # `json` (not jsonb): duplicate keys and document order are
+        # preserved, matching json.loads' last-wins via the ordinality
+        # tiebreak; ordinality stands in for sqlite's je.id
+        return (f"json_each(({tbl}.properties)::json) "
+                "WITH ORDINALITY AS je(key, value, id)")
+
+    def _agg_value_expr(self) -> str:
+        # the json type keeps the ORIGINAL value text — exact for every
+        # type incl. 17-digit reals, so no bail corner on this dialect
+        return "je.value::text"
+
+    def _agg_group_object(self) -> str:
+        return "json_object_agg(w.k, (w.jv)::json)::text"
+
     def _cursor(self):
         backend = self
 

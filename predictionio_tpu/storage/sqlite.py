@@ -10,6 +10,7 @@ and the single writer from blocking each other.
 from __future__ import annotations
 
 import json
+import logging
 import sqlite3
 import threading
 import uuid
@@ -28,6 +29,8 @@ from predictionio_tpu.storage.base import (
     EvaluationInstance,
     Model,
 )
+
+log = logging.getLogger(__name__)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS apps (
@@ -229,6 +232,29 @@ class SQLiteBackend(base.StorageBackend):
         if self.path == ":memory:" or self.path.startswith("file:"):
             return None
         return self.path
+
+    # -- property-aggregation pushdown dialect hooks ----------------------
+    def _agg_json_each(self, tbl: str) -> str:
+        """Table-valued join clause exploding `{tbl}.properties` into one
+        row per top-level key, exposing je.key / je.value / je.id (id =
+        document order, the duplicate-key tiebreak)."""
+        return f"json_each({tbl}.properties) je"
+
+    def _agg_value_expr(self) -> str:
+        """JSON text of je's value, type-exact: booleans as true/false
+        (json_quote would give 1/0), reals re-extracted through the `->`
+        operator for shortest-roundtrip precision (json_quote renders
+        %.15g, dropping the 16th/17th digit). `-> fullkey` is NULL for
+        keys containing '"' or '\\' (sqlite's path parser rejects its own
+        escaping) — the query surfaces that as nbail > 0 and the caller
+        falls back to the per-event Python fold rather than lose a ULP."""
+        return ("CASE je.type WHEN 'real' THEN s.properties -> je.fullkey "
+                "WHEN 'true' THEN 'true' WHEN 'false' THEN 'false' "
+                "ELSE json_quote(je.value) END")
+
+    def _agg_group_object(self) -> str:
+        """Aggregate winners (w.k, w.jv JSON text) into one JSON object."""
+        return "json_group_object(w.k, json(w.jv))"
 
     # repository accessors
     def apps(self) -> "SQLiteApps":
@@ -887,3 +913,177 @@ class SQLiteLEvents(base.LEvents):
                 sql, [*event_names, *value_params, *where_params]).fetchall()
         return columns_from_numeric_rows(
             rows, entity_uniques, target_uniques, event_names)
+
+    def aggregate_properties_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        required: Optional[list] = None,
+    ):
+        """Pushed-down `$set/$unset/$delete` fold (the
+        «aggregateProperties» HBase-scan role [U], SURVEY.md §2.2) — the
+        property-path sibling of `find_columnar`. No per-EVENT Python
+        object at any scale; the host parses one JSON object per
+        surviving ENTITY. Three tiers, identical results:
+
+        - C++ reader (native/pio_aggprops.cpp): streams rows once via
+          the sqlite3 C API, folds with raw JSON value spans, hands back
+          a packed per-entity blob (file-backed DBs).
+        - Pure SQL: window functions assign a (event_time,
+          creation_time) sequence, `json_each` explodes $set/$unset
+          bags, latest-set-wins per (entity, key) with $unset/$delete
+          tombstones resolved by sequence comparison, and
+          `json_group_object` re-assembles each entity server-side.
+          The `required` filter is pushed into the query.
+        - Returns None when neither tier can run (no toolchain AND a
+          dialect/JSON corner — e.g. float-valued keys containing '"',
+          where sqlite's `-> fullkey` extraction fails); the caller
+          falls back to the per-event Python fold, which is the
+          semantics oracle both tiers are tested against bit-for-bit.
+
+        Returns dict[entity_id, (fields_dict, first_updated,
+        last_updated)] or None.
+        """
+        b = self._b
+        clauses = ["app_id=?"]
+        params: list = [app_id]
+        if channel_id is None:
+            clauses.append("channel_id IS NULL")
+        else:
+            clauses.append("channel_id=?")
+            params.append(channel_id)
+        if start_time is not None:
+            clauses.append("event_time>=?")
+            params.append(format_time(start_time))
+        if until_time is not None:
+            clauses.append("event_time<?")
+            params.append(format_time(until_time))
+        if entity_type is not None:
+            clauses.append("entity_type=?")
+            params.append(entity_type)
+        clauses.append("event IN ('$set','$unset','$delete')")
+        where = " AND ".join(clauses)
+
+        native_path = b._native_scan_path()
+        if native_path is not None:
+            from predictionio_tpu import native as native_mod
+
+            raw_sql = (
+                "SELECT entity_id, event, properties, event_time "
+                f"FROM events WHERE {where} "
+                "ORDER BY event_time, creation_time"
+            )
+            rows = native_mod.agg_props_native(
+                native_path, raw_sql, params, required)
+            if rows is not None:
+                out = self._agg_rows_to_dict(rows)
+                if out is not None:
+                    return out
+
+        # dedupe: the oracle's `all(k in p for k in required)` is
+        # set-semantics, but the HAVING below counts DISTINCT winner rows
+        # — a duplicated required key (e.g. labelAttribute repeated in
+        # attributes) would make COUNT(*) == len(required) unsatisfiable
+        # and silently drop every entity
+        req = list(dict.fromkeys(required or []))
+        req_cte = ""
+        req_join = ""
+        req_params: list = []
+        if req:
+            # winners has at most one row per (entity, key), so a plain
+            # COUNT suffices; an INNER JOIN keeps only complete entities
+            marks = ",".join("?" * len(req))
+            req_cte = (
+                ", reqok AS ("
+                f"  SELECT w.entity_id FROM winners w WHERE w.k IN ({marks})"
+                "  GROUP BY w.entity_id HAVING COUNT(*) = ?"
+                ")"
+            )
+            req_join = " JOIN reqok ON e.entity_id=reqok.entity_id"
+            req_params = [*req, len(req)]
+        sql = (
+            "WITH ev AS MATERIALIZED ("
+            "  SELECT entity_id, event, properties, event_time,"
+            "         row_number() OVER (ORDER BY event_time, creation_time)"
+            "           AS seq"
+            f"  FROM events WHERE {where}"
+            # tombstone resolution as ONE window pass: a join against a
+            # per-entity MAX($delete seq) table nested-loops here (sqlite
+            # doesn't auto-index that join shape — measured quadratic at
+            # 2M events), while the window is one sort
+            "), live AS MATERIALIZED ("
+            "  SELECT entity_id, event, properties, event_time, seq FROM ("
+            "    SELECT ev.*, MAX(CASE WHEN event='$delete' THEN seq END)"
+            "           OVER (PARTITION BY entity_id) AS dseq FROM ev)"
+            "  WHERE dseq IS NULL OR seq > dseq"
+            "), ent AS ("
+            "  SELECT entity_id, MIN(seq) AS cseq, MIN(event_time) AS first_up"
+            "  FROM live WHERE event='$set' GROUP BY entity_id"
+            "), lastu AS ("
+            "  SELECT l.entity_id, MAX(l.event_time) AS last_up"
+            "  FROM live l JOIN ent e ON l.entity_id=e.entity_id"
+            "  WHERE l.event='$set' OR (l.event='$unset' AND l.seq > e.cseq)"
+            "  GROUP BY l.entity_id"
+            "), setkv AS MATERIALIZED ("
+            f"  SELECT s.entity_id, je.key AS k, s.seq AS seq, je.id AS nid,"
+            f"         {b._agg_value_expr()} AS jv"
+            f"  FROM live s, {b._agg_json_each('s')}"
+            "  WHERE s.event='$set'"
+            "), unsetk AS ("
+            "  SELECT u.entity_id, je.key AS k, MAX(u.seq) AS useq"
+            f"  FROM live u, {b._agg_json_each('u')}"
+            "  WHERE u.event='$unset'"
+            "  GROUP BY u.entity_id, je.key"
+            "), ranked AS ("
+            "  SELECT entity_id, k, jv, seq,"
+            "         row_number() OVER (PARTITION BY entity_id, k"
+            "                            ORDER BY seq DESC, nid DESC) AS rn"
+            "  FROM setkv"
+            "), winners AS MATERIALIZED ("
+            "  SELECT r.entity_id, r.k, r.jv, r.seq"
+            "  FROM ranked r LEFT JOIN unsetk un"
+            "    ON r.entity_id=un.entity_id AND r.k=un.k"
+            "  WHERE r.rn=1 AND (un.useq IS NULL OR un.useq < r.seq)"
+            "), bail AS ("
+            "  SELECT COUNT(*) AS nbail FROM setkv WHERE jv IS NULL"
+            "), folded AS ("
+            f"  SELECT w.entity_id, {b._agg_group_object()} AS js"
+            "  FROM winners w GROUP BY w.entity_id"
+            f"){req_cte} "
+            "SELECT e.entity_id, e.first_up, l.last_up,"
+            "       COALESCE(f.js, '{}'), b.nbail "
+            "FROM ent e JOIN lastu l ON e.entity_id=l.entity_id"
+            " LEFT JOIN folded f ON e.entity_id=f.entity_id"
+            f" CROSS JOIN bail b{req_join} ORDER BY e.entity_id"
+        )
+        try:
+            with b._cursor() as cur:
+                rows = cur.execute(sql, [*params, *req_params]).fetchall()
+        except Exception as e:  # dialect/JSON corner → per-event fallback
+            log.info("aggregate pushdown failed (%s: %s) — per-event "
+                     "Python fallback", type(e).__name__, e)
+            return None
+        if rows and rows[0][4]:
+            log.info("aggregate pushdown: %d un-extractable real value(s) "
+                     "(key contains '\"' or '\\\\') — per-event Python "
+                     "fallback", rows[0][4])
+            return None
+        return self._agg_rows_to_dict([tuple(r)[:4] for r in rows])
+
+    @staticmethod
+    def _agg_rows_to_dict(rows):
+        """(entity_id, first_text, last_text, json_text) rows → the
+        wrapper's result dict; None on undecodable JSON (→ fallback)."""
+        out = {}
+        try:
+            for eid, first, last, js in rows:
+                out[eid] = (json.loads(js), parse_time(first),
+                            parse_time(last))
+        except (ValueError, TypeError) as e:
+            log.warning("aggregate pushdown: bad folded payload (%s) — "
+                        "per-event Python fallback", e)
+            return None
+        return out

@@ -1,0 +1,408 @@
+"""Pushed-down $set/$unset/$delete aggregation — fidelity vs the
+per-event Python fold.
+
+The columnar property read (storage/sqlite.py::aggregate_properties_columnar;
+C++ tier in native/pio_aggprops.cpp) is the property-path sibling of
+find_columnar, closing the «aggregateProperties» HBase-scan role [U]
+(SURVEY.md §2.2, §3.1) for the shape the Classification / E-Commerce /
+Lead Scoring templates read at train time. The per-event fold
+(data/datamap.py::aggregate_properties) is the semantics oracle: every
+test here asserts the pushdown tiers reproduce it exactly — values,
+value TYPES (bool is not 1, 1.0 is not 1), first/last update times,
+tombstone ordering, and the `required` filter.
+"""
+
+import datetime as dt
+import random
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, aggregate_properties
+from predictionio_tpu.data.events import Event, format_time
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _ev(i, kind, eid, props, entity_type="user"):
+    return Event(
+        event=kind, entity_type=entity_type, entity_id=eid,
+        properties=DataMap(props),
+        event_time=T0 + dt.timedelta(seconds=i),
+        creation_time=T0 + dt.timedelta(seconds=i, microseconds=1),
+    )
+
+
+@pytest.fixture()
+def file_backend(tmp_path):
+    b = SQLiteBackend(str(tmp_path / "agg.db"))
+    app_id = b.apps().insert(App(id=None, name="AggApp"))
+    return b, app_id
+
+
+def _oracle(le, app_id, required=None, **kw):
+    props = aggregate_properties(
+        le.find(app_id=app_id,
+                event_names=["$set", "$unset", "$delete"], **kw))
+    if required:
+        props = {eid: p for eid, p in props.items()
+                 if all(k in p for k in required)}
+    return props
+
+
+def _assert_matches(got, oracle):
+    """Pushdown result (fields, first, last) vs oracle PropertyMaps —
+    exact, including value types."""
+    assert got is not None, "pushdown unexpectedly fell back"
+    assert set(got) == set(oracle)
+    for eid, (fields, first, last) in got.items():
+        o = oracle[eid]
+        assert fields == o.to_dict(), eid
+        for k, v in fields.items():
+            assert type(v) is type(o.to_dict()[k]), (eid, k, v)
+        assert first == o.first_updated, eid
+        assert last == o.last_updated, eid
+
+
+def _both_tiers(b, app_id, required=None, **kw):
+    """Run the C++ tier (file DBs with a toolchain) and the SQL tier on
+    the same backend; yield each non-None result."""
+    le = b.events()
+    out = []
+    native_res = le.aggregate_properties_columnar(
+        app_id=app_id, required=required, **kw)
+    if native_res is not None:
+        out.append(("native-or-sql", native_res))
+    try:
+        b._native_scan_path = lambda: None  # force the SQL tier
+        sql_res = le.aggregate_properties_columnar(
+            app_id=app_id, required=required, **kw)
+    finally:
+        del b.__dict__["_native_scan_path"]
+    if sql_res is not None:
+        out.append(("sql", sql_res))
+    assert out, "no pushdown tier ran at all"
+    return out
+
+
+class TestFidelity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_random_streams_match_python_fold(self, file_backend, seed):
+        """Randomized $set/$unset/$delete streams over tricky keys and
+        values (17-digit floats, bools, null, nested, unicode/control
+        keys) — both tiers reproduce the Python fold exactly."""
+        b, app_id = file_backend
+        rnd = random.Random(seed)
+        keys = ["a", "b", "price", "né\t", "weird key", "0"]
+        vals = [42, 0.1234567890123456789, 's"x\\', True, False, None,
+                {"n": [1, 2.5]}, [], 9007199254740993, 1.0, -0.0,
+                rnd.random(), "", "é "]
+        evs = []
+        for i in range(300):
+            kind = rnd.choices(["$set", "$unset", "$delete"], [8, 3, 1])[0]
+            if kind == "$set":
+                props = {rnd.choice(keys): rnd.choice(vals)
+                         for _ in range(rnd.randrange(0, 4))}
+            elif kind == "$unset":
+                props = {rnd.choice(keys): None
+                         for _ in range(rnd.randrange(0, 3))}
+            else:
+                props = {}
+            evs.append(_ev(i, kind, f"u{rnd.randrange(10)}", props))
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        for name, got in _both_tiers(b, app_id, entity_type="user"):
+            _assert_matches(got, oracle)
+
+    def test_delete_recreate_fresh_first_updated(self, file_backend):
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$set", "u1", {"a": 1}),
+            _ev(1, "$delete", "u1", {}),
+            _ev(2, "$set", "u1", {"b": 2}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].first_updated == T0 + dt.timedelta(seconds=2)
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+            assert got["u1"][0] == {"b": 2}
+
+    def test_unset_touches_last_updated_even_with_absent_keys(
+            self, file_backend):
+        """$unset of keys the entity never had (or an empty bag) still
+        stamps last_updated — the Python fold's exact rule."""
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$set", "u1", {"a": 1}),
+            _ev(5, "$unset", "u1", {"never_there": None}),
+            _ev(7, "$unset", "u1", {}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].last_updated == T0 + dt.timedelta(seconds=7)
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_unset_before_create_is_full_noop(self, file_backend):
+        """$unset (or post-$delete $unset) on a non-existent entity
+        neither creates it nor moves last_updated."""
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$unset", "ghost", {"a": None}),
+            _ev(1, "$set", "u1", {"a": 1}),
+            _ev(2, "$delete", "u1", {}),
+            _ev(3, "$unset", "u1", {"a": None}),
+            _ev(4, "$set", "u1", {"a": 5}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert set(oracle) == {"u1"}
+        assert oracle["u1"].first_updated == T0 + dt.timedelta(seconds=4)
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_unset_then_reset_key_survives(self, file_backend):
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$set", "u1", {"a": 1, "b": 2}),
+            _ev(1, "$unset", "u1", {"a": None}),
+            _ev(2, "$set", "u1", {"a": 3}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].to_dict() == {"a": 3, "b": 2}
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_all_keys_unset_keeps_empty_entity(self, file_backend):
+        """Unsetting every key leaves an EMPTY PropertyMap — the entity
+        still exists (matches the fold: state[eid] stays, just empty)."""
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$set", "u1", {"a": 1}),
+            _ev(1, "$unset", "u1", {"a": None}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].to_dict() == {}
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_time_window_and_channel_filters(self, file_backend):
+        b, app_id = file_backend
+        from predictionio_tpu.storage.base import Channel
+
+        ch_id = b.channels().insert(
+            Channel(id=None, name="side", app_id=app_id))
+        evs = [_ev(i, "$set", "u1", {"k": i}) for i in range(10)]
+        b.events().insert_batch(evs, app_id)
+        b.events().insert_batch([_ev(50, "$set", "uC", {"c": 1})],
+                                app_id, ch_id)
+        kw = dict(start_time=T0 + dt.timedelta(seconds=2),
+                  until_time=T0 + dt.timedelta(seconds=7))
+        oracle = _oracle(b.events(), app_id, **kw)
+        assert oracle["u1"].to_dict() == {"k": 6}
+        assert oracle["u1"].first_updated == T0 + dt.timedelta(seconds=2)
+        for _, got in _both_tiers(b, app_id, **kw):
+            _assert_matches(got, oracle)
+        # channel isolation
+        ch_oracle = {"uC"}
+        got = b.events().aggregate_properties_columnar(
+            app_id=app_id, channel_id=ch_id)
+        assert got is not None and set(got) == ch_oracle
+
+    def test_required_filter_with_duplicate_keys(self, file_backend):
+        """required with a repeated key (the classification template can
+        produce attributes + labelAttribute overlaps) must behave like
+        the oracle's set-semantics `all(k in p)`, not demand two winner
+        rows for one key."""
+        b, app_id = file_backend
+        b.events().insert_batch(
+            [_ev(0, "$set", "u1", {"a": 1, "lbl": 0}),
+             _ev(1, "$set", "u2", {"a": 2})], app_id)
+        req = ["a", "lbl", "lbl"]
+        oracle = _oracle(b.events(), app_id, required=req)
+        assert set(oracle) == {"u1"}
+        for _, got in _both_tiers(b, app_id, required=req):
+            _assert_matches(got, oracle)
+
+    def test_required_filter_counts_null_values(self, file_backend):
+        """required=[k] keeps entities whose k is present even when its
+        VALUE is null (`k in p`, not truthiness)."""
+        b, app_id = file_backend
+        evs = [
+            _ev(0, "$set", "u1", {"a": None, "b": 1}),
+            _ev(1, "$set", "u2", {"b": 2}),
+        ]
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id, required=["a"])
+        assert set(oracle) == {"u1"}
+        for _, got in _both_tiers(b, app_id, required=["a"]):
+            _assert_matches(got, oracle)
+
+
+class TestCorners:
+    def test_duplicate_keys_last_wins(self, file_backend):
+        """Raw rows with duplicate JSON keys (a non-Python writer could
+        store them): json.loads keeps the last — so must both tiers."""
+        b, app_id = file_backend
+        ts = format_time(T0)
+        with b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO events (id, app_id, channel_id, event, "
+                "entity_type, entity_id, properties, event_time, tags, "
+                "creation_time) VALUES (?,?,NULL,?,?,?,?,?,?,?)",
+                ["dup", app_id, "$set", "user", "u1",
+                 '{"a":1,"a":2}', ts, "[]", ts])
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].to_dict() == {"a": 2}
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_lone_surrogate_key_roundtrips(self, file_backend):
+        """json.loads admits lone surrogates into keys; the C++ tier's
+        ASCII re-encoding must preserve them exactly."""
+        b, app_id = file_backend
+        ts = format_time(T0)
+        with b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO events (id, app_id, channel_id, event, "
+                "entity_type, entity_id, properties, event_time, tags, "
+                "creation_time) VALUES (?,?,NULL,?,?,?,?,?,?,?)",
+                ["ls", app_id, "$set", "user", "u1",
+                 '{"\\ud800k":"v"}', ts, "[]", ts])
+        oracle = _oracle(b.events(), app_id)
+        assert list(oracle["u1"].to_dict()) == ["\ud800k"]
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+
+    def test_quoted_key_float_sql_tier_bails(self, file_backend):
+        """A float under a key containing '\"' defeats sqlite's
+        `-> fullkey` extraction; the SQL tier must FALL BACK (None), not
+        return a 15-digit rounding of the value. The C++ tier handles it
+        exactly."""
+        from predictionio_tpu import native
+
+        b, app_id = file_backend
+        f = 0.1234567890123456789
+        b.events().insert_batch(
+            [_ev(0, "$set", "u1", {'k"q': f, "a": 1})], app_id)
+        oracle = _oracle(b.events(), app_id)
+        if native.native_available():
+            got = b.events().aggregate_properties_columnar(app_id=app_id)
+            _assert_matches(got, oracle)
+            assert got["u1"][0]['k"q'] == f
+        try:
+            b._native_scan_path = lambda: None
+            assert b.events().aggregate_properties_columnar(
+                app_id=app_id) is None
+        finally:
+            del b.__dict__["_native_scan_path"]
+
+    def test_nan_properties_native_exact_sql_bails(self, file_backend):
+        """json.dumps-style NaN is invalid JSON for sqlite's json_each →
+        the SQL tier falls back; the native splitter splices the raw
+        span and json.loads accepts it, matching the fold."""
+        import math
+
+        from predictionio_tpu import native
+
+        b, app_id = file_backend
+        ts = format_time(T0)
+        with b._cursor() as cur:
+            cur.execute(
+                "INSERT INTO events (id, app_id, channel_id, event, "
+                "entity_type, entity_id, properties, event_time, tags, "
+                "creation_time) VALUES (?,?,NULL,?,?,?,?,?,?,?)",
+                ["nan", app_id, "$set", "user", "u1",
+                 '{"x": NaN}', ts, "[]", ts])
+        if native.native_available():
+            got = b.events().aggregate_properties_columnar(app_id=app_id)
+            assert got is not None and math.isnan(got["u1"][0]["x"])
+        try:
+            b._native_scan_path = lambda: None
+            assert b.events().aggregate_properties_columnar(
+                app_id=app_id) is None
+        finally:
+            del b.__dict__["_native_scan_path"]
+
+    def test_memory_db_uses_sql_tier(self):
+        """:memory: databases can't be reopened by the C++ reader — the
+        SQL tier must serve them (not a fallback to per-event)."""
+        b = SQLiteBackend(":memory:")
+        app_id = b.apps().insert(App(id=None, name="M"))
+        b.events().insert_batch(
+            [_ev(0, "$set", "u1", {"a": True})], app_id)
+        got = b.events().aggregate_properties_columnar(app_id=app_id)
+        assert got is not None and got["u1"][0] == {"a": True}
+        assert got["u1"][0]["a"] is True
+
+
+def _file_storage(tmp_path, name):
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig)
+
+    src = SourceConfig(name="T", type="sqlite",
+                       path=str(tmp_path / f"{name}.db"))
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    return storage
+
+
+class TestEventStoreRouting:
+    def test_store_uses_pushdown_and_matches_fold(self, tmp_path,
+                                                  monkeypatch):
+        """EventStore.aggregate_properties routes through the pushdown
+        (spied) and returns PropertyMaps identical to the per-event
+        path."""
+        storage = _file_storage(tmp_path, "s")
+        b = storage._backend(storage.config.eventdata)
+        app_id = b.apps().insert(App(id=None, name="RouteApp"))
+        evs = [
+            _ev(0, "$set", "i1", {"cat": "a", "price": 9.5},
+                entity_type="item"),
+            _ev(1, "$set", "i2", {"cat": "b"}, entity_type="item"),
+            _ev(2, "$unset", "i1", {"price": None}, entity_type="item"),
+        ]
+        b.events().insert_batch(evs, app_id)
+        store = EventStore(storage)
+
+        calls = []
+        real = type(b.events()).aggregate_properties_columnar
+
+        def spy(self, *a, **k):
+            out = real(self, *a, **k)
+            calls.append(out is not None)
+            return out
+
+        monkeypatch.setattr(type(b.events()),
+                            "aggregate_properties_columnar", spy)
+        props = store.aggregate_properties("RouteApp", "item")
+        assert calls == [True]
+        # identical to the per-event path (PropertyMap equality is
+        # field equality; check times too)
+        monkeypatch.setattr(type(b.events()),
+                            "aggregate_properties_columnar",
+                            lambda self, *a, **k: None)
+        slow = store.aggregate_properties("RouteApp", "item")
+        assert set(props) == set(slow)
+        for eid in props:
+            assert props[eid] == slow[eid]
+            assert props[eid].first_updated == slow[eid].first_updated
+            assert props[eid].last_updated == slow[eid].last_updated
+
+    def test_store_required_pushdown(self, tmp_path):
+        storage = _file_storage(tmp_path, "s2")
+        b = storage._backend(storage.config.eventdata)
+        app_id = b.apps().insert(App(id=None, name="ReqApp"))
+        b.events().insert_batch(
+            [_ev(0, "$set", "i1", {"cat": "a"}, entity_type="item"),
+             _ev(1, "$set", "i2", {"other": 1}, entity_type="item")],
+            app_id)
+        store = EventStore(storage)
+        props = store.aggregate_properties("ReqApp", "item",
+                                           required=["cat"])
+        assert set(props) == {"i1"}
