@@ -48,11 +48,21 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         return self.rfile.read(length) if length else b""
 
 
+class _Server(ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5: a burst of >5
+    # simultaneous connects (e.g. 32 load clients opening keep-alive
+    # connections at once) gets RST instead of queued. 128 matches what
+    # production WSGI servers default to; the kernel caps it at
+    # net.core.somaxconn anyway.
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class HttpService:
     """Owns a ThreadingHTTPServer + background thread lifecycle."""
 
     def __init__(self, ip: str, port: int, handler_cls: Type[BaseHTTPRequestHandler]):
-        self.httpd = ThreadingHTTPServer((ip, port), handler_cls)
+        self.httpd = _Server((ip, port), handler_cls)
         self._thread: Optional[threading.Thread] = None
 
     @property
