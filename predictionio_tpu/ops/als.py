@@ -291,6 +291,14 @@ _CHUNK_BUDGET_BYTES = 1 << 30
 _BUCKET_CACHE_VERSION = 1
 
 
+def _persist_rank() -> int:
+    """The checkpoint-writing rank (PIO_PERSIST_RANK, default 0) — see
+    parallel/distributed.py::persist_rank."""
+    from predictionio_tpu.parallel.distributed import persist_rank
+
+    return persist_rank()
+
+
 def _bucket_cache_keep() -> int:
     """Fingerprints retained per cache dir. The dir is shared by every
     ALS-family template on the host, so hosts alternating more than this
@@ -1087,12 +1095,13 @@ def als_train(
         if compute_rmse:
             rmse_history.extend(float(x) for x in np.asarray(rmses))
         # multi-host: all ranks restore (consistent global start state) and
-        # all ranks join the host-gather collective, but only process 0
-        # writes — N ranks racing save/keep_only on a shared checkpoint
-        # dir could interleave delete-vs-write mid-step
+        # all ranks join the host-gather collective, but only the persist
+        # rank (PIO_PERSIST_RANK, default 0) writes — N ranks racing
+        # save/keep_only on a shared checkpoint dir could interleave
+        # delete-vs-write mid-step
         if manager:
             host_copies = uf_host, vf_host = factors_to_host()
-            if jax.process_index() == 0:
+            if jax.process_index() == _persist_rank():
                 if not first_save_done:
                     manager.keep_only(restore_step)
                     first_save_done = True
@@ -1116,8 +1125,8 @@ def als_train(
                 f"factor sharding but trained factors came back {spec!r}")
         log.info("als_train: training factors model-sharded %s over mesh %s",
                  tuple(spec), dict(mesh.shape))
-    if (manager and jax.process_index() == 0 and not first_save_done
-            and restore_step is not None):
+    if (manager and jax.process_index() == _persist_rank()
+            and not first_save_done and restore_step is not None):
         # fully-resumed run (no new saves): still purge stale steps now —
         # the restore point is on disk, so there's no crash window here.
         # (restore_step=None with no saves means a degenerate run, e.g.

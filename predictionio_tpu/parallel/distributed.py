@@ -46,6 +46,25 @@ def parse_mesh_shape(spec: str) -> dict[str, int]:
     return out
 
 
+def persist_rank() -> int:
+    """The rank that persists models/instances and writes checkpoints
+    (`PIO_PERSIST_RANK`, default 0). Decouples the PERSISTER from the
+    COORDINATOR: jax.distributed pins the coordination service to
+    process 0, but the host with fast storage access need not be the
+    coordinator host — e.g. rank 0 on a control node, models written by
+    the rank colocated with the database. Every rank still trains (SPMD)
+    and joins the pre-persist host-gather collectives; only this rank
+    writes."""
+    import jax
+
+    r = int(os.environ.get("PIO_PERSIST_RANK", "0"))
+    n = jax.process_count()
+    if not 0 <= r < n:
+        raise ValueError(
+            f"PIO_PERSIST_RANK={r} out of range for a {n}-process world")
+    return r
+
+
 def initialize_from_env() -> bool:
     """Bring up `jax.distributed` when the PIO_* env says this is a
     multi-host run; no-op (False) otherwise. Idempotent."""

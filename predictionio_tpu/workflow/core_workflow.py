@@ -77,21 +77,29 @@ class CoreWorkflow:
         mark instance COMPLETED.
 
         Multi-host: every rank trains (the jitted step is SPMD and all
-        ranks must participate in the collectives), but only process 0
+        ranks must participate in the collectives), but only ONE rank
         persists — the reference has exactly one Spark driver writing the
         EngineInstance row; N ranks each inserting their own row would
-        leave `pio deploy`'s latest-COMPLETED lookup racing N instances."""
+        leave `pio deploy`'s latest-COMPLETED lookup racing N instances.
+        The persisting rank is `PIO_PERSIST_RANK` (default 0), which may
+        differ from the coordinator (always process 0 in jax) — see
+        parallel/distributed.py::persist_rank."""
         import jax
 
-        if jax.process_count() > 1 and jax.process_index() != 0:
+        from predictionio_tpu.parallel.distributed import persist_rank
+
+        p_rank = persist_rank() if jax.process_count() > 1 else 0
+        if jax.process_count() > 1 and jax.process_index() != p_rank:
             models = engine.train(ctx, engine_params, sanity_check=sanity_check)
             log.info("CoreWorkflow.run_train: rank %d trained %d model(s); "
-                     "rank 0 persists", jax.process_index(), len(models))
+                     "rank %d persists", jax.process_index(), len(models),
+                     p_rank)
             # WORKER_DONE ≠ COMPLETED: this rank did its SPMD share, but
-            # whether a servable instance exists is rank 0's verdict —
-            # orchestrators must watch rank 0 for the persisted id
+            # whether a servable instance exists is the persist rank's
+            # verdict — orchestrators must watch it for the persisted id
             return EngineInstance(
-                id=f"(worker rank {jax.process_index()}; rank 0 persists)",
+                id=f"(worker rank {jax.process_index()}; "
+                   f"rank {p_rank} persists)",
                 status="WORKER_DONE", start_time=_now(), end_time=_now(),
                 engine_id=variant.id, engine_version=engine_version,
                 engine_variant=variant.id,

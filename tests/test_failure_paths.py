@@ -489,6 +489,29 @@ class TestElasticRecovery:
         np.testing.assert_allclose(got_uf, ref_uf, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(got_if, ref_if, rtol=1e-4, atol=1e-5)
 
+    def test_eight_process_rank_death_fails_world_fast(self, tmp_path):
+        """The failure matrix at EIGHT processes (VERDICT r3 #7): rank 5
+        of an 8-rank CLI train hard-dies at the first epoch boundary;
+        all seven survivors must exit nonzero in bounded time — no hangs
+        at the doubled world size."""
+        db = tmp_path / "oct.db"
+        _seed_world_db(db, "OctFailApp")
+        ej = tmp_path / "engine.json"
+        _world_engine_json(ej, "OctFailApp", "octfail")
+        from tests.test_distributed_multihost import _run_world_train
+
+        rcs, outs = _run_world_train(
+            ej, db, tmp_path, n_ranks=8, dev_per_rank=1,
+            extra_env={"PIO_LOG_LEVEL": "INFO",
+                       "PIO_COORDINATOR_TIMEOUT_S": "60"},
+            faults_by_rank={5: "als.epoch_boundary:1"},
+            extra_args=("--checkpoint-dir", str(tmp_path / "ckpt"),
+                        "--checkpoint-every", "1"),
+            check=False, timeout=600)
+        assert rcs[5] == 137, outs[5]
+        for pid in (0, 1, 2, 3, 4, 6, 7):
+            assert rcs[pid] != 0, f"rank {pid} exited 0: {outs[pid][-300:]}"
+
     def test_coordinator_death_releases_world(self, tmp_path):
         """Rank 0 hosts the jax.distributed coordinator AND is the only
         persisting rank; its death must fail every non-zero rank within

@@ -332,3 +332,32 @@ class TestConnectionPool:
         monkeypatch.setattr(postgres, "_load_driver", lambda: (driver, "fake"))
         with pytest.raises(ValueError, match="Cannot parse Postgres DSN"):
             PostgresBackend("postgres://hostonly")
+
+
+class TestAggregatePushdownDialect:
+    def test_agg_sql_is_postgres_spelled_and_falls_back_clean(
+            self, pg_backend):
+        """The PG aggregation pushdown emits Postgres spellings (json_each
+        WITH ORDINALITY, ::json casts, json_object_agg) that the
+        sqlite-backed fake driver cannot execute — the wrapper must catch
+        that and return None so EventStore falls back to the bit-exact
+        per-event fold. Shape-checks the dialect hooks; a real server
+        lights the fast path up."""
+        # dialect hooks produce PG spellings, not sqlite ones
+        assert "WITH ORDINALITY" in pg_backend._agg_json_each("s")
+        assert "::json" in pg_backend._agg_json_each("s")
+        assert pg_backend._agg_value_expr() == "je.value::text"
+        assert "json_object_agg" in pg_backend._agg_group_object()
+
+        import datetime as dt
+
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.events import Event
+
+        le = pg_backend.events()
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        le.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                        properties=DataMap({"a": 1}), event_time=t0,
+                        creation_time=t0), app_id=1)
+        # sqlite chokes on the PG SQL → clean None (no exception leak)
+        assert le.aggregate_properties_columnar(app_id=1) is None
