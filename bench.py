@@ -428,6 +428,89 @@ def _measure_map10(scale: str):
                             k=10, max_users=20_000)
 
 
+def bench_aggprops(n_events: int = 2_000_000, n_entities: int = 200_000,
+                   emit: bool = True):
+    """Property-aggregation tier A/B (VERDICT r3 #2's receipt,
+    reproducible): synth $set/$unset/$delete events into a temp sqlite
+    file, fold them through the C++ tier, the SQL pushdown, and the
+    per-event Python oracle; assert agreement on a sample; print one
+    JSON line. `bench.py --aggprops`."""
+    import datetime as dt
+    import random
+    import tempfile
+
+    from predictionio_tpu import native as native_mod
+    from predictionio_tpu.data.datamap import aggregate_properties
+    from predictionio_tpu.data.events import format_time
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+    tmp = tempfile.mkdtemp(prefix="pio_agg_bench_")
+    b = SQLiteBackend(os.path.join(tmp, "ev.db"))
+    app_id = b.apps().insert(App(id=None, name="AggBench"))
+    rnd = random.Random(1)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    kinds = rnd.choices(["$set", "$unset", "$delete"], [90, 8, 2],
+                        k=n_events)
+    with b._cursor() as cur:
+        rows = []
+        for i in range(n_events):
+            kind = kinds[i]
+            props = (
+                f'{{"cat":"c{rnd.randrange(50)}",'
+                f'"price":{rnd.random() * 100:.6f},'
+                f'"stock":{rnd.randrange(1000)}}}'
+                if kind == "$set" else
+                '{"stock":null}' if kind == "$unset" else "{}")
+            ts = format_time(t0 + dt.timedelta(microseconds=i))
+            rows.append((f"e{i}", app_id, kind, "item",
+                         f"u{rnd.randrange(n_entities)}", props, ts, "[]",
+                         ts))
+        cur.executemany(
+            "INSERT INTO events (id, app_id, channel_id, event, "
+            "entity_type, entity_id, properties, event_time, tags, "
+            "creation_time) VALUES (?,?,NULL,?,?,?,?,?,?,?)", rows)
+    le = b.events()
+
+    def timed(fn):
+        t = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t
+
+    got_cpp, t_cpp = timed(lambda: le.aggregate_properties_columnar(
+        app_id=app_id, entity_type="item"))
+    cpp_ok = got_cpp is not None and native_mod.native_available()
+    try:
+        b._native_scan_path = lambda: None  # force the SQL tier
+        got_sql, t_sql = timed(lambda: le.aggregate_properties_columnar(
+            app_id=app_id, entity_type="item"))
+    finally:
+        del b.__dict__["_native_scan_path"]
+    oracle, t_py = timed(lambda: aggregate_properties(le.find(
+        app_id=app_id, event_names=["$set", "$unset", "$delete"])))
+    for eid in random.Random(3).sample(list(oracle), min(50, len(oracle))):
+        for name, got in (("c++", got_cpp), ("sql", got_sql)):
+            if got is None:
+                continue
+            assert got[eid][0] == oracle[eid].to_dict(), (name, eid)
+    b.close()
+    record = {
+        "metric": f"aggregate_properties_{n_events // 1_000_000}m",
+        "value": round(t_cpp, 2) if cpp_ok else round(t_sql, 2),
+        "unit": "s",
+        "tier": "c++" if cpp_ok else "sql",
+        "cpp_s": round(t_cpp, 2) if cpp_ok else None,
+        "sql_s": round(t_sql, 2) if got_sql is not None else None,
+        "python_fold_s": round(t_py, 2),
+        "entities": len(oracle),
+        "vs_baseline": round(t_py / (t_cpp if cpp_ok else t_sql), 1),
+        "baseline": "per-event Python fold (find() -> Event -> dict)",
+    }
+    if emit:
+        print(json.dumps(record))
+    return record
+
+
 def bench_north_star(scale: str = "20m", full: bool = True):
     """Rank-64 ALS epoch time at 2M/20M scale (the BASELINE.json north
     star), on the planted-factor dataset the quality-parity runs use, so
@@ -648,6 +731,9 @@ if __name__ == "__main__":
     ap.add_argument("--evalgrid", action="store_true",
                     help="4-point λ grid as one device program vs "
                          "sequential trains (ops/als_grid A/B)")
+    ap.add_argument("--aggprops", action="store_true",
+                    help="property-aggregation tier A/B at 2M events "
+                         "(C++ / SQL pushdown / per-event Python fold)")
     ap.add_argument("--scale", choices=sorted(CPU_REF_EPOCH_S),
                     default=None, help="dataset scale (default: 20m for "
                     "the north star, 2m for --evalgrid)")
@@ -672,5 +758,7 @@ if __name__ == "__main__":
         main()
     elif args.evalgrid:
         bench_eval_grid(args.scale or "2m")
+    elif args.aggprops:
+        bench_aggprops()
     else:
         bench_north_star(args.scale or "20m", full=not args.fast)

@@ -295,7 +295,14 @@ def agg_props_native(db_path: str, sql: str, params: list,
         buf = ctypes.create_string_buffer(max(nbytes.value, 1))
         if lib.pio_agg_fill(handle, buf) != 0:
             return None
-        parts = buf.raw[:nbytes.value].decode().split("\0")[:-1]
+        try:
+            parts = buf.raw[:nbytes.value].decode().split("\0")[:-1]
+        except UnicodeDecodeError as e:
+            # stored TEXT that isn't valid UTF-8 (foreign writer):
+            # fall back to the Python fold rather than crash the read
+            log.warning("native aggprops: undecodable payload (%s) — "
+                        "Python fallback", e)
+            return None
     finally:
         lib.pio_agg_free(handle)
     if len(parts) != 4 * n.value:
