@@ -141,19 +141,25 @@ class EventStore:
 
         Reads through the pushed-down columnar fold when the backend has
         one (C++ / SQL tiers in `storage/sqlite.py` — no per-event Python
-        object; ~13× the per-event path at 2M property events, see
+        object; 11.3× the per-event path at 2M property events, see
         BASELINE.md), falling back to the per-event
         `data/datamap.py::aggregate_properties` fold, which is the
-        semantics oracle the pushdown tiers are tested against."""
+        semantics oracle the pushdown tiers are tested against.
+        `PIO_AGG_PUSHDOWN=0` forces the per-event fold (ops escape
+        hatch + the A/B lever the measured receipts use)."""
+        import os
+
         storage, app_id, channel_id = self._resolve(app_name, channel_name)
-        agg = storage.l_events().aggregate_properties_columnar(
-            app_id=app_id,
-            channel_id=channel_id,
-            start_time=start_time,
-            until_time=until_time,
-            entity_type=entity_type,
-            required=list(required) if required else None,
-        )
+        agg = None
+        if os.environ.get("PIO_AGG_PUSHDOWN", "1") != "0":
+            agg = storage.l_events().aggregate_properties_columnar(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                required=list(required) if required else None,
+            )
         if agg is not None:
             return {
                 eid: PropertyMap(fields, first_updated=first, last_updated=last)
