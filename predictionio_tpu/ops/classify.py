@@ -123,6 +123,62 @@ def naive_bayes_train(
     return NaiveBayesModel(np.asarray(log_prior), np.asarray(log_theta))
 
 
+@functools.lru_cache(maxsize=16)
+def _nb_fit_grid(n_classes: int):
+    import jax
+    import jax.numpy as jnp
+
+    def fit(x, y, w, smoothings):
+        # sufficient statistics ONCE (they don't depend on smoothing);
+        # the per-cell finish is a [G]-vmapped elementwise log transform
+        onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None]
+        class_counts = onehot.sum(0)  # [C]
+        feat_sums = onehot.T @ x  # [C, D]
+        n = w.sum()
+        d = x.shape[1]
+
+        def finish(s):
+            log_prior = jnp.log(class_counts + s) - jnp.log(
+                n + n_classes * s)
+            log_theta = jnp.log(feat_sums + s) - jnp.log(
+                feat_sums.sum(-1, keepdims=True) + d * s)
+            return log_prior, log_theta
+
+        return jax.vmap(finish)(smoothings)
+
+    return jax.jit(fit)
+
+
+def naive_bayes_train_grid(
+    features: np.ndarray,
+    labels: np.ndarray,
+    n_classes: int,
+    smoothings,
+    mesh=None,
+) -> "list[NaiveBayesModel]":
+    """N smoothing (λ) grid cells as ONE device program (SURVEY.md §2.6
+    strategy 4's TPU-native form, extended beyond the ALS flagship): the
+    one-hot count matmul — the only part that touches the data — runs
+    once, and the λ-dependent log transforms vmap over a traced [G]
+    axis. Per-cell results match `naive_bayes_train` exactly."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    x = np.ascontiguousarray(features, dtype=np.float32)
+    y = np.ascontiguousarray(labels, dtype=np.int32)
+    if np.any(x < 0):
+        raise ValueError("multinomial NB requires non-negative features")
+    x, y, w = _pad_batch(x, y, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
+    x, y, w = _shard_examples(mesh, x, y, w)
+    s = jnp.asarray([float(v) for v in smoothings], dtype=jnp.float32)
+    log_prior, log_theta = _nb_fit_grid(n_classes)(x, y, w, s)
+    lp, lt = np.asarray(log_prior), np.asarray(log_theta)
+    return [NaiveBayesModel(lp[g], lt[g]) for g in range(len(s))]
+
+
 @functools.lru_cache(maxsize=32)
 def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
     import jax
@@ -153,6 +209,86 @@ def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
         return params, losses
 
     return jax.jit(fit)
+
+
+@functools.lru_cache(maxsize=16)
+def _logreg_fit_grid(n_classes: int, iterations: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    # optax.adam(lr) == scale_by_adam() then scale(-lr); keeping lr out
+    # of the transform lets it be a traced per-cell scalar under vmap.
+    # (-lr)·d == -(lr·d) exactly in IEEE, so cells match the sequential
+    # `_logreg_fit` bit for bit modulo vmap layout.
+    base = optax.scale_by_adam()
+
+    def fit_one(lr, reg, params0, x, y, w):
+        def loss_fn(params):
+            logits = x @ params["w"] + params["b"]
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            data = (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+            return data + 0.5 * reg * jnp.sum(params["w"] ** 2)
+
+        def step(carry, _):
+            params, state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state = base.update(grads, state, params)
+            params = jax.tree.map(lambda p, u: p - lr * u, params, updates)
+            return (params, state), loss
+
+        (params, _), losses = jax.lax.scan(
+            step, (params0, base.init(params0)), xs=None, length=iterations)
+        return params, losses
+
+    def run(lrs, regs, params0, x, y, w):
+        return jax.vmap(fit_one, in_axes=(0, 0, None, None, None, None))(
+            lrs, regs, params0, x, y, w)
+
+    return jax.jit(run)
+
+
+def logreg_train_grid(
+    features: np.ndarray,
+    labels: np.ndarray,
+    n_classes: int,
+    iterations: int,
+    learning_rates,
+    regs,
+    mesh=None,
+) -> "list[LogRegModel]":
+    """N (stepSize, regParam) grid cells as ONE device program: the
+    full-batch Adam scan vmaps over a traced [G] hyperparameter axis —
+    one compile, one dispatch, the sharded example matmuls batched
+    [G, N, D] on the MXU instead of re-dispatched per cell. `iterations`
+    must be shared (it sets the scan length — a static)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    x = np.ascontiguousarray(features, dtype=np.float32)
+    y = np.ascontiguousarray(labels, dtype=np.int32)
+    d = x.shape[1]
+    x, y, w = _pad_batch(x, y, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
+    x, y, w = _shard_examples(mesh, x, y, w)
+    params0 = {
+        "w": jnp.zeros((d, n_classes), dtype=jnp.float32),
+        "b": jnp.zeros((n_classes,), dtype=jnp.float32),
+    }
+    lrs = jnp.asarray([float(v) for v in learning_rates], jnp.float32)
+    rgs = jnp.asarray([float(v) for v in regs], jnp.float32)
+    params, losses = _logreg_fit_grid(n_classes, int(iterations))(
+        lrs, rgs, params0, x, y, w)
+    wts = np.asarray(params["w"])
+    bs = np.asarray(params["b"])
+    ls = np.asarray(losses)
+    return [
+        LogRegModel(weights=wts[g], bias=bs[g],
+                    loss_history=[float(v) for v in ls[g]])
+        for g in range(len(lrs))
+    ]
 
 
 def logreg_train(

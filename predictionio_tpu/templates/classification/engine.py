@@ -36,7 +36,9 @@ from predictionio_tpu.ops.classify import (
     LogRegModel,
     NaiveBayesModel,
     logreg_train,
+    logreg_train_grid,
     naive_bayes_train,
+    naive_bayes_train_grid,
 )
 
 log = logging.getLogger(__name__)
@@ -215,6 +217,20 @@ class NaiveBayesAlgorithm(Algorithm):
         x = _query_vector(query, model.attributes)
         return {"label": model.predict_label(x)}
 
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list]:
+        """A λ (smoothing) grid as ONE device program: the count matmul
+        runs once, the λ-dependent finish vmaps over [G]
+        (ops/classify.py::naive_bayes_train_grid — SURVEY.md §2.6
+        strategy 4's TPU-native form beyond the ALS flagship)."""
+        smoothings = [a.params.lambda_ for a in algos]
+        nbs = naive_bayes_train_grid(
+            pd.features, pd.label_idx, n_classes=len(pd.classes),
+            smoothings=smoothings, mesh=ctx.mesh)
+        return [NBServingModel(nb=nb, classes=pd.classes,
+                               attributes=pd.attributes) for nb in nbs]
+
 
 @dataclasses.dataclass
 class LRServingModel:
@@ -255,6 +271,24 @@ class LogisticRegressionAlgorithm(Algorithm):
     def predict(self, model: LRServingModel, query: Query) -> PredictedResult:
         x = _query_vector(query, model.attributes)
         return {"label": model.predict_label(x)}
+
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list]:
+        """A (stepSize, regParam) grid as ONE device program — the Adam
+        scan vmapped over a traced [G] hyperparameter axis. `iterations`
+        sets the scan length (a static), so mixed-iteration grids fall
+        back to sequential."""
+        iters = {a.params.iterations for a in algos}
+        if len(iters) != 1:
+            return None
+        lrs = logreg_train_grid(
+            pd.features, pd.label_idx, n_classes=len(pd.classes),
+            iterations=iters.pop(),
+            learning_rates=[a.params.stepSize for a in algos],
+            regs=[a.params.regParam for a in algos], mesh=ctx.mesh)
+        return [LRServingModel(lr=lr, classes=pd.classes,
+                               attributes=pd.attributes) for lr in lrs]
 
 
 class ClassificationEngine(EngineFactory):

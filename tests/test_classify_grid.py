@@ -1,0 +1,138 @@
+"""Grid-batched classification training (SURVEY.md §2.6 strategy 4's
+TPU-native form extended beyond the ALS flagship): N hyperparameter
+cells as ONE device program, per-cell results matching the sequential
+trainers."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.classify import (
+    logreg_train,
+    logreg_train_grid,
+    naive_bayes_train,
+    naive_bayes_train_grid,
+)
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.default_rng(5)
+    n, d, c = 1000, 6, 3
+    x = np.abs(rng.normal(size=(n, d))).astype(np.float32)
+    y = rng.integers(0, c, n).astype(np.int32)
+    return x, y, c
+
+
+class TestNBGrid:
+    def test_matches_sequential_per_cell(self, data):
+        x, y, c = data
+        smoothings = [0.1, 1.0, 5.0, 25.0]
+        grid = naive_bayes_train_grid(x, y, c, smoothings)
+        assert len(grid) == len(smoothings)
+        for s, m in zip(smoothings, grid):
+            ref = naive_bayes_train(x, y, c, smoothing=s)
+            np.testing.assert_allclose(m.log_prior, ref.log_prior,
+                                       rtol=1e-6, atol=1e-7)
+            np.testing.assert_allclose(m.log_theta, ref.log_theta,
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_negative_features_rejected(self, data):
+        x, y, c = data
+        with pytest.raises(ValueError, match="non-negative"):
+            naive_bayes_train_grid(-x, y, c, [1.0, 2.0])
+
+
+class TestLogRegGrid:
+    def test_matches_sequential_per_cell(self, data):
+        x, y, c = data
+        cells = [(0.5, 0.0), (0.1, 0.01), (0.05, 0.1), (0.2, 0.0)]
+        grid = logreg_train_grid(
+            x, y, c, iterations=25,
+            learning_rates=[lr for lr, _ in cells],
+            regs=[rg for _, rg in cells])
+        for (lr, rg), m in zip(cells, grid):
+            ref = logreg_train(x, y, c, iterations=25, learning_rate=lr,
+                               reg=rg)
+            np.testing.assert_allclose(m.weights, ref.weights,
+                                       rtol=2e-4, atol=1e-5)
+            np.testing.assert_allclose(m.bias, ref.bias,
+                                       rtol=2e-4, atol=1e-5)
+            np.testing.assert_allclose(m.loss_history, ref.loss_history,
+                                       rtol=2e-4, atol=1e-5)
+
+
+class TestEngineEvalGridRouting:
+    def _setup(self, memory_storage, algo):
+        from tests.test_classification_template import (
+            FACTORY, ingest_users, variant_dict)
+        from predictionio_tpu.workflow.workflow_utils import (
+            EngineVariant, extract_engine_params, get_engine)
+
+        ingest_users(memory_storage)
+        vd = variant_dict()
+        vd["datasource"]["params"]["evalK"] = 3
+        vd["algorithms"] = [algo]
+        variant = EngineVariant.from_dict(vd)
+        engine = get_engine(variant.engine_factory)
+        return engine, extract_engine_params(engine, variant)
+
+    @pytest.mark.parametrize("algo,param,values", [
+        ({"name": "naive", "params": {"lambda": 1.0}}, "lambda_",
+         [0.1, 1.0, 10.0]),
+        ({"name": "logisticregression",
+          "params": {"iterations": 20, "stepSize": 0.3}}, "stepSize",
+         [0.05, 0.3, 0.8]),
+    ])
+    def test_eval_grid_matches_sequential(self, memory_storage, algo,
+                                          param, values, monkeypatch):
+        """MetricEvaluator's grid path (Engine.eval_grid → the new
+        train_grid overrides) scores identically to the sequential
+        evaluator on a λ / stepSize grid."""
+        import dataclasses
+
+        from predictionio_tpu.controller import AverageMetric
+        from predictionio_tpu.controller.context import WorkflowContext
+        from predictionio_tpu.controller.evaluation import (
+            Evaluation, MetricEvaluator)
+
+        engine, base_ep = self._setup(memory_storage, algo)
+        name = base_ep.algorithm_params_list[0][0]
+        eps = []
+        for v in values:
+            p = dataclasses.replace(base_ep.algorithm_params_list[0][1],
+                                    **{param: v})
+            eps.append(dataclasses.replace(
+                base_ep, algorithm_params_list=[(name, p)]))
+
+        class Accuracy(AverageMetric):
+            def calculate(self, q, p, a):
+                return 1.0 if p["label"] == a["label"] else 0.0
+
+        class ClsEval(Evaluation):
+            pass
+
+        ClsEval.engine = engine
+        ClsEval.metric = Accuracy()
+
+        grid_calls = []
+        cls = type(engine.components(eps[0])[2][0][1])
+        real = cls.train_grid.__func__
+
+        def spy(c, ctx, pd, algos):
+            out = real(c, ctx, pd, algos)
+            grid_calls.append(out is not None)
+            return out
+
+        monkeypatch.setattr(cls, "train_grid", classmethod(spy))
+        ctx = WorkflowContext(storage=memory_storage, seed=0)
+        grid_res = MetricEvaluator.evaluate(ctx, ClsEval(), eps)
+        assert grid_calls and all(grid_calls), "train_grid never engaged"
+
+        # sequential arm: disable the batched path entirely
+        monkeypatch.setattr(cls, "train_grid",
+                            classmethod(lambda c, ctx, pd, algos: None))
+        seq_res = MetricEvaluator.evaluate(ctx, ClsEval(), eps)
+        grid_scores = [r.scores["Accuracy"] for r in grid_res.all_results]
+        seq_scores = [r.scores["Accuracy"] for r in seq_res.all_results]
+        np.testing.assert_allclose(grid_scores, seq_scores,
+                                   rtol=1e-6, atol=1e-9)
