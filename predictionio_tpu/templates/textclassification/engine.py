@@ -37,7 +37,9 @@ from predictionio_tpu.ops.classify import (
     LogRegModel,
     NaiveBayesModel,
     logreg_train,
+    logreg_train_grid,
     naive_bayes_train,
+    naive_bayes_train_grid,
 )
 from predictionio_tpu.ops.text import (
     IDFModel,
@@ -210,6 +212,29 @@ class NBAlgorithm(Algorithm):
     def predict(self, model: TfIdfClassifierModel, query: Query) -> PredictedResult:
         return model.classify(str(query["text"]))
 
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list]:
+        """A λ grid as one device program when the cells share a
+        featurization ((numFeatures, minDocFreq) equal): hashing-TF +
+        IDF run ONCE — the per-cell work collapses to the [G]-vmapped NB
+        finish (ops/classify.py::naive_bayes_train_grid)."""
+        if len({(a.params.numFeatures, a.params.minDocFreq)
+                for a in algos}) != 1:
+            return None
+        tf = hashing_tf(pd.tokens, algos[0].params.numFeatures)
+        idf = idf_fit(tf, algos[0].params.minDocFreq)
+        nbs = naive_bayes_train_grid(
+            idf.transform(tf), pd.label_idx, n_classes=len(pd.classes),
+            smoothings=[a.params.lambda_ for a in algos], mesh=ctx.mesh)
+        return [
+            TfIdfClassifierModel(
+                kind="nb", nb=nb, lr=None, idf=idf,
+                num_features=algos[0].params.numFeatures,
+                classes=pd.classes)
+            for nb in nbs
+        ]
+
 
 @dataclasses.dataclass
 class LRParams(Params):
@@ -244,6 +269,30 @@ class LRAlgorithm(Algorithm):
 
     def predict(self, model: TfIdfClassifierModel, query: Query) -> PredictedResult:
         return model.classify(str(query["text"]))
+
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list]:
+        """A (stepSize, regParam) grid as one device program over a
+        SHARED tf-idf featurization; iterations and featurization params
+        must agree across cells (sequential fallback otherwise)."""
+        if len({(a.params.numFeatures, a.params.minDocFreq,
+                 a.params.iterations) for a in algos}) != 1:
+            return None
+        tf = hashing_tf(pd.tokens, algos[0].params.numFeatures)
+        idf = idf_fit(tf, algos[0].params.minDocFreq)
+        lrs = logreg_train_grid(
+            idf.transform(tf), pd.label_idx, n_classes=len(pd.classes),
+            iterations=algos[0].params.iterations,
+            learning_rates=[a.params.stepSize for a in algos],
+            regs=[a.params.regParam for a in algos], mesh=ctx.mesh)
+        return [
+            TfIdfClassifierModel(
+                kind="lr", nb=None, lr=lr, idf=idf,
+                num_features=algos[0].params.numFeatures,
+                classes=pd.classes)
+            for lr in lrs
+        ]
 
 
 @dataclasses.dataclass

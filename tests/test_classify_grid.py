@@ -61,6 +61,44 @@ class TestLogRegGrid:
                                        rtol=2e-4, atol=1e-5)
 
 
+class TestTextTemplateGrid:
+    def test_tfidf_shared_nb_grid_matches_sequential(self):
+        """The text template's NB λ grid shares ONE tf-idf featurization
+        across cells and matches per-cell sequential training."""
+        from predictionio_tpu.controller.context import WorkflowContext
+        from predictionio_tpu.templates.textclassification.engine import (
+            NBAlgorithm, NBParams, Preparator, TrainingData)
+
+        texts = ["spam buy now", "hello friend meeting", "buy cheap spam",
+                 "lunch meeting tomorrow", "cheap pills buy",
+                 "project meeting notes"] * 10
+        labels = ["spam", "ham", "spam", "ham", "spam", "ham"] * 10
+        pd = Preparator().prepare(
+            WorkflowContext(), TrainingData(texts=texts, labels=labels))
+        lambdas = [0.2, 1.0, 4.0]
+        algos = [NBAlgorithm(NBParams(lambda_=l)) for l in lambdas]
+        grid = NBAlgorithm.train_grid(WorkflowContext(), pd, algos)
+        assert grid is not None and len(grid) == 3
+        for a, m in zip(algos, grid):
+            ref = a.train(WorkflowContext(), pd)
+            np.testing.assert_allclose(m.nb.log_theta, ref.nb.log_theta,
+                                       rtol=1e-6, atol=1e-7)
+            assert m.classify("buy cheap now") == ref.classify(
+                "buy cheap now")
+
+    def test_mixed_featurization_falls_back(self):
+        from predictionio_tpu.controller.context import WorkflowContext
+        from predictionio_tpu.templates.textclassification.engine import (
+            NBAlgorithm, NBParams, Preparator, TrainingData)
+
+        pd = Preparator().prepare(
+            WorkflowContext(),
+            TrainingData(texts=["a b", "c d"], labels=["x", "y"]))
+        algos = [NBAlgorithm(NBParams(numFeatures=256)),
+                 NBAlgorithm(NBParams(numFeatures=512))]
+        assert NBAlgorithm.train_grid(WorkflowContext(), pd, algos) is None
+
+
 class TestEngineEvalGridRouting:
     def _setup(self, memory_storage, algo):
         from tests.test_classification_template import (
