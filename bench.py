@@ -607,16 +607,30 @@ def bench_north_star(scale: str = "20m", full: bool = True):
                 return {k: r[k] for k in keys}
             return run
 
+        def with_mini_ladder(fn):
+            # the driver runs bare `bench.py`: carry a compact 1/8/32
+            # concurrency curve in the artifact (headline stays the
+            # 8-client rung), unless the user set --clients themselves
+            def run():
+                if CLIENT_LADDER == [8]:
+                    CLIENT_LADDER[:] = [1, 8, 32]
+                    try:
+                        return fn()
+                    finally:
+                        CLIENT_LADDER[:] = [8]
+                return fn()
+            return run
+
         guarded("map10_parity", map10)
-        guarded("serving", project(
+        guarded("serving", with_mini_ladder(project(
             lambda: bench_serving("memory", emit=False),
-            ("value", "p50_ms", "p95_ms", "concurrency", "ladder")))
+            ("value", "p50_ms", "p95_ms", "concurrency", "ladder"))))
         guarded("batch_predict", project(
             lambda: bench_batch_predict(emit=False),
             ("value", "n_queries")))
-        guarded("ingest", project(
+        guarded("ingest", with_mini_ladder(project(
             lambda: bench_ingest(emit=False),
-            ("value", "single", "batch", "concurrency")))
+            ("value", "single", "batch", "concurrency"))))
         record["metrics"] = metrics
     print(json.dumps(record))
 

@@ -394,6 +394,24 @@ class TestEventStoreRouting:
             assert props[eid].first_updated == slow[eid].first_updated
             assert props[eid].last_updated == slow[eid].last_updated
 
+    def test_env_gate_forces_per_event_fold(self, tmp_path, monkeypatch):
+        """PIO_AGG_PUSHDOWN=0 (the ops escape hatch) must skip the
+        columnar tiers entirely and still return the same result."""
+        storage = _file_storage(tmp_path, "gate")
+        b = storage._backend(storage.config.eventdata)
+        app_id = b.apps().insert(App(id=None, name="GateApp"))
+        b.events().insert_batch(
+            [_ev(0, "$set", "u1", {"a": 1}, entity_type="item")], app_id)
+        store = EventStore(storage)
+        calls = []
+        real = type(b.events()).aggregate_properties_columnar
+        monkeypatch.setattr(
+            type(b.events()), "aggregate_properties_columnar",
+            lambda self, *a, **k: calls.append(1) or real(self, *a, **k))
+        monkeypatch.setenv("PIO_AGG_PUSHDOWN", "0")
+        props = store.aggregate_properties("GateApp", "item")
+        assert calls == [] and props["u1"].to_dict() == {"a": 1}
+
     def test_store_required_pushdown(self, tmp_path):
         storage = _file_storage(tmp_path, "s2")
         b = storage._backend(storage.config.eventdata)
