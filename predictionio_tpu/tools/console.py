@@ -23,7 +23,8 @@ def cmd_version(args) -> int:
 
 
 def cmd_status(args) -> int:
-    """Storage connectivity health check (`pio status` [U])."""
+    """Storage connectivity health check (`pio status` [U]) + which
+    native fast paths this host can run."""
     from predictionio_tpu.storage import Storage
 
     results = Storage.get().verify_all_data_objects()
@@ -31,6 +32,14 @@ def cmd_status(args) -> int:
         print(f"  {name}: {'OK' if ok else 'FAILED'}")
     ok = all(results.values())
     print("Storage status: " + ("all OK" if ok else "FAILURES detected"))
+    # native tier: informational, never a failure — every native path
+    # has a bit-identical Python fallback
+    from predictionio_tpu import native
+
+    print("Native fast paths (scan/bucketize/import/export/aggregate): "
+          + ("available"
+             if native.native_available()
+             else "unavailable (no toolchain) — Python fallbacks active"))
     return 0 if ok else 1
 
 
