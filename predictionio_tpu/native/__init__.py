@@ -145,6 +145,35 @@ def native_available() -> bool:
     return get_lib() is not None
 
 
+def native_status() -> str:
+    """One-line status for `pio status` — reports from CHEAP state only
+    (env, loaded lib, cached .so, toolchain presence); never compiles,
+    never raises. Distinguishes disabled-by-env from build-failed from
+    no-toolchain so the operator debugs the right thing."""
+    import shutil
+
+    try:
+        if os.environ.get("PIO_NATIVE", "1") == "0":
+            return "disabled (PIO_NATIVE=0) — Python fallbacks active"
+        if _lib is not None:
+            return "available (loaded)"
+        if _lib_failed:
+            return ("build/load FAILED earlier this process (see warnings) "
+                    "— Python fallbacks active")
+        h = hashlib.blake2b(digest_size=8)
+        for src_path in _SRCS:
+            with open(src_path, "rb") as f:
+                h.update(f.read())
+        so_path = os.path.join(_build_dir(), f"pio_native_{h.hexdigest()}.so")
+        if os.path.exists(so_path):
+            return "available (cached build)"
+        if shutil.which("g++"):
+            return "toolchain present — builds on first use"
+        return "unavailable (no toolchain) — Python fallbacks active"
+    except Exception as e:  # status must never take the CLI down
+        return f"status unknown ({type(e).__name__}) — Python fallbacks apply"
+
+
 def columnar_scan_native(db_path: str, sql: str, params: list,
                          value_key: Optional[str],
                          event_names: list):
