@@ -590,7 +590,11 @@ def bench_north_star(scale: str = "20m", full: bool = True):
         def guarded(name, fn):
             try:
                 metrics[name] = fn()
-            except BaseException as e:  # noqa: BLE001 — record, don't die
+            except KeyboardInterrupt:
+                raise  # Ctrl-C aborts the bench, not just one metric
+            except (Exception, SystemExit) as e:
+                # SystemExit: _run_http_load raises it on client errors —
+                # a failed sub-bench is a recorded error, not a dead run
                 metrics[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
         def map10():

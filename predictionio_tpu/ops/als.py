@@ -1000,6 +1000,10 @@ def als_train(
     start_iter = 0
     rmse_history: list[float] = []
     manager = None
+    # resolve the checkpoint-writing rank ONCE, before any epoch runs —
+    # an out-of-range PIO_PERSIST_RANK must fail here, not discard a
+    # computed epoch at the first save (single-process runs ignore it)
+    ckpt_rank = _persist_rank() if checkpoint_dir else 0
     if checkpoint_dir:
         import hashlib
 
@@ -1101,7 +1105,7 @@ def als_train(
         # delete-vs-write mid-step
         if manager:
             host_copies = uf_host, vf_host = factors_to_host()
-            if jax.process_index() == _persist_rank():
+            if jax.process_index() == ckpt_rank:
                 if not first_save_done:
                     manager.keep_only(restore_step)
                     first_save_done = True
@@ -1125,7 +1129,7 @@ def als_train(
                 f"factor sharding but trained factors came back {spec!r}")
         log.info("als_train: training factors model-sharded %s over mesh %s",
                  tuple(spec), dict(mesh.shape))
-    if (manager and jax.process_index() == _persist_rank()
+    if (manager and jax.process_index() == ckpt_rank
             and not first_save_done and restore_step is not None):
         # fully-resumed run (no new saves): still purge stale steps now —
         # the restore point is on disk, so there's no crash window here.

@@ -54,11 +54,15 @@ def persist_rank() -> int:
     coordinator host — e.g. rank 0 on a control node, models written by
     the rank colocated with the database. Every rank still trains (SPMD)
     and joins the pre-persist host-gather collectives; only this rank
-    writes."""
+    writes. Single-process runs ignore the variable entirely (a stale
+    multi-host env file must not break a local train); multi-process
+    worlds validate it loudly — at workflow entry, before any epoch."""
     import jax
 
-    r = int(os.environ.get("PIO_PERSIST_RANK", "0"))
     n = jax.process_count()
+    if n == 1:
+        return 0
+    r = int(os.environ.get("PIO_PERSIST_RANK", "0"))
     if not 0 <= r < n:
         raise ValueError(
             f"PIO_PERSIST_RANK={r} out of range for a {n}-process world")

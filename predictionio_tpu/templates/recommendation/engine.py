@@ -311,7 +311,11 @@ class ALSAlgorithm(Algorithm):
                     user_ids=pd.user_ids,
                     item_ids=pd.item_ids,
                     seen=seen,
-                    rmse_history=r.rmse_history,
+                    # the group trains RMSE when ANY cell wants it; a
+                    # computeRMSE=False cell must still come out empty,
+                    # exactly as its sequential train would
+                    rmse_history=(r.rmse_history
+                                  if algos[i].params.computeRMSE else []),
                 )
         return models
 
