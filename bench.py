@@ -132,6 +132,13 @@ def _run_http_load(port: int, path, payloads, n_threads,
     if errors:
         raise SystemExit(f"load failed at {n_threads} clients: {errors[0]}")
     all_lat = sorted(x for lat in latencies for x in lat)
+    if not all_lat:
+        # zero completions with no client exception (e.g. every thread
+        # still blocked in one in-flight request) — fail loudly instead
+        # of a StatisticsError from the percentile math below
+        raise SystemExit(
+            f"no requests completed within {duration_s}s at "
+            f"{n_threads} clients")
     qps = len(all_lat) / wall
     return (qps, statistics.median(all_lat),
             all_lat[int(len(all_lat) * 0.95)], len(all_lat))

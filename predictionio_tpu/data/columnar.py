@@ -113,10 +113,14 @@ def columns_from_events(
     """Fold already-materialized `Event` objects into `EventColumns` —
     the generic tier every backend (and the batch view's cached-snapshot
     path) shares. Output contract matches the pushed-down scans: sorted
-    BiMap codes, (event_time, creation_time) row order when `ordered`."""
+    BiMap codes, (event_time, creation_time, id) row order when
+    `ordered` — the unique id as final tiebreak, matching the SQL and
+    C++ tiers' ORDER BYs, so exact-timestamp ties resolve identically
+    in every tier."""
     events = list(events)
     if ordered:
-        events.sort(key=lambda e: (e.event_time, e.creation_time))
+        events.sort(key=lambda e: (e.event_time, e.creation_time,
+                                   e.event_id or ""))
     if event_names is None:
         event_names = sorted(
             {e.event for e in events if e.event not in SPECIAL_EVENTS})

@@ -154,7 +154,10 @@ def aggregate_properties(events: Sequence) -> dict[str, PropertyMap]:
     """Fold special events into per-entity PropertyMaps.
 
     ``events`` are `Event`s of a single entity_type (any order; sorted here by
-    (event_time, creation_time) ascending). Parity target:
+    (event_time, creation_time, event_id) ascending — the unique id as
+    final tiebreak, so exact-timestamp ties resolve identically to the
+    SQL window and C++ pushdown tiers regardless of input order).
+    Parity target:
     «data/.../storage/PropertyMap.scala» + `LEvents.aggregateProperties` [U].
     """
     # Local import to avoid a cycle at module load.
@@ -165,7 +168,7 @@ def aggregate_properties(events: Sequence) -> dict[str, PropertyMap]:
     last: dict[str, datetime] = {}
 
     def sort_key(e):
-        return (e.event_time, e.creation_time)
+        return (e.event_time, e.creation_time, e.event_id or "")
 
     for e in sorted(events, key=sort_key):
         eid = e.entity_id

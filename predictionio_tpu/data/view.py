@@ -36,7 +36,9 @@ T = TypeVar("T")
 
 
 def _ordered(events: Sequence[Event]) -> list[Event]:
-    return sorted(events, key=lambda e: (e.event_time, e.creation_time))
+    return sorted(events,
+                  key=lambda e: (e.event_time, e.creation_time,
+                                 e.event_id or ""))
 
 
 class LBatchView:

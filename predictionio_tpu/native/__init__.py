@@ -155,9 +155,25 @@ def native_status() -> str:
     try:
         if os.environ.get("PIO_NATIVE", "1") == "0":
             return "disabled (PIO_NATIVE=0) — Python fallbacks active"
-        if _lib is not None:
+        # snapshot under the build lock so a concurrent first-use build
+        # can't interleave a stale (loaded, failed) pair into the report
+        # — but never BLOCK on it (a first-use g++ build holds it for
+        # ~2 min, and this probe must stay cheap): a held lock IS the
+        # status
+        if not _lock.acquire(blocking=False):
+            # the lock is also taken briefly on get_lib()'s cached fast
+            # path — an unlocked _lib read distinguishes "loaded, lock
+            # momentarily busy" from an actual first-use build
+            if _lib is not None:
+                return "available (loaded)"
+            return "build in progress (first use) — will load when done"
+        try:
+            lib, lib_failed = _lib, _lib_failed
+        finally:
+            _lock.release()
+        if lib is not None:
             return "available (loaded)"
-        if _lib_failed:
+        if lib_failed:
             return ("build/load FAILED earlier this process (see warnings) "
                     "— Python fallbacks active")
         h = hashlib.blake2b(digest_size=8)
@@ -294,7 +310,9 @@ def agg_props_native(db_path: str, sql: str, params: list,
     """$set/$unset/$delete fold via the C++ reader (pio_aggprops.cpp).
 
     `sql` must select (entity_id, event, properties, event_time) ordered
-    by (event_time, creation_time) ascending, with `?` placeholders
+    by (event_time, creation_time, id) ascending — the unique id as
+    final tiebreak, so exact-timestamp ties fold identically to the SQL
+    window tier and the per-event oracle — with `?` placeholders
     bound from `params` (all bound as text). Returns a list of
     (entity_id, first_updated_text, last_updated_text, folded_json_text)
     tuples — one per surviving entity, `required` keys pre-filtered —

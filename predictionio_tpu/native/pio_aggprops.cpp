@@ -16,7 +16,9 @@
 // per surviving entity.
 //
 // Fold semantics (must match data/datamap.py::aggregate_properties):
-//   - rows arrive ordered by (event_time, creation_time) ascending;
+//   - rows arrive ordered by (event_time, creation_time, id) ascending
+//     (unique id as final tiebreak — exact-timestamp ties must resolve
+//     the same way in every tier);
 //   - $set creates/updates keys (later sets win per key); creation
 //     stamps first_updated, every $set stamps last_updated;
 //   - $unset drops the named keys IF the entity exists, and stamps
@@ -336,7 +338,7 @@ const char* pio_agg_error() { return g_error.c_str(); }
 
 // Runs the whole fold. `sql` must select
 //   0 entity_id TEXT, 1 event TEXT, 2 properties TEXT, 3 event_time TEXT
-// ordered by (event_time, creation_time) ascending — the fold is
+// ordered by (event_time, creation_time, id) ascending — the fold is
 // order-sensitive and trusts the statement's ORDER BY. Returns 0 with a
 // handle + sizes, or -1 (pio_agg_error() has the reason; the caller
 // falls back to the per-event Python fold).

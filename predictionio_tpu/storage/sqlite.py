@@ -767,7 +767,7 @@ class SQLiteLEvents(base.LEvents):
         order = "DESC" if reversed else "ASC"
         sql = (
             f"SELECT * FROM events WHERE {' AND '.join(clauses)} "
-            f"ORDER BY event_time {order}, creation_time {order}"
+            f"ORDER BY event_time {order}, creation_time {order}, id {order}"
         )
         if limit is not None and limit >= 0:
             sql += " LIMIT ?"
@@ -800,7 +800,7 @@ class SQLiteLEvents(base.LEvents):
           via `json_extract`, so the only per-row Python work is one
           numeric tuple (~2× the per-Event path, works on every dialect).
 
-        `ordered=False` skips the (event_time, creation_time) output sort
+        `ordered=False` skips the (event_time, creation_time, id) output sort
         — order-invariant consumers like ALS save a full-table sort.
 
         BiMap codes follow sorted distinct-id order: SQLite's BINARY
@@ -861,7 +861,7 @@ class SQLiteLEvents(base.LEvents):
                 f"event_time FROM events WHERE {where}"
             )
             if ordered:
-                raw_sql += " ORDER BY event_time, creation_time"
+                raw_sql += " ORDER BY event_time, creation_time, id"
             out = native_mod.columnar_scan_native(
                 native_path, raw_sql, where_params, value_key, event_names)
             if out is not None:
@@ -908,7 +908,7 @@ class SQLiteLEvents(base.LEvents):
                 f"FROM events WHERE {where}"
             )
             if ordered:
-                sql += " ORDER BY event_time, creation_time"
+                sql += " ORDER BY event_time, creation_time, id"
             rows = cur.execute(
                 sql, [*event_names, *value_params, *where_params]).fetchall()
         return columns_from_numeric_rows(
@@ -974,7 +974,7 @@ class SQLiteLEvents(base.LEvents):
             raw_sql = (
                 "SELECT entity_id, event, properties, event_time "
                 f"FROM events WHERE {where} "
-                "ORDER BY event_time, creation_time"
+                "ORDER BY event_time, creation_time, id"
             )
             rows = native_mod.agg_props_native(
                 native_path, raw_sql, params, required)
@@ -1007,7 +1007,7 @@ class SQLiteLEvents(base.LEvents):
         sql = (
             "WITH ev AS MATERIALIZED ("
             "  SELECT entity_id, event, properties, event_time,"
-            "         row_number() OVER (ORDER BY event_time, creation_time)"
+            "         row_number() OVER (ORDER BY event_time, creation_time, id)"
             "           AS seq"
             f"  FROM events WHERE {where}"
             # tombstone resolution as ONE window pass: a join against a

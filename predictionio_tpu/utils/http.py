@@ -8,9 +8,13 @@ servers with the same lifecycle; this base class carries it once.
 from __future__ import annotations
 
 import json
+import logging
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Type
+
+logger = logging.getLogger("predictionio_tpu.http")
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -56,6 +60,21 @@ class _Server(ThreadingHTTPServer):
     # net.core.somaxconn anyway.
     request_queue_size = 128
     daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        # socketserver's default prints a raw traceback to stderr; a
+        # framework that silences its access log must own its error
+        # channel too. Client disconnects mid-request (reset/broken
+        # pipe — routine under load tests and kill drills) are debug
+        # noise; real handler bugs are errors, with the traceback kept
+        # in the logging record.
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, BrokenPipeError, TimeoutError)):
+            logger.debug("client %s dropped mid-request: %r",
+                         client_address, exc)
+        else:
+            logger.error("exception processing request from %s",
+                         client_address, exc_info=True)
 
 
 class HttpService:

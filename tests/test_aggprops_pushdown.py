@@ -245,6 +245,33 @@ class TestFidelity:
 
 
 class TestCorners:
+    def test_exact_time_tie_resolves_by_id_everywhere(self, file_backend):
+        """Two $set events with IDENTICAL event_time AND creation_time
+        (routine in batch imports sharing one creation stamp): every
+        tier — per-event oracle, SQL window, C++ fold — must agree on
+        the winner. The unique `id` column is the final tiebreak in all
+        ORDER BYs, so the larger id wins deterministically."""
+        b, app_id = file_backend
+        e_lo = _ev(0, "$set", "u1", {"price": 1, "only_lo": True})
+        e_hi = _ev(0, "$set", "u1", {"price": 2})
+        e_lo.event_id = "a" * 32
+        e_hi.event_id = "b" * 32
+        e_hi.creation_time = e_lo.creation_time  # exact tie, both stamps
+        # insert the would-be winner FIRST so insertion order can't be
+        # what the tiers secretly agree on
+        b.events().insert_batch([e_hi, e_lo], app_id)
+        oracle = _oracle(b.events(), app_id)
+        assert oracle["u1"].to_dict() == {"price": 2, "only_lo": True}
+        for _, got in _both_tiers(b, app_id):
+            _assert_matches(got, oracle)
+            assert got["u1"][0]["price"] == 2
+        # the shared fold itself must resolve the tie by id even when
+        # the caller hands it events in non-id order (its documented
+        # "any order" contract) — not just transitively via find()'s
+        # ORDER BY
+        direct = aggregate_properties([e_hi, e_lo])
+        assert direct["u1"].to_dict() == {"price": 2, "only_lo": True}
+
     def test_duplicate_keys_last_wins(self, file_backend):
         """Raw rows with duplicate JSON keys (a non-Python writer could
         store them): json.loads keeps the last — so must both tiers."""
