@@ -27,7 +27,7 @@ class WorkflowContext:
         verbose: int = 0,
         storage: Optional[Any] = None,
         checkpoint_dir: Optional[str] = None,
-        checkpoint_every: int = 1,
+        checkpoint_every: Optional[int] = None,
         metrics: Optional[Any] = None,
     ):
         """Args:
@@ -38,8 +38,12 @@ class WorkflowContext:
         verbose: debug verbosity (the reference's WorkflowParams.verbose).
         storage: Storage registry override (defaults to the process one).
         checkpoint_dir: when set, algorithms checkpoint trainer state here
-            every `checkpoint_every` epochs and resume from the latest
-            step on re-run (SURVEY.md §5 'Checkpoint / resume').
+            every `checkpoint_every` of their own step unit (ALS: epochs;
+            W2V/LogReg: scan iterations) and resume from the latest step
+            on re-run (SURVEY.md §5 'Checkpoint / resume').
+        checkpoint_every: None = each algorithm picks its own default
+            (ALS every epoch; step-loop trainers ~10 saves per run —
+            `checkpoint_every_or`); an explicit value applies verbatim.
         metrics: a `utils.profiling.MetricsLogger` for per-epoch metric
             emission (default: log-only).
         """
@@ -60,6 +64,13 @@ class WorkflowContext:
 
             self._metrics = NullMetricsLogger()
         return self._metrics
+
+    def checkpoint_every_or(self, default: int) -> int:
+        """`--checkpoint-every` when the user passed one, else the
+        algorithm's own sensible default (its step unit varies: ALS
+        epochs are seconds each so every-1 is right; a 200-iteration
+        Adam scan at every-1 would be 200 dispatches + saves)."""
+        return self.checkpoint_every if self.checkpoint_every else default
 
     def algorithm_checkpoint_dir(self, algo_name: str) -> Optional[str]:
         """Per-algorithm checkpoint subdirectory (None when disabled)."""

@@ -180,7 +180,11 @@ def naive_bayes_train_grid(
 
 
 @functools.lru_cache(maxsize=32)
-def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
+def _logreg_fit(n_classes: int, n_steps: int, lr: float, reg: float):
+    """`n_steps` Adam iterations as one jitted scan over an explicit
+    (params, opt_state) carry — the carry fully captures trainer state,
+    so the run segments into checkpoint-sized chunks (workflow/segmented)
+    with results identical to one whole-run dispatch."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -193,9 +197,7 @@ def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
         data = (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
         return data + 0.5 * reg * jnp.sum(params["w"] ** 2)
 
-    def fit(params0, x, y, w):
-        state0 = opt.init(params0)
-
+    def fit(params0, state0, x, y, w):
         def step(carry, _):
             params, state = carry
             loss, grads = jax.value_and_grad(loss_fn)(params, x, y, w)
@@ -203,10 +205,10 @@ def _logreg_fit(n_classes: int, iterations: int, lr: float, reg: float):
             params = optax.apply_updates(params, updates)
             return (params, state), loss
 
-        (params, _), losses = jax.lax.scan(
-            step, (params0, state0), xs=None, length=iterations
+        (params, state), losses = jax.lax.scan(
+            step, (params0, state0), xs=None, length=n_steps
         )
-        return params, losses
+        return params, state, losses
 
     return jax.jit(fit)
 
@@ -299,30 +301,87 @@ def logreg_train(
     learning_rate: float = 0.1,
     reg: float = 0.0,
     mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
 ) -> LogRegModel:
     """Softmax regression, full-batch Adam in one jitted `lax.scan` —
     gradients over the sharded example axis reduce via GSPMD psum (the
-    `treeAggregate` replacement, SURVEY.md §2.7 'Aggregation')."""
+    `treeAggregate` replacement, SURVEY.md §2.7 'Aggregation').
+
+    `checkpoint_dir`: when set, (params, Adam state) are checkpointed
+    every `checkpoint_every` iterations (default: one save at the end)
+    under a fingerprint of the training data + config, and a re-run
+    resumes from the latest usable step — the same SURVEY.md §5
+    contract als_train carries, via workflow/segmented. Without it the
+    whole run stays ONE dispatch (unchanged behavior)."""
+    import jax
     import jax.numpy as jnp
+    import optax
 
     from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from predictionio_tpu.workflow.segmented import (
+        fingerprint_of, segmented_train,
+    )
 
     if mesh is None:
         mesh = make_mesh()
-    x = np.ascontiguousarray(features, dtype=np.float32)
-    y = np.ascontiguousarray(labels, dtype=np.int32)
-    d = x.shape[1]
-    x, y, w = _pad_batch(x, y, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
+    x_np = np.ascontiguousarray(features, dtype=np.float32)
+    y_np = np.ascontiguousarray(labels, dtype=np.int32)
+    d = x_np.shape[1]
+    x, y, w = _pad_batch(x_np, y_np, math.lcm(8, mesh.shape.get(DATA_AXIS, 1)))
     x, y, w = _shard_examples(mesh, x, y, w)
-    params0 = {
-        "w": jnp.zeros((d, n_classes), dtype=jnp.float32),
-        "b": jnp.zeros((n_classes,), dtype=jnp.float32),
-    }
-    params, losses = _logreg_fit(
-        n_classes, int(iterations), float(learning_rate), float(reg)
-    )(params0, x, y, w)
+    lr, rg = float(learning_rate), float(reg)
+    opt = optax.adam(lr)
+
+    def init_state():
+        params0 = {
+            "w": jnp.zeros((d, n_classes), dtype=jnp.float32),
+            "b": jnp.zeros((n_classes,), dtype=jnp.float32),
+        }
+        return (params0, opt.init(params0))
+
+    def run_chunk(state, n_steps, done):
+        params, ostate = state
+        params, ostate, losses = _logreg_fit(n_classes, n_steps, lr, rg)(
+            params, ostate, x, y, w)
+        # np.asarray on the losses is the execution fence (scalar
+        # readback — see segmented_train's contract)
+        return (params, ostate), [float(v) for v in np.asarray(losses)]
+
+    def state_to_host(state):
+        return {"leaves": [np.asarray(leaf) for leaf in jax.tree.leaves(state)]}
+
+    def state_from_host(tree):
+        template = init_state()
+        want = jax.tree.leaves(template)
+        got = tree["leaves"]
+        if len(got) != len(want):
+            raise ValueError(f"leaf count {len(got)} != {len(want)}")
+        leaves = []
+        for g, t in zip(got, want):
+            if tuple(np.shape(g)) != tuple(t.shape):
+                raise ValueError(f"shape {np.shape(g)} != {t.shape}")
+            leaves.append(jnp.asarray(g, dtype=t.dtype))
+        return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+    # fingerprint excludes `iterations` (resuming into a longer run is
+    # legal, matching als_train) but covers data, shapes, and config
+    fp = fingerprint_of(x_np, y_np, (n_classes, d, lr, rg, "logreg.v1"))
+    state, history, _ = segmented_train(
+        total_steps=int(iterations),
+        init_state=init_state,
+        run_chunk=run_chunk,
+        state_to_host=state_to_host,
+        state_from_host=state_from_host,
+        fingerprint=fp,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        fault_site="logreg.step_boundary",
+        name="logreg_train",
+    )
+    params = state[0]
     return LogRegModel(
         weights=np.asarray(params["w"]),
         bias=np.asarray(params["b"]),
-        loss_history=[float(v) for v in np.asarray(losses)],
+        loss_history=[float(v) for v in history],
     )

@@ -156,8 +156,13 @@ class Word2VecModel:
 
 
 @functools.lru_cache(maxsize=16)
-def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig):
-    """Whole training run as one jitted program: `lax.scan` over steps,
+def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig,
+                    n_steps: int):
+    """`n_steps` of the training run as one jitted program (callers pass
+    `cfg` with steps=0 so runs differing only in step count share the
+    compile; the (emb_in, emb_out, key) carry fully captures trainer
+    state, so checkpoint-sized chunks compose to the exact whole-run
+    result): `lax.scan` over steps,
     each step samples a pair batch + negatives on device and applies
     **sparse** SGD updates via scatter-add. The gradients of the SGNS loss
     touch only the B·(negatives+2) embedding rows in the batch, so the
@@ -207,17 +212,17 @@ def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig):
                 -lr * g_ngs.reshape(-1, g_ngs.shape[-1]))
             return (emb_in, emb_out, key), loss
 
-        (emb_in, emb_out, _), losses = jax.lax.scan(
-            step, (emb_in0, emb_out0, key), xs=None, length=cfg.steps
+        (emb_in, emb_out, key), losses = jax.lax.scan(
+            step, (emb_in0, emb_out0, key), xs=None, length=n_steps
         )
-        return emb_in, losses
+        return (emb_in, emb_out, key), losses
 
     return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=16)
 def _w2v_train_loop_sharded(n_pairs: int, vocab_size: int,
-                            cfg: Word2VecConfig, mesh):
+                            cfg: Word2VecConfig, n_steps: int, mesh):
     """Data-parallel variant (SURVEY.md §2.6 strategy 3, «Word2Vec.fit»'s
     parameter-mixing DP re-expressed for ICI): the per-step pair batch is
     sharded over the mesh `data` axis — each device computes the SGNS
@@ -285,9 +290,9 @@ def _w2v_train_loop_sharded(n_pairs: int, vocab_size: int,
                 -lr * g_ngs.reshape(-1, g_ngs.shape[-1]))
             return (emb_in, emb_out, key), loss
 
-        (emb_in, emb_out, _), losses = lax.scan(
-            step, (emb_in0, emb_out0, key), xs=None, length=cfg.steps)
-        return emb_in, losses
+        (emb_in, emb_out, key), losses = lax.scan(
+            step, (emb_in0, emb_out0, key), xs=None, length=n_steps)
+        return (emb_in, emb_out, key), losses
 
     from jax.sharding import PartitionSpec as P
 
@@ -295,7 +300,7 @@ def _w2v_train_loop_sharded(n_pairs: int, vocab_size: int,
     shard = jax.shard_map(
         run, mesh=mesh,
         in_specs=(rep, rep, rep, rep),
-        out_specs=(rep, rep),
+        out_specs=((rep, rep, rep), rep),
         check_vma=False,  # replicated-in/replicated-out by construction
     )
     return jax.jit(shard)
@@ -305,12 +310,26 @@ def word2vec_train(
     docs_tokens: Sequence[Sequence[str]],
     cfg: Word2VecConfig = Word2VecConfig(),
     mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
 ) -> Word2VecModel:
-    """Train skip-gram embeddings («Word2Vec.fit» replacement [U])."""
+    """Train skip-gram embeddings («Word2Vec.fit» replacement [U]).
+
+    `checkpoint_dir`: when set, the (emb_in, emb_out, PRNG key) carry is
+    checkpointed every `checkpoint_every` SGNS steps (default: one save
+    at the end) under a fingerprint of the pair table + config, and a
+    re-run resumes from the latest usable step — the SURVEY.md §5
+    contract als_train carries, via workflow/segmented. The carry holds
+    the step PRNG key, so a resumed run samples the exact batches the
+    uninterrupted run would have. Without it the whole run stays ONE
+    dispatch (unchanged behavior)."""
     import jax
     import jax.numpy as jnp
 
     from predictionio_tpu.parallel.mesh import make_mesh, replicated
+    from predictionio_tpu.workflow.segmented import (
+        fingerprint_of, segmented_train,
+    )
 
     vocab = build_vocab(docs_tokens, cfg.min_count, cfg.max_vocab)
     if not vocab:
@@ -323,29 +342,83 @@ def word2vec_train(
     rep = replicated(mesh)
 
     v = len(vocab)
-    key = jax.random.key(cfg.seed)
-    k_init, k_run = jax.random.split(key)
-    emb_in = jax.device_put(
-        (jax.random.uniform(k_init, (v, cfg.dim), minval=-0.5, maxval=0.5)
-         / cfg.dim).astype(jnp.float32), rep)
-    emb_out = jax.device_put(jnp.zeros((v, cfg.dim), dtype=jnp.float32), rep)
     pairs_dev = jax.device_put(jnp.asarray(pairs), rep)
 
     from predictionio_tpu.parallel.mesh import DATA_AXIS
 
     n_data = mesh.shape.get(DATA_AXIS, 1) if mesh.size > 1 else 1
-    if n_data > 1 and cfg.batch_size % n_data == 0:
-        run = _w2v_train_loop_sharded(len(pairs), v, cfg, mesh)
-    else:
-        if n_data > 1:
-            log.warning(
-                "word2vec_train: batch_size %d not divisible by data axis "
-                "%d — running the single-device loop", cfg.batch_size, n_data)
-        run = _w2v_train_loop(len(pairs), v, cfg)
-    emb, losses = run(k_run, pairs_dev, emb_in, emb_out)
-    losses = np.asarray(losses)
-    log.info(
-        "word2vec_train: vocab %d, %d pairs, %d steps, loss %.4f → %.4f",
-        v, len(pairs), cfg.steps, losses[0], losses[-1],
+    use_sharded = n_data > 1 and cfg.batch_size % n_data == 0
+    if n_data > 1 and not use_sharded:
+        log.warning(
+            "word2vec_train: batch_size %d not divisible by data axis "
+            "%d — running the single-device loop", cfg.batch_size, n_data)
+    # the traced program only sees n_steps; steps=0 in the cache key so
+    # runs differing in step count share the compile
+    loop_cfg = dataclasses.replace(cfg, steps=0)
+
+    def get_loop(n_steps):
+        if use_sharded:
+            return _w2v_train_loop_sharded(len(pairs), v, loop_cfg,
+                                           n_steps, mesh)
+        return _w2v_train_loop(len(pairs), v, loop_cfg, n_steps)
+
+    def init_state():
+        key = jax.random.key(cfg.seed)
+        k_init, k_run = jax.random.split(key)
+        emb_in = jax.device_put(
+            (jax.random.uniform(k_init, (v, cfg.dim), minval=-0.5,
+                                maxval=0.5) / cfg.dim).astype(jnp.float32),
+            rep)
+        emb_out = jax.device_put(jnp.zeros((v, cfg.dim), dtype=jnp.float32),
+                                 rep)
+        return emb_in, emb_out, k_run
+
+    def run_chunk(state, n_steps, done):
+        emb_in, emb_out, key = state
+        (emb_in, emb_out, key), losses = get_loop(n_steps)(
+            key, pairs_dev, emb_in, emb_out)
+        # np.asarray on the losses is the execution fence (scalar
+        # readback — see segmented_train's contract)
+        return ((emb_in, emb_out, key),
+                [float(x) for x in np.asarray(losses)])
+
+    def state_to_host(state):
+        emb_in, emb_out, key = state
+        return {"emb_in": np.asarray(emb_in), "emb_out": np.asarray(emb_out),
+                "key_data": np.asarray(jax.random.key_data(key))}
+
+    def state_from_host(tree):
+        emb_in, emb_out = tree["emb_in"], tree["emb_out"]
+        if emb_in.shape != (v, cfg.dim) or emb_out.shape != (v, cfg.dim):
+            raise ValueError(f"embedding shape {emb_in.shape} != "
+                             f"{(v, cfg.dim)}")
+        key = jax.random.wrap_key_data(jnp.asarray(tree["key_data"]))
+        return (jax.device_put(jnp.asarray(emb_in, jnp.float32), rep),
+                jax.device_put(jnp.asarray(emb_out, jnp.float32), rep),
+                key)
+
+    # fingerprint excludes `steps` (resuming into a longer run is legal,
+    # matching als_train) but covers the pair table — which encodes the
+    # corpus, vocab, and window — and every update-shaping config knob
+    fp = fingerprint_of(pairs, (v, cfg.dim, cfg.negatives, cfg.batch_size,
+                                cfg.learning_rate, cfg.seed, use_sharded,
+                                "w2v.v1"))
+    state, history, _ = segmented_train(
+        total_steps=cfg.steps,
+        init_state=init_state,
+        run_chunk=run_chunk,
+        state_to_host=state_to_host,
+        state_from_host=state_from_host,
+        fingerprint=fp,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        fault_site="w2v.step_boundary",
+        name="word2vec_train",
     )
+    emb = state[0]
+    if history:
+        log.info(
+            "word2vec_train: vocab %d, %d pairs, %d steps, loss %.4f → %.4f",
+            v, len(pairs), cfg.steps, history[0], history[-1],
+        )
     return Word2VecModel(vectors=np.asarray(emb), vocab=vocab)

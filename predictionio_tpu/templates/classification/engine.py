@@ -264,6 +264,9 @@ class LogisticRegressionAlgorithm(Algorithm):
             iterations=self.params.iterations,
             learning_rate=self.params.stepSize,
             reg=self.params.regParam, mesh=ctx.mesh,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("lr"),
+            checkpoint_every=ctx.checkpoint_every_or(
+                max(1, self.params.iterations // 10)),
         )
         return LRServingModel(lr=lr, classes=pd.classes,
                               attributes=pd.attributes)

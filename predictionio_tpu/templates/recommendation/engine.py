@@ -217,7 +217,7 @@ class ALSAlgorithm(Algorithm):
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
             cfg=cfg, mesh=ctx.mesh, compute_rmse=p.computeRMSE,
             checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
-            checkpoint_every=ctx.checkpoint_every,
+            checkpoint_every=ctx.checkpoint_every_or(1),
             bucket_cache_dir=ctx.algorithm_cache_dir("als"),
         )
         # epoch_times covers only epochs executed this call (a resumed run

@@ -261,6 +261,9 @@ class LRAlgorithm(Algorithm):
             iterations=self.params.iterations,
             learning_rate=self.params.stepSize,
             reg=self.params.regParam, mesh=ctx.mesh,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("lr"),
+            checkpoint_every=ctx.checkpoint_every_or(
+                max(1, self.params.iterations // 10)),
         )
         return TfIdfClassifierModel(
             kind="lr", nb=None, lr=lr, idf=idf,
@@ -342,12 +345,23 @@ class Word2VecAlgorithm(Algorithm):
             learning_rate=p.learningRate, min_count=p.minCount,
             seed=ctx.seed if p.seed is None else p.seed,
         )
-        w2v = word2vec_train(pd.tokens, cfg, mesh=ctx.mesh)
+        # two checkpointed phases under separate subdirs: a crash during
+        # the head train resumes embeddings instantly from the completed
+        # w2v checkpoint instead of re-running the SGNS loop
+        w2v = word2vec_train(
+            pd.tokens, cfg, mesh=ctx.mesh,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("w2v"),
+            checkpoint_every=ctx.checkpoint_every_or(
+                max(1, cfg.steps // 10)),
+        )
         docs = np.stack([w2v.doc_vector(t) for t in pd.tokens])
         lr = logreg_train(
             docs, pd.label_idx, n_classes=len(pd.classes),
             iterations=p.iterations, learning_rate=p.stepSize,
             reg=p.regParam, mesh=ctx.mesh,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("w2v-head"),
+            checkpoint_every=ctx.checkpoint_every_or(
+                max(1, p.iterations // 10)),
         )
         return W2VClassifierModel(w2v=w2v, lr=lr, classes=pd.classes)
 

@@ -24,6 +24,9 @@ Sites in the tree:
   its checkpoint save; armed per-rank it kills one member of a
   multi-process world at the worst moment (the elastic-recovery drill,
   test_failure_paths.py::TestElasticRecovery)
+- `w2v.step_boundary` / `logreg.step_boundary` — the same
+  chunk-computed-but-not-saved moment for the segmented W2V SGNS and
+  LogReg Adam trainers (workflow/segmented.py)
 """
 
 from __future__ import annotations

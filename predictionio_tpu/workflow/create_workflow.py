@@ -47,7 +47,7 @@ def run_train(
     skip_sanity_check: bool = False,
     verbose: int = 0,
     checkpoint_dir: Optional[str] = None,
-    checkpoint_every: int = 1,
+    checkpoint_every: Optional[int] = None,
     profile_dir: Optional[str] = None,
     metrics_file: Optional[str] = None,
     debug_nans: bool = False,

@@ -1,0 +1,165 @@
+"""Generic segmented (chunked-dispatch) training with fingerprinted
+checkpoints.
+
+The ALS trainer established the framework's checkpoint/resume contract
+(ops/als.py: fingerprinted per-chunk saves, crash-safe overwrite,
+PIO_PERSIST_RANK writer, stale-step purge discipline — SURVEY.md §5
+'Checkpoint / resume', «CoreWorkflow.runTrain» idempotent re-run
+contract [U]). VERDICT r4 missing #1: that contract covered ONLY ALS,
+leaving the W2V SGNS loop and LogReg's Adam scan as single
+uncheckpointed dispatches — a mid-train crash of a long text
+`pio train` lost everything.
+
+This module factors the discipline out so every scan-based trainer
+shares it. A trainer provides four callbacks over an opaque device
+state pytree and gets back the exact ALS semantics:
+
+- without `checkpoint_dir`: ONE dispatch for the whole run (no host
+  round trips — this TPU sits behind a tunnel);
+- with it: `checkpoint_every`-step dispatches, the state checkpointed
+  after each, resumable after a kill with results matching the
+  uninterrupted run;
+- a checkpoint only resumes the *same* run: data + config fingerprint
+  mismatch retrains from scratch (nightly retrain into the same dir
+  must not return yesterday's model);
+- multi-process worlds: every rank restores (consistent global start
+  state) and computes, only the persist rank (PIO_PERSIST_RANK,
+  default 0) writes — N ranks racing save/keep_only on a shared dir
+  could interleave delete-vs-write mid-step;
+- stale steps from a previous run are purged right before this run's
+  FIRST save, not at start (eager deletion would open a window — run
+  start until first save — in which a crash leaves no checkpoint);
+- `faults.inject` fires at every chunk boundary (between a computed
+  chunk and its save — the worst moment for a rank to die), so kill
+  drills can target any trainer through one site name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+def fingerprint_of(*parts: Any) -> str:
+    """blake2b digest over byte/str parts (ndarray-friendly)."""
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=8)
+    for p in parts:
+        if isinstance(p, bytes):
+            h.update(p)
+        elif isinstance(p, np.ndarray):
+            h.update(np.ascontiguousarray(p).tobytes())
+        else:
+            h.update(repr(p).encode())
+    return h.hexdigest()
+
+
+def segmented_train(
+    *,
+    total_steps: int,
+    init_state: Callable[[], Any],
+    run_chunk: Callable[[Any, int, int], tuple[Any, list]],
+    state_to_host: Callable[[Any], dict],
+    state_from_host: Callable[[dict], Any],
+    fingerprint: str,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
+    fault_site: str = "segment.boundary",
+    name: str = "train",
+    resume: bool = True,
+) -> tuple[Any, list, int]:
+    """Run `total_steps` of a scan-based trainer with optional
+    checkpointing. Returns `(final_state, history, start_step)` where
+    `history` holds one metric entry per ABSOLUTE step (resumed prefix
+    included, restored from checkpoint metadata) and `start_step` is the
+    resume point (0 for a fresh run).
+
+    Callbacks:
+    - `init_state()` → fresh device state pytree.
+    - `run_chunk(state, n_steps, done)` → `(state, step_metrics)`;
+      `done` is the absolute step count before the chunk. MUST fence
+      execution before returning (a scalar readback — ALS's pattern;
+      `jax.block_until_ready` can return early behind the axon tunnel)
+      so the fault-injection point and the save see finished compute.
+    - `state_to_host(state)` → JSON-free numpy pytree for
+      `CheckpointManager.save`. Runs on every rank (any collectives in
+      a multi-host gather need all ranks); only the persist rank's
+      result is written.
+    - `state_from_host(tree)` → device state, raising on a foreign /
+      shape-mismatched tree (treated as "train from scratch", matching
+      als_train's guard).
+    """
+    import jax
+
+    from predictionio_tpu.utils import faults
+
+    history: list = []
+    start_step = 0
+    state = None
+    manager = None
+    restore_step = None
+    ckpt_rank = 0
+    if checkpoint_dir and total_steps > 0:
+        from predictionio_tpu.parallel.distributed import persist_rank
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        # resolve the writer rank ONCE, before any step runs — an
+        # out-of-range PIO_PERSIST_RANK must fail here, not discard a
+        # computed chunk at the first save
+        ckpt_rank = persist_rank()
+        manager = CheckpointManager(checkpoint_dir)
+        if resume:
+            usable = [s for s in manager.all_steps() if s <= total_steps]
+            if usable:
+                tree, meta = manager.restore(usable[-1])
+                if meta.get("fingerprint") == fingerprint:
+                    try:
+                        state = state_from_host(tree)
+                    except Exception as e:
+                        log.warning("%s: checkpoint step %d unusable (%s) "
+                                    "— training from scratch",
+                                    name, usable[-1], e)
+                        state = None
+                if state is not None:
+                    start_step = restore_step = usable[-1]
+                    history = list(meta.get("history", []))[:start_step]
+                    log.info("%s: resumed from checkpoint step %d",
+                             name, restore_step)
+                else:
+                    log.warning(
+                        "%s: checkpoint at %s is from different data/config "
+                        "(or a foreign tree) — training from scratch",
+                        name, checkpoint_dir)
+    if state is None:
+        state = init_state()
+
+    every = max(1, checkpoint_every or total_steps)
+    done = start_step
+    first_save_done = False
+    while done < total_steps:
+        n_steps = (min(every, total_steps - done)
+                   if manager else total_steps - done)
+        state, metrics = run_chunk(state, n_steps, done)
+        done += n_steps
+        history.extend(metrics)
+        faults.inject(fault_site)
+        if manager:
+            host_tree = state_to_host(state)
+            if jax.process_index() == ckpt_rank:
+                if not first_save_done:
+                    manager.keep_only(restore_step)
+                    first_save_done = True
+                manager.save(done, host_tree,
+                             metadata={"history": [float(v) for v in history],
+                                       "total_steps": total_steps,
+                                       "fingerprint": fingerprint})
+    if (manager and jax.process_index() == ckpt_rank
+            and not first_save_done and restore_step is not None):
+        # fully-resumed run (no new saves): purge stale steps now — the
+        # restore point is on disk, so there's no crash window here
+        manager.keep_only(restore_step)
+    return state, history, start_step

@@ -230,8 +230,8 @@ class TestWord2VecSparseStep:
         emb_out0 = jnp.asarray(rng.normal(size=(V, cfg.dim)), jnp.float32)
         key = jax.random.key(7)
 
-        run = _w2v_train_loop(P, V, cfg)
-        emb_sparse, losses = run(key, pairs, emb_in0, emb_out0)
+        run = _w2v_train_loop(P, V, cfg, cfg.steps)
+        (emb_sparse, _, _), losses = run(key, pairs, emb_in0, emb_out0)
 
         # dense reference with identical sampling sequence
         def dense_run(key, emb_in, emb_out):
@@ -297,10 +297,11 @@ class TestWord2VecDataParallel:
         emb_out0 = jnp.asarray(rng.normal(size=(V, cfg.dim)), jnp.float32)
         key = jax.random.key(11)
 
-        ref, ref_losses = _w2v_train_loop(P, V, cfg)(
+        (ref, _, _), ref_losses = _w2v_train_loop(P, V, cfg, cfg.steps)(
             key, pairs, emb_in0, emb_out0)
         mesh = make_mesh({DATA_AXIS: 8})
-        out, losses = _w2v_train_loop_sharded(P, V, cfg, mesh)(
+        (out, _, _), losses = _w2v_train_loop_sharded(P, V, cfg, cfg.steps,
+                                                        mesh)(
             key, pairs, emb_in0, emb_out0)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-6)
