@@ -230,6 +230,12 @@ def cmd_deploy(args) -> int:
         engine_version=args.engine_version,
         engine_variant=engine_variant,
     )
+    if getattr(args, "workers", 1) and args.workers > 1:
+        # pre-fork BEFORE any storage/jax/model state exists in this
+        # process — each worker loads its own (workflow/worker_pool.py)
+        from predictionio_tpu.workflow.worker_pool import run_worker_pool
+
+        return run_worker_pool(config, args.workers)
     try:
         server = PredictionServer(config)
     except (RuntimeError, ImportError, AttributeError, ValueError, TypeError,
@@ -474,9 +480,13 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--skip-sanity-check", action="store_true")
     train.add_argument("--checkpoint-dir", default=None,
                        help="checkpoint trainer state here every "
-                            "--checkpoint-every epochs; re-running train "
-                            "resumes from the latest step")
-    train.add_argument("--checkpoint-every", type=int, default=1)
+                            "--checkpoint-every steps of each "
+                            "algorithm's unit (ALS: epochs; W2V/LogReg: "
+                            "scan iterations); re-running train resumes "
+                            "from the latest step")
+    train.add_argument("--checkpoint-every", type=int, default=None,
+                       help="default: per-algorithm (ALS every epoch; "
+                            "step-loop trainers ~10 saves per run)")
     train.add_argument("--profile-dir", default=None,
                        help="capture a jax.profiler trace here "
                             "(TensorBoard/Perfetto layout)")
@@ -498,6 +508,12 @@ def build_parser() -> argparse.ArgumentParser:
     deploy = sub.add_parser("deploy")
     deploy.add_argument("--ip", default="0.0.0.0")
     deploy.add_argument("--port", type=int, default=8000)
+    deploy.add_argument("--workers", type=int, default=1,
+                        help="N pre-forked serving processes sharing the "
+                             "port via SO_REUSEPORT (kernel-balanced; "
+                             "/reload and /stop fan out to all); each "
+                             "worker is a full process with its own GIL, "
+                             "so qps scales with cores")
     deploy.add_argument("--engine-id", default=None)
     deploy.add_argument("--engine-version", default="1")
     deploy.add_argument("--engine-variant", default=None)

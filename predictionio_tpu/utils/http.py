@@ -77,11 +77,28 @@ class _Server(ThreadingHTTPServer):
                          client_address, exc_info=True)
 
 
+class _ReusePortServer(_Server):
+    # SO_REUSEPORT before bind: N processes listen on ONE port and the
+    # kernel load-balances incoming connections across them — the
+    # `pio deploy --workers N` pre-fork scale-out (workflow/worker_pool).
+    # Set explicitly in server_bind rather than via socketserver's
+    # allow_reuse_port, which is inert before Python 3.11 (pyproject
+    # declares >= 3.10).
+    def server_bind(self):
+        import socket
+
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 class HttpService:
     """Owns a ThreadingHTTPServer + background thread lifecycle."""
 
-    def __init__(self, ip: str, port: int, handler_cls: Type[BaseHTTPRequestHandler]):
-        self.httpd = _Server((ip, port), handler_cls)
+    def __init__(self, ip: str, port: int,
+                 handler_cls: Type[BaseHTTPRequestHandler],
+                 reuse_port: bool = False):
+        cls = _ReusePortServer if reuse_port else _Server
+        self.httpd = cls((ip, port), handler_cls)
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from typing import Any, Optional
 
@@ -106,16 +107,26 @@ def load_served_state(
 
 
 class PredictionServer(HttpService):
+    """One serving process. Under `pio deploy --workers N`
+    (workflow/worker_pool.py) N of these run pre-forked on one
+    SO_REUSEPORT-shared port; `supervisor_pid` is then set and the
+    /reload//stop verbs fan out through the supervisor's signals so one
+    HTTP request reaches every worker — the «MasterActor» supervision
+    role [U] (SURVEY.md §3.2) made multi-process."""
+
     def __init__(self, config: ServerConfig, storage: Optional[Storage] = None,
-                 plugins=None):
+                 plugins=None, reuse_port: bool = False,
+                 supervisor_pid: Optional[int] = None):
         from predictionio_tpu.plugins import load_plugins_from_env
 
         self.config = config
         self.storage = storage or Storage.get()
         self.plugins = (plugins if plugins is not None
                         else load_plugins_from_env())
+        self.supervisor_pid = supervisor_pid
         self._state = load_served_state(self.storage, config)
         self._state_lock = threading.Lock()
+        worker_pid = os.getpid()
         server = self
 
         class Handler(JsonRequestHandler):
@@ -134,6 +145,9 @@ class PredictionServer(HttpService):
                         "engineFactory": state.instance.engine_factory,
                         "engineInstanceId": state.instance.id,
                         "startTime": state.instance.start_time.isoformat(),
+                        # which pool worker answered — the observable
+                        # receipt that SO_REUSEPORT is really balancing
+                        "workerPid": worker_pid,
                     })
                 return self._send(404, {"message": "Not Found"})
 
@@ -157,10 +171,17 @@ class PredictionServer(HttpService):
                         return self._send(400, {"message": str(e)})
                     return self._send(200, result)
                 if self.path == "/reload":
+                    if server.supervisor_pid is not None:
+                        # pool mode: the kernel routed this request to ONE
+                        # worker; the supervisor's SIGHUP reaches them all
+                        # (this one included)
+                        import signal
+
+                        os.kill(server.supervisor_pid, signal.SIGHUP)
+                        return self._send(200, {
+                            "message": "Reload signaled to all workers"})
                     try:
-                        with server._state_lock:
-                            server._state = load_served_state(
-                                server.storage, server.config)
+                        server.reload()
                     except Exception as e:
                         return self._send(500, {"message": str(e)})
                     return self._send(200, {
@@ -168,12 +189,28 @@ class PredictionServer(HttpService):
                         "engineInstanceId": server._state.instance.id,
                     })
                 if self.path == "/stop":
+                    if server.supervisor_pid is not None:
+                        import signal
+
+                        self._send(200, {
+                            "message": "Shutting down all workers."})
+                        os.kill(server.supervisor_pid, signal.SIGTERM)
+                        return None
                     self._send(200, {"message": "Shutting down."})
                     threading.Thread(target=server.shutdown, daemon=True).start()
                     return None
                 return self._send(404, {"message": "Not Found"})
 
-        HttpService.__init__(self, config.ip, config.port, Handler)
+        HttpService.__init__(self, config.ip, config.port, Handler,
+                             reuse_port=reuse_port)
+
+    def reload(self) -> None:
+        """Swap to the newest COMPLETED instance (idempotent, atomic).
+        Called from the /reload handler and, in pool mode, from the
+        worker's SIGHUP handler."""
+        with self._state_lock:
+            self._state = load_served_state(self.storage, self.config)
+        log.info("Reloaded engine instance %s", self._state.instance.id)
 
     @property
     def instance_id(self) -> str:
