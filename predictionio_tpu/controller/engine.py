@@ -113,6 +113,12 @@ class Engine:
                               "serving"),
             engine_params.serving_params,
         )
+        check = getattr(serving, "check_against_algorithms", None)
+        if check is not None:
+            # fail a serving/algorithms mismatch HERE — at train, deploy,
+            # and eval entry — not as a 500 on every production query
+            # (e.g. WeightedServing with N weights for M algorithms)
+            check([name for name, _ in algos])
         return ds, prep, algos, serving
 
     # -- train (CoreWorkflow.runTrain inner loop, SURVEY.md §3.1) ----------

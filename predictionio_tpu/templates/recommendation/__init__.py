@@ -12,11 +12,15 @@ from predictionio_tpu.templates.recommendation.engine import (
     ALSAlgorithmParams,
     DataSource,
     DataSourceParams,
+    PopularityAlgorithm,
+    PopularityParams,
     Preparator,
     PreparedData,
     Query,
     RecommendationEngine,
     TrainingData,
+    WeightedServing,
+    WeightedServingParams,
 )
 
 __all__ = [
@@ -28,5 +32,9 @@ __all__ = [
     "TrainingData",
     "ALSAlgorithm",
     "ALSAlgorithmParams",
+    "PopularityAlgorithm",
+    "PopularityParams",
+    "WeightedServing",
+    "WeightedServingParams",
     "Query",
 ]

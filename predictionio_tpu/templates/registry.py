@@ -33,15 +33,26 @@ BUILTIN_TEMPLATES: dict[str, TemplateInfo] = {
     for t in [
         TemplateInfo(
             name="recommendation",
-            description="Personalized item recommendation via mesh-sharded ALS",
+            description="Personalized item recommendation via mesh-sharded "
+                        "ALS blended with an item-popularity baseline",
             engine_factory=(
                 "predictionio_tpu.templates.recommendation.RecommendationEngine"),
             engine_json={
                 "datasource": {"params": {
                     "appName": "MyApp", "eventNames": ["rate", "buy"]}},
-                "algorithms": [{"name": "als", "params": {
-                    "rank": 10, "numIterations": 10, "lambda": 0.01,
-                    "seed": 3}}],
+                # two algorithms, blended by WeightedServing — the
+                # multi-algorithm capability as the shipped default
+                # («Engine.algorithmClassMap» + «LAverageServing» [U]);
+                # popularity backstops ALS on cold-start users
+                "algorithms": [
+                    {"name": "als", "params": {
+                        "rank": 10, "numIterations": 10, "lambda": 0.01,
+                        "seed": 3}},
+                    {"name": "popular", "params": {
+                        "weightByRating": False}},
+                ],
+                "serving": {"name": "weighted",
+                            "params": {"weights": [0.8, 0.2]}},
             },
             sample_query={"user": "1", "num": 4},
         ),

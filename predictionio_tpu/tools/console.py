@@ -230,7 +230,7 @@ def cmd_deploy(args) -> int:
         engine_version=args.engine_version,
         engine_variant=engine_variant,
     )
-    if getattr(args, "workers", 1) and args.workers > 1:
+    if getattr(args, "workers", 1) > 1:
         # pre-fork BEFORE any storage/jax/model state exists in this
         # process — each worker loads its own (workflow/worker_pool.py)
         from predictionio_tpu.workflow.worker_pool import run_worker_pool

@@ -66,6 +66,18 @@ class _ServedState:
         self.instance = instance
 
 
+def _row_block(raw: Optional[str]) -> dict:
+    """Decode a stored component block. Rows written since round 5 carry
+    `{"name": ..., "params": {...}}` (the component name must survive
+    the round trip — see workflow_utils.engine_params_to_json); older
+    rows stored the bare params dict, still decoded as an unnamed
+    block."""
+    d = json.loads(raw or "{}")
+    if isinstance(d, dict) and "params" in d and set(d) <= {"name", "params"}:
+        return d
+    return {"params": d}
+
+
 def variant_from_instance(instance: EngineInstance) -> EngineVariant:
     """Rebuild an EngineVariant from the params JSON stored on the
     EngineInstance row (`pio deploy` reads the row, not engine.json —
@@ -73,10 +85,10 @@ def variant_from_instance(instance: EngineInstance) -> EngineVariant:
     return EngineVariant.from_dict({
         "id": instance.engine_id,
         "engineFactory": instance.engine_factory,
-        "datasource": {"params": json.loads(instance.data_source_params or "{}")},
-        "preparator": {"params": json.loads(instance.preparator_params or "{}")},
+        "datasource": _row_block(instance.data_source_params),
+        "preparator": _row_block(instance.preparator_params),
         "algorithms": json.loads(instance.algorithms_params or "[]") or [{}],
-        "serving": {"params": json.loads(instance.serving_params or "{}")},
+        "serving": _row_block(instance.serving_params),
     })
 
 

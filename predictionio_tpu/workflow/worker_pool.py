@@ -115,9 +115,15 @@ def run_worker_pool(config, n_workers: int) -> int:
             # child: the fork inherits the supervisor's broadcast
             # handlers — reset them FIRST, or a SIGTERM landing during
             # the slow model load would re-broadcast instead of dying
-            # (and a recycled-pid broadcast could hit strangers)
-            for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+            # (and a recycled-pid broadcast could hit strangers).
+            # SIGHUP is IGNORED (not SIG_DFL) until the server is up: a
+            # routine /reload racing this worker's multi-second model
+            # load must not kill it — it will load the newest instance
+            # anyway; _worker_main installs the real reload handler
+            # once ready.
+            for sig in (signal.SIGTERM, signal.SIGINT):
                 signal.signal(sig, signal.SIG_DFL)
+            signal.signal(signal.SIGHUP, signal.SIG_IGN)
             # drop supervisor-only fds, run, and _exit (never return
             # into the supervisor's stack)
             os.close(read_fd)
@@ -186,6 +192,15 @@ def run_worker_pool(config, n_workers: int) -> int:
                 break
             except InterruptedError:
                 continue
+            if not workers.get(pid, False):
+                # readiness arrives via the pipe's reader THREAD while
+                # deaths are reaped synchronously here: a worker that
+                # wrote its ready mark and died moments later (OOM right
+                # after load) must not be misread as a startup failure —
+                # give the reader a beat to drain the mark
+                import time
+
+                time.sleep(0.2)
             was_ready = workers.pop(pid, False)
             if state["shutting_down"]:
                 continue

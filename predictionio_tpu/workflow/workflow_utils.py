@@ -168,21 +168,30 @@ def extract_engine_params(engine: Engine, variant: EngineVariant) -> EngineParam
 
 
 def engine_params_to_json(engine_params: EngineParams) -> dict[str, str]:
-    """Serialize EngineParams blocks for EngineInstance metadata rows."""
+    """Serialize EngineParams blocks for EngineInstance metadata rows.
+
+    Every block stores `{"name": ..., "params": {...}}` — the component
+    NAME must survive the row round trip, or `pio deploy` rebuilding the
+    variant from the stored instance would resolve multi-entry class
+    maps to the wrong component (a weighted-serving train deployed as
+    FirstServing). Algorithms always stored names; round 5 extended the
+    envelope to the other three roles when the multi-algorithm template
+    made non-default serving real."""
     from predictionio_tpu.controller.params import params_to_dict
 
+    def block(name, p):
+        return json.dumps(
+            {"name": name, "params": params_to_dict(p) if p else {}})
+
     return {
-        "data_source_params": json.dumps(
-            params_to_dict(engine_params.data_source_params)
-            if engine_params.data_source_params else {}),
-        "preparator_params": json.dumps(
-            params_to_dict(engine_params.preparator_params)
-            if engine_params.preparator_params else {}),
+        "data_source_params": block(engine_params.data_source_name,
+                                    engine_params.data_source_params),
+        "preparator_params": block(engine_params.preparator_name,
+                                   engine_params.preparator_params),
         "algorithms_params": json.dumps([
             {"name": name, "params": params_to_dict(p) if p else {}}
             for name, p in engine_params.algorithm_params_list
         ]),
-        "serving_params": json.dumps(
-            params_to_dict(engine_params.serving_params)
-            if engine_params.serving_params else {}),
+        "serving_params": block(engine_params.serving_name,
+                                engine_params.serving_params),
     }

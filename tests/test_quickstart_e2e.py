@@ -170,6 +170,15 @@ def test_quickstart_recommendation(rig, tmp_path):
     assert scores == sorted(scores, reverse=True)
     # items are real item ids from the import
     assert all(1 <= int(r["item"]) <= 30 for r in result["itemScores"])
+    # 7. the stock engine.json is MULTI-ALGORITHM (ALS + popularity
+    # blended by WeightedServing): an unknown user — where ALS alone
+    # predicts nothing — still gets the popularity baseline through the
+    # blend. This is the user-path receipt that the second algorithm
+    # trained, persisted, and contributes to served results.
+    assert "Training completed" in out  # both algos trained in step 5
+    cold = engine.send_query({"user": "never-seen", "num": 4})
+    assert len(cold["itemScores"]) == 4, cold
+    assert all(1 <= int(r["item"]) <= 30 for r in cold["itemScores"])
 
 
 def test_eventserver_rest_conformance(rig):
