@@ -214,7 +214,7 @@ def _logreg_fit(n_classes: int, n_steps: int, lr: float, reg: float):
 
 
 @functools.lru_cache(maxsize=16)
-def _logreg_fit_grid(n_classes: int, iterations: int):
+def _logreg_fit_grid(n_classes: int, n_steps: int):
     import jax
     import jax.numpy as jnp
     import optax
@@ -225,27 +225,39 @@ def _logreg_fit_grid(n_classes: int, iterations: int):
     # `_logreg_fit` bit for bit modulo vmap layout.
     base = optax.scale_by_adam()
 
-    def fit_one(lr, reg, params0, x, y, w):
+    def fit_one(lr, reg, n_iter, params0, x, y, w):
         def loss_fn(params):
             logits = x @ params["w"] + params["b"]
             ll = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             data = (ll * w).sum() / jnp.maximum(w.sum(), 1.0)
             return data + 0.5 * reg * jnp.sum(params["w"] ** 2)
 
-        def step(carry, _):
+        def step(carry, t):
             params, state = carry
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, state = base.update(grads, state, params)
-            params = jax.tree.map(lambda p, u: p - lr * u, params, updates)
+            updates, new_state = base.update(grads, state, params)
+            new_params = jax.tree.map(lambda p, u: p - lr * u,
+                                      params, updates)
+            # per-cell iteration horizon (traced): past its own count a
+            # cell carries params AND optimizer state unchanged, landing
+            # exactly on its sequential result while longer cells keep
+            # stepping
+            act = t < n_iter
+            params = jax.tree.map(
+                lambda new, old: jnp.where(act, new, old),
+                new_params, params)
+            state = jax.tree.map(
+                lambda new, old: jnp.where(act, new, old),
+                new_state, state)
             return (params, state), loss
 
         (params, _), losses = jax.lax.scan(
-            step, (params0, base.init(params0)), xs=None, length=iterations)
+            step, (params0, base.init(params0)), xs=jnp.arange(n_steps))
         return params, losses
 
-    def run(lrs, regs, params0, x, y, w):
-        return jax.vmap(fit_one, in_axes=(0, 0, None, None, None, None))(
-            lrs, regs, params0, x, y, w)
+    def run(lrs, regs, n_iters, params0, x, y, w):
+        return jax.vmap(fit_one, in_axes=(0, 0, 0, None, None, None, None))(
+            lrs, regs, n_iters, params0, x, y, w)
 
     return jax.jit(run)
 
@@ -254,16 +266,19 @@ def logreg_train_grid(
     features: np.ndarray,
     labels: np.ndarray,
     n_classes: int,
-    iterations: int,
+    iterations,
     learning_rates,
     regs,
     mesh=None,
 ) -> "list[LogRegModel]":
-    """N (stepSize, regParam) grid cells as ONE device program: the
-    full-batch Adam scan vmaps over a traced [G] hyperparameter axis —
-    one compile, one dispatch, the sharded example matmuls batched
-    [G, N, D] on the MXU instead of re-dispatched per cell. `iterations`
-    must be shared (it sets the scan length — a static)."""
+    """N (stepSize, regParam, iterations) grid cells as ONE device
+    program: the full-batch Adam scan vmaps over a traced [G]
+    hyperparameter axis — one compile, one dispatch, the sharded example
+    matmuls batched [G, N, D] on the MXU instead of re-dispatched per
+    cell. `iterations` is an int shared by every cell OR a per-cell
+    sequence (round 5): the scan runs max(iterations) steps and each
+    cell freezes params + optimizer state at its own horizon, matching
+    its sequential train."""
     import jax.numpy as jnp
 
     from predictionio_tpu.parallel.mesh import DATA_AXIS, make_mesh
@@ -281,14 +296,26 @@ def logreg_train_grid(
     }
     lrs = jnp.asarray([float(v) for v in learning_rates], jnp.float32)
     rgs = jnp.asarray([float(v) for v in regs], jnp.float32)
-    params, losses = _logreg_fit_grid(n_classes, int(iterations))(
-        lrs, rgs, params0, x, y, w)
+    if np.ndim(iterations) == 0:
+        iters_list = [int(iterations)] * int(len(lrs))
+    else:
+        iters_list = [int(v) for v in iterations]
+    if len(iters_list) != len(lrs):
+        raise ValueError(
+            f"logreg_train_grid: {len(iters_list)} iteration counts for "
+            f"{len(lrs)} cells")
+    n_steps = max(iters_list) if iters_list else 0
+    n_iters = jnp.asarray(iters_list, jnp.int32)
+    params, losses = _logreg_fit_grid(n_classes, n_steps)(
+        lrs, rgs, n_iters, params0, x, y, w)
     wts = np.asarray(params["w"])
     bs = np.asarray(params["b"])
     ls = np.asarray(losses)
     return [
         LogRegModel(weights=wts[g], bias=bs[g],
-                    loss_history=[float(v) for v in ls[g]])
+                    # post-horizon rows re-measure frozen params — slice
+                    # to the cell's own history
+                    loss_history=[float(v) for v in ls[g][:iters_list[g]]])
         for g in range(len(lrs))
     ]
 

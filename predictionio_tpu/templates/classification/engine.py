@@ -278,16 +278,13 @@ class LogisticRegressionAlgorithm(Algorithm):
     @classmethod
     def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
                    algos) -> Optional[list]:
-        """A (stepSize, regParam) grid as ONE device program — the Adam
-        scan vmapped over a traced [G] hyperparameter axis. `iterations`
-        sets the scan length (a static), so mixed-iteration grids fall
-        back to sequential."""
-        iters = {a.params.iterations for a in algos}
-        if len(iters) != 1:
-            return None
+        """A (stepSize, regParam, iterations) grid as ONE device program
+        — the Adam scan vmapped over a traced [G] hyperparameter axis,
+        with mixed iteration counts handled by a traced per-cell horizon
+        (each cell freezes at its own count — round 5)."""
         lrs = logreg_train_grid(
             pd.features, pd.label_idx, n_classes=len(pd.classes),
-            iterations=iters.pop(),
+            iterations=[a.params.iterations for a in algos],
             learning_rates=[a.params.stepSize for a in algos],
             regs=[a.params.regParam for a in algos], mesh=ctx.mesh)
         return [LRServingModel(lr=lr, classes=pd.classes,

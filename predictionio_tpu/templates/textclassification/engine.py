@@ -276,17 +276,18 @@ class LRAlgorithm(Algorithm):
     @classmethod
     def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
                    algos) -> Optional[list]:
-        """A (stepSize, regParam) grid as one device program over a
-        SHARED tf-idf featurization; iterations and featurization params
-        must agree across cells (sequential fallback otherwise)."""
-        if len({(a.params.numFeatures, a.params.minDocFreq,
-                 a.params.iterations) for a in algos}) != 1:
+        """A (stepSize, regParam, iterations) grid as one device program
+        over a SHARED tf-idf featurization; featurization params must
+        agree across cells (sequential fallback otherwise), while mixed
+        iteration counts batch via the traced per-cell horizon."""
+        if len({(a.params.numFeatures, a.params.minDocFreq)
+                for a in algos}) != 1:
             return None
         tf = hashing_tf(pd.tokens, algos[0].params.numFeatures)
         idf = idf_fit(tf, algos[0].params.minDocFreq)
         lrs = logreg_train_grid(
             idf.transform(tf), pd.label_idx, n_classes=len(pd.classes),
-            iterations=algos[0].params.iterations,
+            iterations=[a.params.iterations for a in algos],
             learning_rates=[a.params.stepSize for a in algos],
             regs=[a.params.regParam for a in algos], mesh=ctx.mesh)
         return [

@@ -30,10 +30,18 @@ class TestGridCompatible:
                 for r, a, s in ((0.01, 1.0, 0), (0.1, 2.0, 1))]
         assert grid_compatible(cfgs) is None
 
+    def test_mixed_iterations_ok(self):
+        """r5: an iterations sweep — the cheapest and most-used grid
+        axis — batches via the traced per-cell horizon instead of
+        degrading to sequential trains."""
+        cfgs = [dataclasses.replace(self.BASE, iterations=n)
+                for n in (2, 5, 3)]
+        assert grid_compatible(cfgs) is None
+
     @pytest.mark.parametrize("field,value", [
         ("rank", 16), ("implicit", True), ("split_cap", 64),
         ("cap_growth", 2.0), ("compute_dtype", "bfloat16"),
-        ("weighted_reg", False), ("iterations", 5),
+        ("weighted_reg", False),
     ])
     def test_static_mismatch_rejected(self, field, value):
         cfgs = [self.BASE, dataclasses.replace(self.BASE, **{field: value})]
@@ -86,6 +94,28 @@ class TestGridMatchesSequential:
         for cfg, gr in zip(cfgs, grid):
             seq = als_train(u, i, v, n_u, n_i, cfg)
             assert rel_err(gr.user_factors, seq.user_factors) < 1e-4
+
+    def test_mixed_iterations_match_sequential_per_cell(self):
+        """r4-weak-#3 closed: cells with DIFFERENT iteration counts in
+        one grid program — each must equal its own sequential train
+        (the traced horizon freezes a finished cell's factors), and the
+        rmse history must be each cell's own length."""
+        u, i, v, n_u, n_i = coo()
+        base = ALSConfig(rank=8, iterations=0, seed=5, split_cap=64)
+        cfgs = [dataclasses.replace(base, iterations=n, reg=r)
+                for n, r in ((2, 0.1), (5, 0.1), (3, 0.02))]
+        grid = als_train_grid(u, i, v, n_u, n_i, cfgs, compute_rmse=True)
+        for cfg, gr in zip(cfgs, grid):
+            seq = als_train(u, i, v, n_u, n_i, cfg, compute_rmse=True)
+            assert rel_err(gr.user_factors, seq.user_factors) < 1e-4
+            assert rel_err(gr.item_factors, seq.item_factors) < 1e-4
+            assert len(gr.rmse_history) == cfg.iterations
+            assert gr.rmse_history == pytest.approx(seq.rmse_history,
+                                                    rel=1e-4)
+            assert len(gr.epoch_times) == cfg.iterations
+        # the 2-iter and 5-iter cells share λ: the horizon must make
+        # them genuinely different, not clones of the longest run
+        assert rel_err(grid[0].user_factors, grid[1].user_factors) > 1e-3
 
     def test_incompatible_grid_raises(self):
         u, i, v, n_u, n_i = coo(n=500, n_u=30, n_i=20)

@@ -60,6 +60,36 @@ class TestLogRegGrid:
             np.testing.assert_allclose(m.loss_history, ref.loss_history,
                                        rtol=2e-4, atol=1e-5)
 
+    def test_mixed_iterations_match_sequential_per_cell(self, data):
+        """r5: per-cell iteration horizons — each cell freezes params
+        AND Adam state at its own count, landing on its sequential
+        result; loss histories are each cell's own length."""
+        x, y, c = data
+        cells = [(0.5, 0.0, 10), (0.5, 0.0, 30), (0.1, 0.01, 20)]
+        grid = logreg_train_grid(
+            x, y, c, iterations=[n for _, _, n in cells],
+            learning_rates=[lr for lr, _, _ in cells],
+            regs=[rg for _, rg, _ in cells])
+        for (lr, rg, n), m in zip(cells, grid):
+            ref = logreg_train(x, y, c, iterations=n, learning_rate=lr,
+                               reg=rg)
+            assert len(m.loss_history) == n
+            np.testing.assert_allclose(m.weights, ref.weights,
+                                       rtol=2e-4, atol=1e-5)
+            np.testing.assert_allclose(m.bias, ref.bias,
+                                       rtol=2e-4, atol=1e-5)
+            np.testing.assert_allclose(m.loss_history, ref.loss_history,
+                                       rtol=2e-4, atol=1e-5)
+        # same (lr, reg), different horizons: genuinely different models
+        assert np.abs(grid[0].weights - grid[1].weights).max() > 1e-5
+
+    def test_iteration_count_mismatch_raises(self, data):
+        x, y, c = data
+        with pytest.raises(ValueError, match="2 iteration counts for 3"):
+            logreg_train_grid(x, y, c, iterations=[5, 10],
+                              learning_rates=[0.1, 0.2, 0.3],
+                              regs=[0.0, 0.0, 0.0])
+
 
 class TestTextTemplateGrid:
     def test_tfidf_shared_nb_grid_matches_sequential(self):
