@@ -418,21 +418,55 @@ def bench_batch_predict(n_queries: int = 8192, emit: bool = True):
     return record
 
 
-def _measure_map10(scale: str):
-    """OUR implicit MAP@10 at the bench scale under the recorded CPU
-    reference's exact protocol (see CPU_REF_MAP10). Train is implicit
-    rank-64/10-iter; eval is quality/parity.py's held-out MAP@10."""
+def _train_implicit_protocol(scale: str):
+    """THE MAP@10 parity protocol's train, in one place (the recorded
+    CPU-reference number CPU_REF_MAP10 was measured under exactly this):
+    implicit rank-64/10-iter λ=0.05 α=40 seed 0 on synth_implicit(seed 0).
+    Returns (result, split) so callers evaluate once-trained factors."""
     from predictionio_tpu.ops.als import ALSConfig, als_train
     from predictionio_tpu.quality import datasets
-    from predictionio_tpu.quality.parity import map_at_k_heldout
 
     split = datasets.synth_implicit(scale, seed=0)
     cfg = ALSConfig(rank=64, iterations=10, reg=0.05, weighted_reg=True,
                     implicit=True, alpha=40.0, seed=0)
     res = als_train(split.train_u, split.train_i, split.train_r,
                     split.n_users, split.n_items, cfg)
+    return res, split
+
+
+def _measure_map10(scale: str):
+    """OUR implicit MAP@10 at the bench scale under the recorded CPU
+    reference's exact protocol (see CPU_REF_MAP10): 20k-user sampled
+    held-out MAP@10 (quality/parity.py)."""
+    from predictionio_tpu.quality.parity import map_at_k_heldout
+
+    res, split = _train_implicit_protocol(scale)
     return map_at_k_heldout(res.user_factors, res.item_factors, split,
                             k=10, max_users=20_000)
+
+
+def bench_map10_full(scale: str = "20m"):
+    """One record pinning the 20k-user MAP@10 sampling error (VERDICT r4
+    weak #5): train ONCE, evaluate the sampled protocol AND the full
+    test population on the same factors. `bench.py --map10full`."""
+    from predictionio_tpu.quality.parity import map_at_k_heldout
+
+    res, split = _train_implicit_protocol(scale)
+    sampled = map_at_k_heldout(res.user_factors, res.item_factors, split,
+                               k=10, max_users=20_000)
+    full = map_at_k_heldout(res.user_factors, res.item_factors, split,
+                            k=10, max_users=None)
+    n_users = len(np.unique(split.test_u))
+    print(json.dumps({
+        "metric": f"map10_full_population_ml{scale}",
+        "value": round(full, 4),
+        "unit": "map@10",
+        "sampled_20k": round(sampled, 4),
+        "sampling_error": round(sampled - full, 4),
+        "n_test_users": int(n_users),
+        "vs_baseline": round(full - CPU_REF_MAP10[scale], 4),
+        "baseline": f"CPU-reference sampled MAP@10 {CPU_REF_MAP10[scale]}",
+    }))
 
 
 def bench_aggprops(n_events: int = 2_000_000, n_entities: int = 200_000,
@@ -646,11 +680,248 @@ def bench_north_star(scale: str = "20m", full: bool = True):
     print(json.dumps(record))
 
 
-def bench_eval_grid(scale: str = "2m", n_points: int = 4):
+
+def _proc_stats():
+    """(rss_mb, open_fds, threads) from /proc — zero-dependency health
+    probes for the soak drill."""
+    import threading
+
+    rss_kb = 0
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                rss_kb = int(line.split()[1])
+                break
+    fds = len(os.listdir("/proc/self/fd"))
+    return rss_kb / 1024.0, fds, threading.active_count()
+
+
+def bench_soak(duration_s: float = 600.0, emit: bool = True,
+               serving_clients: int = 2, ingest_clients: int = 2,
+               retrain_every_s: float = 20.0):
+    """Sustained mixed drill (VERDICT r4 next #6): concurrent ingest +
+    serving + a periodically re-running background train (each retrain
+    followed by a served /reload), while sampling RSS / fd count /
+    thread count — the reference's servers are months-lived JVMs, ours
+    must hold a long window with flat memory, zero errors, and no
+    starvation. `bench.py --soak [--duration 600]`; the suite runs a
+    short mechanism variant (tests/test_soak.py).
+
+    Flatness bar: median RSS of the last quarter ≤ 1.15× the second
+    quarter (the first quarter is warmup — jit caches, connection pools)
+    and fds back to ~baseline once clients disconnect."""
+    import http.client
+    import tempfile
+    import threading
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        PredictionServer, ServerConfig,
+    )
+    from predictionio_tpu.workflow.create_workflow import run_train
+
+    tmp = tempfile.mkdtemp(prefix="pio_soak_")
+    src = SourceConfig(name="SOAK", type="sqlite",
+                       path=os.path.join(tmp, "soak.db"))
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    Storage.reset(storage)
+    app_id = storage.meta_apps().insert(App(id=0, name="SoakApp"))
+    key = "soak-key"
+    storage.meta_access_keys().insert(
+        AccessKey(key=key, app_id=app_id, events=[]))
+
+    rng = np.random.default_rng(11)
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+
+    storage.l_events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=str(u),
+               target_entity_type="item", target_entity_id=str(i),
+               properties=DataMap({"rating": float(r)}))
+         for u, i, r in zip(rng.integers(0, 40, 1200),
+                            rng.integers(0, 30, 1200),
+                            rng.integers(1, 6, 1200))],
+        app_id=app_id)
+
+    engine_json = os.path.join(tmp, "engine.json")
+    with open(engine_json, "w") as f:
+        json.dump({
+            "id": "soak", "engineFactory":
+                "predictionio_tpu.templates.recommendation."
+                "RecommendationEngine",
+            "datasource": {"params": {"appName": "SoakApp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 3, "lambda": 0.05,
+                "seed": 1}}],
+        }, f)
+    run_train(engine_json=engine_json)
+
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    es.start()
+    ps = PredictionServer(ServerConfig(ip="127.0.0.1", port=0,
+                                       engine_id="soak",
+                                       engine_variant="soak"))
+    ps.start()
+
+    baseline_rss, baseline_fds, baseline_threads = _proc_stats()
+    stop = threading.Event()
+    errors: list = []
+    counts = {"serve": 0, "ingest": 0, "retrain": 0, "reload": 0}
+    lock = threading.Lock()
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:
+                errors.append(f"{type(e).__name__}: {e}")
+                stop.set()
+        return run
+
+    def serve_loop():
+        conn = http.client.HTTPConnection("127.0.0.1", ps.port, timeout=30)
+        i = 0
+        while not stop.is_set():
+            conn.request("POST", "/queries.json",
+                         json.dumps({"user": str(i % 40), "num": 3}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"serve HTTP {r.status}")
+            i += 1
+            with lock:
+                counts["serve"] += 1
+        conn.close()
+
+    def ingest_loop():
+        conn = http.client.HTTPConnection("127.0.0.1", es.port, timeout=30)
+        i = 0
+        while not stop.is_set():
+            ev = {"event": "rate", "entityType": "user",
+                  "entityId": str(i % 40), "targetEntityType": "item",
+                  "targetEntityId": str(i % 30),
+                  "properties": {"rating": float(i % 5 + 1)}}
+            conn.request("POST", f"/events.json?accessKey={key}",
+                         json.dumps(ev),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            if r.status != 201:
+                raise RuntimeError(f"ingest HTTP {r.status}")
+            i += 1
+            with lock:
+                counts["ingest"] += 1
+        conn.close()
+
+    def retrain_loop():
+        while not stop.wait(retrain_every_s):
+            run_train(engine_json=engine_json)
+            with lock:
+                counts["retrain"] += 1
+            conn = http.client.HTTPConnection("127.0.0.1", ps.port,
+                                              timeout=60)
+            conn.request("POST", "/reload", b"")
+            r = conn.getresponse()
+            r.read()
+            conn.close()
+            if r.status != 200:
+                raise RuntimeError(f"reload HTTP {r.status}")
+            with lock:
+                counts["reload"] += 1
+
+    samples: list = []
+
+    def sampler():
+        while not stop.wait(min(5.0, max(1.0, duration_s / 40))):
+            samples.append((time.perf_counter(), *_proc_stats()))
+
+    threads = ([threading.Thread(target=guard(serve_loop))
+                for _ in range(serving_clients)]
+               + [threading.Thread(target=guard(ingest_loop))
+                  for _ in range(ingest_clients)]
+               + [threading.Thread(target=guard(retrain_loop)),
+                  threading.Thread(target=sampler)])
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    stopped_early = stop.wait(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    wall = time.perf_counter() - t0
+    es.shutdown()
+    ps.shutdown()
+    end_rss, end_fds, end_threads = _proc_stats()
+
+    if errors:
+        raise SystemExit(f"soak failed after {wall:.0f}s: {errors[0]} "
+                         f"(counts {counts})")
+    if stopped_early:
+        raise SystemExit("soak stopped early without a recorded error")
+    for name, n in counts.items():
+        if n == 0 and not (name in ("retrain", "reload")
+                           and duration_s < retrain_every_s * 2):
+            raise SystemExit(f"soak starvation: zero {name} operations "
+                             f"in {wall:.0f}s (counts {counts})")
+
+    rss_series = [r for (_, r, _, _) in samples]
+    q = max(1, len(rss_series) // 4)
+    warm = float(np.median(rss_series[q:2 * q])) if len(rss_series) >= 4         else baseline_rss
+    last = float(np.median(rss_series[-q:])) if rss_series else end_rss
+    growth = last / max(warm, 1e-9)
+    record = {
+        "metric": f"soak_{int(duration_s)}s_mixed",
+        "value": round(wall, 1),
+        "unit": "s",
+        "counts": dict(counts),
+        "rss_mb": {"baseline": round(baseline_rss, 1),
+                   "warm": round(warm, 1), "last_quarter": round(last, 1),
+                   "end": round(end_rss, 1),
+                   "growth_vs_warm": round(growth, 3)},
+        "fds": {"baseline": baseline_fds, "end": end_fds},
+        "threads": {"baseline": baseline_threads, "end": end_threads},
+        "errors": 0,
+        "vs_baseline": round(growth, 3),
+        "baseline": "flat RSS bar: last-quarter median <= 1.15x "
+                    "post-warmup median",
+    }
+    if growth > 1.15:
+        record["verdict"] = "FAIL: RSS grew past the flatness bar"
+        print(json.dumps(record))
+        raise SystemExit(record["verdict"])
+    if end_fds > baseline_fds + 15:
+        record["verdict"] = f"FAIL: fd leak ({baseline_fds} -> {end_fds})"
+        print(json.dumps(record))
+        raise SystemExit(record["verdict"])
+    if emit:
+        print(json.dumps(record))
+    return record
+
+
+def bench_eval_grid(scale: str = "2m", n_points: int = 4,
+                    mixed_iters: bool = False):
     """Grid-batched eval A/B (VERDICT r3 #1): an `n_points` λ grid at
     rank 64 trained as ONE device program (ops/als_grid) vs `n_points`
     sequential `als_train` calls, same window. The done-bar: grid wall
-    ≲1.5× ONE train's wall (vs ~n_points× for sequential)."""
+    ≲1.5× ONE train's wall (vs ~n_points× for sequential).
+
+    `mixed_iters` (r5, VERDICT r4 weak #3): cells get DIFFERENT
+    iteration counts — the traced per-cell horizon batches the
+    iterations sweep, the most common grid axis — with a built-in
+    correctness gate: each cell's item factors must match its own
+    sequential train within the bf16-at-scale drift band. The band is
+    5e-2 max-rel because the EQUAL-iterations grid (the shipped r4
+    path, never factor-gated at this scale) already differs from
+    sequential by 1.7–3.2e-2 at 2M/bf16 — batched [V,G,K] einsums
+    reassociate differently than per-train einsums (measured on TPU
+    2026-07-31; the f32 small-scale tests pin 1e-4). The gate catches a
+    broken horizon (a wrong cell lands ~1e-1+ off), not bf16 noise."""
     import dataclasses
 
     from predictionio_tpu.ops.als import ALSConfig, als_train
@@ -661,7 +932,26 @@ def bench_eval_grid(scale: str = "2m", n_points: int = 4):
     base = ALSConfig(rank=64, iterations=5, reg=0.05, seed=0,
                      compute_dtype="bfloat16", solver="auto")
     lambdas = [0.01, 0.05, 0.1, 0.2][:n_points]
-    cfgs = [dataclasses.replace(base, reg=lam) for lam in lambdas]
+    iters = ([3, 5, 2, 4][:n_points] if mixed_iters
+             else [base.iterations] * n_points)
+    cfgs = [dataclasses.replace(base, reg=lam, iterations=n)
+            for lam, n in zip(lambdas, iters)]
+    if mixed_iters:
+        grid_models = als_train_grid(
+            split.train_u, split.train_i, split.train_r,
+            split.n_users, split.n_items, cfgs)
+        for cfg, gm in zip(cfgs, grid_models):
+            seq = als_train(split.train_u, split.train_i, split.train_r,
+                            split.n_users, split.n_items, cfg)
+            rel = (np.abs(gm.item_factors - seq.item_factors).max()
+                   / max(np.abs(seq.item_factors).max(), 1e-9))
+            if rel > 5e-2:  # see docstring: bf16-at-scale band, not 1e-4
+                raise SystemExit(
+                    f"mixed-iters grid cell iters={cfg.iterations} "
+                    f"diverged from sequential: rel {rel:.2e}")
+            if len(gm.rmse_history) != len(seq.rmse_history):
+                raise SystemExit("mixed-iters rmse history length mismatch")
+        del grid_models, seq
 
     def one_train(cfg):
         return als_train(split.train_u, split.train_i, split.train_r,
@@ -687,17 +977,22 @@ def bench_eval_grid(scale: str = "2m", n_points: int = 4):
         fn()
         return time.perf_counter() - t0
 
-    # same-window best-of-2, interleaved so tunnel drift hits both arms
+    # same-window best-of-2, interleaved so tunnel drift hits both arms.
+    # The one-train comparator is the LONGEST cell — with mixed horizons
+    # the grid's floor is max(iterations) steps, so that's the fair bar
+    longest = max(cfgs, key=lambda c: c.iterations)
     one_s, grid_s, seq_s = [], [], []
     for _ in range(2):
-        one_s.append(timed(lambda: one_train(cfgs[0])))
+        one_s.append(timed(lambda: one_train(longest)))
         grid_s.append(timed(grid))
         seq_s.append(timed(lambda: [one_train(c) for c in cfgs]))
     one_wall, grid_wall, seq_wall = min(one_s), min(grid_s), min(seq_s)
+    tag = "mixed_iters_" if mixed_iters else ""
     print(json.dumps({
-        "metric": f"eval_grid_{n_points}pt_ml{scale}_rank64",
+        "metric": f"eval_grid_{tag}{n_points}pt_ml{scale}_rank64",
         "value": round(grid_wall, 3),
         "unit": "s",
+        "iterations": iters,
         "one_train_wall_s": round(one_wall, 3),
         "sequential_grid_wall_s": round(seq_wall, 3),
         "grid_vs_one_train": round(grid_wall / one_wall, 2),
@@ -756,6 +1051,19 @@ if __name__ == "__main__":
     ap.add_argument("--evalgrid", action="store_true",
                     help="4-point λ grid as one device program vs "
                          "sequential trains (ops/als_grid A/B)")
+    ap.add_argument("--mixed-iters", action="store_true",
+                    help="with --evalgrid: cells get different iteration "
+                         "counts (traced per-cell horizon), gated on "
+                         "matching per-cell sequential trains")
+    ap.add_argument("--soak", action="store_true",
+                    help="sustained mixed drill: ingest + serving + "
+                         "background retrain/reload with RSS/fd/thread "
+                         "flatness asserts")
+    ap.add_argument("--duration", type=float, default=600.0,
+                    help="--soak window in seconds (default 600)")
+    ap.add_argument("--map10full", action="store_true",
+                    help="full-population MAP@10 alongside the 20k-user "
+                         "sample on one train (pins the sampling error)")
     ap.add_argument("--aggprops", action="store_true",
                     help="property-aggregation tier A/B at 2M events "
                          "(C++ / SQL pushdown / per-event Python fold)")
@@ -782,7 +1090,11 @@ if __name__ == "__main__":
     elif args.quickstart:
         main()
     elif args.evalgrid:
-        bench_eval_grid(args.scale or "2m")
+        bench_eval_grid(args.scale or "2m", mixed_iters=args.mixed_iters)
+    elif args.soak:
+        bench_soak(duration_s=args.duration)
+    elif args.map10full:
+        bench_map10_full(args.scale or "20m")
     elif args.aggprops:
         bench_aggprops()
     else:

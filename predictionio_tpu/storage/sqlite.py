@@ -128,6 +128,7 @@ class SQLiteBackend(base.StorageBackend):
         self._shared = None  # set → one shared connection, lock-serialized
         self._shared_lock = threading.RLock()
         self._all_conns: list = []
+        self._thread_conns: list = []  # (owner thread, conn) for reaping
         self._conns_lock = threading.Lock()
 
     def _connect(self) -> sqlite3.Connection:
@@ -136,7 +137,27 @@ class SQLiteBackend(base.StorageBackend):
         conn.execute("PRAGMA journal_mode=WAL")
         conn.execute("PRAGMA synchronous=NORMAL")
         with self._conns_lock:
+            # reap dead threads' connections HERE, where new ones are
+            # born: per-thread conns live in threading.local, but
+            # _all_conns' strong reference kept a dead handler thread's
+            # connection (and its db+wal fds) alive forever — in a
+            # long-lived server whose HTTP layer spawns a thread per
+            # client connection, that's an unbounded fd leak (~2 fds per
+            # /reload; found by the round-5 10-minute soak drill)
+            dead = [(t, c) for t, c in self._thread_conns
+                    if not t.is_alive() and c is not self._shared]
+            for t, c in dead:
+                self._thread_conns.remove((t, c))
+                try:
+                    self._all_conns.remove(c)
+                except ValueError:
+                    pass
+                try:
+                    c.close()
+                except Exception:
+                    pass
             self._all_conns.append(conn)
+            self._thread_conns.append((threading.current_thread(), conn))
         return conn
 
     def _conn(self) -> sqlite3.Connection:
@@ -289,6 +310,7 @@ class SQLiteBackend(base.StorageBackend):
                     # connections
                     pass
             self._all_conns.clear()
+            self._thread_conns.clear()
         self._shared = None
         self._local = threading.local()
 

@@ -237,3 +237,36 @@ class TestBatchInsert:
         assert len(found) == 250
         # events carry their assigned ids back
         assert all(e.event_id for e in batch)
+
+
+class TestThreadConnReaping:
+    def test_dead_thread_connections_are_reaped(self, tmp_path):
+        """Per-thread sqlite connections must not outlive their threads:
+        a long-lived server spawns a handler thread per client
+        connection, and before round 5 every such thread's connection
+        (db + wal fds) stayed open forever via _all_conns' strong ref —
+        the fd leak the 10-minute soak drill caught (~2 fds per
+        /reload). Dead threads' conns are closed when the next
+        connection is created."""
+        import threading
+
+        from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+        b = SQLiteBackend(str(tmp_path / "reap.db"))
+        b.apps().insert(App(id=None, name="ReapApp"))
+
+        def read():
+            assert b.apps().get_by_name("ReapApp") is not None
+
+        for _ in range(20):
+            t = threading.Thread(target=read)
+            t.start()
+            t.join()
+        # one fresh connect triggers the sweep of all 20 dead owners
+        read_main = threading.Thread(target=read)
+        read_main.start()
+        read_main.join()
+        with b._conns_lock:
+            live = len(b._all_conns)
+        assert live <= 3, f"{live} connections retained for dead threads"
+        b.close()
