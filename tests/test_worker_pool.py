@@ -65,6 +65,38 @@ def _post(port, path, body=None, timeout=30):
         conn.close()
 
 
+def _read_ready_port(proc, timeout_s, want_workers=None):
+    """select-before-readline readiness wait (the quickstart rig's
+    pattern — a silently wedged pool must not block past the deadline)."""
+    import selectors
+
+    suffix = rf" \(workers: {want_workers}\)" if want_workers else ""
+    sel = selectors.DefaultSelector()
+    assert proc.stdout is not None
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not sel.select(timeout=min(1.0, deadline - time.monotonic())):
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            return None  # pool exited
+        m = re.search(rf"deployed on 127\.0\.0\.1:(\d+){suffix}", line)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def _teardown(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 @pytest.fixture()
 def pool(tmp_path):
     from tests.test_distributed_multihost import _train_env
@@ -76,28 +108,12 @@ def pool(tmp_path):
         [PIO, "deploy", "--ip", "127.0.0.1", "--port", "0", "--workers", "3",
          "--engine-id", "rec-test", "--engine-variant", "rec-test"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    port = None
-    deadline = time.time() + 120
-    assert proc.stdout is not None
-    while time.time() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            break
-        m = re.search(r"deployed on 127\.0\.0\.1:(\d+) \(workers: 3\)", line)
-        if m:
-            port = int(m.group(1))
-            break
+    port = _read_ready_port(proc, 120, want_workers=3)
     assert port, "pool never reported ready"
     try:
         yield proc, port, db, expected
     finally:
-        if proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.wait(timeout=30)
+        _teardown(proc)
 
 
 def _query_until(port, deadline_s=60, want=None, tries=80):
@@ -177,6 +193,56 @@ class TestWorkerPool:
         status, body = _post(port, "/queries.json", {"user": "u0", "num": 3})
         assert status == 200
         assert body["itemScores"][0]["item"] == expected["u0"]
+
+    def test_pool_serves_multi_algorithm_blend(self, tmp_path):
+        """The two round-5 serving features composed: a worker pool
+        deploying the MULTI-algorithm engine (ALS + popularity,
+        weighted blend) — a cold-start user gets the popularity
+        baseline through the blend from whichever worker answers."""
+        from tests.test_distributed_multihost import _train_env
+        from tests.test_recommendation_template import (
+            ingest_ratings, multi_algo_variant,
+        )
+        from predictionio_tpu.workflow.workflow_utils import EngineVariant
+
+        db = tmp_path / "multi.db"
+        storage = _sqlite_storage(db)
+        try:
+            ingest_ratings(storage)
+            from predictionio_tpu.controller import WorkflowContext
+            from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+            from predictionio_tpu.workflow.workflow_utils import (
+                extract_engine_params, get_engine,
+            )
+
+            variant = EngineVariant.from_dict(multi_algo_variant())
+            engine = get_engine(variant.engine_factory)
+            ep = extract_engine_params(engine, variant)
+            CoreWorkflow.run_train(engine, ep, variant,
+                                   WorkflowContext(storage=storage, seed=1))
+        finally:
+            storage.close()
+        env = _train_env(db, tmp_path, 2)
+        proc = subprocess.Popen(
+            [PIO, "deploy", "--ip", "127.0.0.1", "--port", "0",
+             "--workers", "2", "--engine-id", "rec-multi",
+             "--engine-variant", "rec-multi"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            port = _read_ready_port(proc, 120)
+            assert port, "multi-algo pool never ready"
+            status, body = _post(port, "/queries.json",
+                                 {"user": "u0", "num": 3})
+            assert status == 200 and len(body["itemScores"]) == 3
+            status, cold = _post(port, "/queries.json",
+                                 {"user": "stranger", "num": 3})
+            assert status == 200
+            assert len(cold["itemScores"]) == 3, (
+                "cold-start user must get the popularity baseline "
+                f"through the blend: {cold}")
+        finally:
+            _teardown(proc)
 
     def test_startup_failure_fails_pool_fast(self, tmp_path):
         from tests.test_distributed_multihost import _train_env
