@@ -272,6 +272,37 @@ class TestCorners:
         direct = aggregate_properties([e_hi, e_lo])
         assert direct["u1"].to_dict() == {"price": 2, "only_lo": True}
 
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_randomized_tie_heavy_streams_agree(self, file_backend, seed):
+        """Fuzz the r5 tiebreak: streams where MOST events share a
+        handful of (event_time, creation_time) stamps (batch-import
+        shape), random ids — every tier must produce identical folds."""
+        b, app_id = file_backend
+        rnd = random.Random(seed)
+        stamps = [T0 + dt.timedelta(seconds=s) for s in (0, 0, 0, 1, 1)]
+        evs = []
+        for i in range(200):
+            kind = rnd.choices(["$set", "$unset", "$delete"], [8, 3, 1])[0]
+            props = ({rnd.choice("abc"): rnd.randrange(100)}
+                     if kind == "$set" else
+                     {rnd.choice("abc"): None} if kind == "$unset" else {})
+            t = rnd.choice(stamps)
+            e = Event(event=kind, entity_type="user",
+                      entity_id=f"u{rnd.randrange(6)}",
+                      properties=DataMap(props), event_time=t,
+                      creation_time=t)
+            e.event_id = "%032x" % rnd.getrandbits(128)
+            evs.append(e)
+        rnd.shuffle(evs)
+        b.events().insert_batch(evs, app_id)
+        oracle = _oracle(b.events(), app_id)
+        for _, got in _both_tiers(b, app_id, entity_type="user"):
+            _assert_matches(got, oracle)
+        # the shared fold also agrees when fed DIRECTLY in shuffled order
+        direct = aggregate_properties(evs)
+        assert {k: v.to_dict() for k, v in direct.items()} == \
+            {k: v.to_dict() for k, v in oracle.items()}
+
     def test_duplicate_keys_last_wins(self, file_backend):
         """Raw rows with duplicate JSON keys (a non-Python writer could
         store them): json.loads keeps the last — so must both tiers."""
