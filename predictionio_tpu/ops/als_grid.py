@@ -480,3 +480,67 @@ def als_train_grid(
             start_epoch=0,
         ))
     return out
+
+
+def grid_dispatch(
+    ctx,
+    cfgs: Sequence[ALSConfig],
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    n_users: int,
+    n_items: int,
+    train_one,
+    build_model,
+    log_prefix: str,
+    *,
+    rmse_flags: Optional[Sequence[bool]] = None,
+    host_factors: bool = True,
+    cache_dir: Optional[str] = None,
+) -> Optional[list]:
+    """The shared guard + partition + dispatch skeleton behind every
+    ALS template's `train_grid` («EvaluationWorkflow» grid loop [U],
+    SURVEY.md §2.6 row 4) — one copy, so a fix to the fallback
+    conditions reaches every template at once.
+
+    Returns None when the grid must run sequentially (model-axis
+    sharding, --check-asserts, or no two cells batchable); otherwise a
+    models list where batchable groups ran as one device program each.
+    `train_one(i)` trains cell i the ordinary way (singleton groups);
+    `build_model(i, result)` wraps cell i's `ALSResult` into the
+    template's model type. `rmse_flags[i]` marks cells whose config
+    wants an RMSE history: a group computes it when ANY member asks."""
+    from predictionio_tpu.parallel.mesh import MODEL_AXIS
+    from predictionio_tpu.utils import checks as _checks
+
+    n = len(cfgs)
+    if ctx.mesh.shape.get(MODEL_AXIS, 1) > 1:
+        log.info("%s: model-axis factor sharding requested — training "
+                 "%d grid points sequentially", log_prefix, n)
+        return None
+    if _checks.enabled():
+        # the grid loop has no checkify path; --check-asserts must run
+        # the checked sequential trains, not silently skip the asserts
+        log.info("%s: --check-asserts armed — training %d grid points "
+                 "sequentially (checked)", log_prefix, n)
+        return None
+    groups = grid_groups(cfgs)
+    if max(len(g) for g in groups) == 1:
+        log.info("%s: no two of the %d grid points share shapes — "
+                 "sequential trains", log_prefix, n)
+        return None
+    models: list = [None] * n
+    for group in groups:
+        if len(group) == 1:
+            models[group[0]] = train_one(group[0])
+            continue
+        results = als_train_grid(
+            user_idx, item_idx, values, n_users=n_users, n_items=n_items,
+            cfgs=[cfgs[i] for i in group], mesh=ctx.mesh,
+            compute_rmse=bool(rmse_flags is not None
+                              and any(rmse_flags[i] for i in group)),
+            bucket_cache_dir=cache_dir, host_factors=host_factors,
+        )
+        for i, r in zip(group, results):
+            models[i] = build_model(i, r)
+    return models

@@ -178,6 +178,10 @@ class AssociationAlgorithm(Algorithm):
         self.params = params
 
     def train(self, ctx: WorkflowContext, pd: PreparedData) -> CPModel:
+        # No checkpoint plumbing here, deliberately: rule mining is one
+        # sub-second counting pass with no iterative state to snapshot —
+        # the SURVEY.md §5 resume contract is satisfied by idempotent
+        # re-run (the crash-recovery cost IS the train cost).
         p = self.params
         rules = basket_ops.mine_rules(
             pd.basket_idx, pd.item_idx, pd.n_baskets, len(pd.item_ids),

@@ -249,6 +249,8 @@ class ECommAlgorithm(Algorithm):
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
             cfg=cfg, mesh=ctx.mesh,
             bucket_cache_dir=ctx.algorithm_cache_dir("als"),
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
+            checkpoint_every=ctx.checkpoint_every_or(1),
         )
         f = result.item_factors
         norms = np.linalg.norm(f, axis=1, keepdims=True)

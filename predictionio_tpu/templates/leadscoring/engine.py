@@ -204,7 +204,10 @@ class LeadScoringAlgorithm(Algorithm):
             pd.features, pd.labels, n_classes=2,
             iterations=self.params.iterations,
             learning_rate=self.params.stepSize,
-            reg=self.params.regParam, mesh=ctx.mesh)
+            reg=self.params.regParam, mesh=ctx.mesh,
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("lr"),
+            checkpoint_every=ctx.checkpoint_every_or(
+                max(1, self.params.iterations // 10)))
         rate = float(pd.labels.mean()) if len(pd.labels) else 0.0
         ctx.metrics.emit("train/leadscoring", sessions=len(pd.labels),
                          conversion_rate=rate)

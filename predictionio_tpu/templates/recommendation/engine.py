@@ -263,62 +263,45 @@ class ALSAlgorithm(Algorithm):
         Mixed grids partition into maximal batchable groups (the stock
         rank×λ grid = one program per rank); leftover singletons take the
         ordinary `train` path."""
-        from predictionio_tpu.ops.als_grid import als_train_grid, grid_groups
-        from predictionio_tpu.parallel.mesh import MODEL_AXIS
+        from predictionio_tpu.ops.als_grid import grid_dispatch
 
-        if ctx.mesh.shape.get(MODEL_AXIS, 1) > 1:
-            log.info("ALSAlgorithm.train_grid: model-axis factor sharding "
-                     "requested — training %d grid points sequentially",
-                     len(algos))
-            return None
-        from predictionio_tpu.utils import checks as _checks
-
-        if _checks.enabled():
-            # the grid loop has no checkify path; --check-asserts must run
-            # the checked sequential trains, not silently skip the asserts
-            log.info("ALSAlgorithm.train_grid: --check-asserts armed — "
-                     "training %d grid points sequentially (checked)",
-                     len(algos))
-            return None
         cfgs = [a._als_config(ctx) for a in algos]
-        groups = grid_groups(cfgs)
-        if max(len(g) for g in groups) == 1:
-            log.info("ALSAlgorithm.train_grid: no two of the %d grid points "
-                     "share shapes — sequential trains", len(algos))
-            return None
-        models: list[Optional[ALSModel]] = [None] * len(algos)
-        seen = SeenItems(pd.user_idx, pd.item_idx, len(pd.user_ids))
-        for group in groups:
-            if len(group) == 1:
-                models[group[0]] = algos[group[0]].train(ctx, pd)
-                continue
-            compute_rmse = any(algos[i].params.computeRMSE for i in group)
-            # host_factors=False: eval models stay device-resident — the
-            # batch_predict top-k runs on device anyway, and the G-wide
-            # factor readback was the grid A/B's largest overhead. These
-            # models are eval-scoped (never pickled/persisted).
-            results = als_train_grid(
-                pd.user_idx, pd.item_idx, pd.ratings,
-                n_users=len(pd.user_ids), n_items=len(pd.item_ids),
-                cfgs=[cfgs[i] for i in group], mesh=ctx.mesh,
-                compute_rmse=compute_rmse,
-                bucket_cache_dir=ctx.algorithm_cache_dir("als"),
-                host_factors=False,
+        # lazily built: when every guard falls back to sequential trains,
+        # the O(n_events) SeenItems pass must not run here at all
+        seen_box: list[SeenItems] = []
+
+        def build_model(i, r):
+            if not seen_box:
+                seen_box.append(
+                    SeenItems(pd.user_idx, pd.item_idx, len(pd.user_ids)))
+            seen = seen_box[0]
+            return ALSModel(
+                user_factors=r.user_factors,
+                item_factors=r.item_factors,
+                user_ids=pd.user_ids,
+                item_ids=pd.item_ids,
+                seen=seen,
+                # the group trains RMSE when ANY cell wants it; a
+                # computeRMSE=False cell must still come out empty,
+                # exactly as its sequential train would
+                rmse_history=(r.rmse_history
+                              if algos[i].params.computeRMSE else []),
             )
-            for i, r in zip(group, results):
-                models[i] = ALSModel(
-                    user_factors=r.user_factors,
-                    item_factors=r.item_factors,
-                    user_ids=pd.user_ids,
-                    item_ids=pd.item_ids,
-                    seen=seen,
-                    # the group trains RMSE when ANY cell wants it; a
-                    # computeRMSE=False cell must still come out empty,
-                    # exactly as its sequential train would
-                    rmse_history=(r.rmse_history
-                                  if algos[i].params.computeRMSE else []),
-                )
-        return models
+
+        # host_factors=False: eval models stay device-resident — the
+        # batch_predict top-k runs on device anyway, and the G-wide
+        # factor readback was the grid A/B's largest overhead. These
+        # models are eval-scoped (never pickled/persisted).
+        return grid_dispatch(
+            ctx, cfgs, pd.user_idx, pd.item_idx, pd.ratings,
+            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+            train_one=lambda i: algos[i].train(ctx, pd),
+            build_model=build_model,
+            log_prefix="ALSAlgorithm.train_grid",
+            rmse_flags=[a.params.computeRMSE for a in algos],
+            host_factors=False,
+            cache_dir=ctx.algorithm_cache_dir("als"),
+        )
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         num = int(query.get("num", 10))

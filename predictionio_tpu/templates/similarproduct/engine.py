@@ -152,22 +152,14 @@ class DataSource(BaseDataSource):
                 user_idx=td.user_idx[keep_ev], item_idx=td.item_idx[keep_ev],
                 user_ids=td.user_ids, item_ids=td.item_ids,
                 item_categories=td.item_categories)
-            # per-user anchor candidates from the KEPT pairs: first and
-            # second kept item (distinct by pair uniqueness), so a
-            # held-out item equal to anchor #1 can still fall back
+            # per-user anchor = first KEPT item; pairs are distinct per
+            # user, so a kept anchor can never equal a held-out item
             tr_u, tr_i = pu[tr], pi[tr]
             users_with, first = np.unique(tr_u, return_index=True)
             anchor1 = dict(zip(users_with.tolist(), tr_i[first].tolist()))
-            second = first + 1
-            has2 = (second < len(tr_u)) & (
-                tr_u[np.minimum(second, len(tr_u) - 1)] == users_with)
-            anchor2 = dict(zip(users_with[has2].tolist(),
-                               tr_i[second[has2]].tolist()))
             qa = []
             for u, i in zip(pu[~tr].tolist(), pi[~tr].tolist()):
                 anchor = anchor1.get(u)
-                if anchor == i:
-                    anchor = anchor2.get(u)
                 if anchor is None:
                     continue
                 qa.append((
@@ -309,6 +301,8 @@ class ALSAlgorithm(Algorithm):
             n_users=len(pd.user_ids), n_items=len(pd.item_ids),
             cfg=self._als_config(ctx), mesh=ctx.mesh,
             bucket_cache_dir=ctx.algorithm_cache_dir("als"),
+            checkpoint_dir=ctx.algorithm_checkpoint_dir("als"),
+            checkpoint_every=ctx.checkpoint_every_or(1),
         )
         return self._model_from_item_factors(result.item_factors, pd)
 
@@ -320,41 +314,18 @@ class ALSAlgorithm(Algorithm):
         cells varying in (λ, α, seed, iterations — mixed horizons batch)
         share the bucketized data; leftover singletons take the ordinary
         `train` path, mirroring the recommendation template's grid."""
-        from predictionio_tpu.ops.als_grid import als_train_grid, grid_groups
-        from predictionio_tpu.parallel.mesh import MODEL_AXIS
-        from predictionio_tpu.utils import checks as _checks
+        from predictionio_tpu.ops.als_grid import grid_dispatch
 
-        if ctx.mesh.shape.get(MODEL_AXIS, 1) > 1:
-            log.info("SimilarProduct train_grid: model-axis factor "
-                     "sharding requested — training %d grid points "
-                     "sequentially", len(algos))
-            return None
-        if _checks.enabled():
-            log.info("SimilarProduct train_grid: --check-asserts armed — "
-                     "training %d grid points sequentially (checked)",
-                     len(algos))
-            return None
-        cfgs = [a._als_config(ctx) for a in algos]
-        groups = grid_groups(cfgs)
-        if max(len(g) for g in groups) == 1:
-            log.info("SimilarProduct train_grid: no two of the %d grid "
-                     "points share shapes — sequential trains", len(algos))
-            return None
-        models: list = [None] * len(algos)
-        for group in groups:
-            if len(group) == 1:
-                models[group[0]] = algos[group[0]].train(ctx, pd)
-                continue
-            results = als_train_grid(
-                pd.user_idx, pd.item_idx, pd.counts,
-                n_users=len(pd.user_ids), n_items=len(pd.item_ids),
-                cfgs=[cfgs[i] for i in group], mesh=ctx.mesh,
-                bucket_cache_dir=ctx.algorithm_cache_dir("als"),
-            )
-            for i, r in zip(group, results):
-                models[i] = cls._model_from_item_factors(
-                    np.asarray(r.item_factors), pd)
-        return models
+        return grid_dispatch(
+            ctx, [a._als_config(ctx) for a in algos],
+            pd.user_idx, pd.item_idx, pd.counts,
+            n_users=len(pd.user_ids), n_items=len(pd.item_ids),
+            train_one=lambda i: algos[i].train(ctx, pd),
+            build_model=lambda i, r: cls._model_from_item_factors(
+                np.asarray(r.item_factors), pd),
+            log_prefix="SimilarProduct train_grid",
+            cache_dir=ctx.algorithm_cache_dir("als"),
+        )
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
         sims = model.similar(

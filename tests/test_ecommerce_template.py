@@ -206,3 +206,40 @@ class TestECommerceEndToEnd:
         assert name == "ecomm"
         assert params.seenEvents == ["buy", "view"]
         assert params.unseenOnly is True
+
+
+class TestECommerceCheckpoint:
+    """Round 5: `ctx.checkpoint_dir` plumbs into this template's
+    `als_train` (SURVEY.md §5 checkpoint/resume for every ALS template)."""
+
+    def test_interrupted_resume_matches_uninterrupted(
+            self, memory_storage, tmp_path, caplog):
+        import logging
+
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        ingest(memory_storage)
+        _, _, want = trained(memory_storage, {"numIterations": 6})
+
+        def ckpt_train(iters):
+            variant = EngineVariant.from_dict(
+                variant_dict({"numIterations": iters}))
+            engine = get_engine(variant.engine_factory)
+            ep = extract_engine_params(engine, variant)
+            ctx = WorkflowContext(storage=memory_storage, seed=1,
+                                  checkpoint_dir=str(tmp_path / "ck"),
+                                  checkpoint_every=1)
+            return engine.train(ctx, ep)[0]
+
+        ckpt_train(3)  # the "interrupted" run
+        cm = CheckpointManager(str(tmp_path / "ck" / "als"))
+        assert cm.latest_step() == 3
+        with caplog.at_level(logging.INFO):
+            got = ckpt_train(6)
+        assert any("resumed from checkpoint step 3" in r.getMessage()
+                   for r in caplog.records)
+        assert cm.latest_step() == 6
+        np.testing.assert_allclose(got.user_factors, want[0].user_factors,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got.item_factors, want[0].item_factors,
+                                   rtol=1e-4, atol=1e-5)

@@ -165,3 +165,41 @@ class TestLeadScoringEvaluation:
         for r in result.all_results:
             assert r.scores[result.metric_name] > 0.75
         assert result.best in result.all_results
+
+
+class TestLeadScoringCheckpoint:
+    """Round 5: `ctx.checkpoint_dir` plumbs into this template's
+    `logreg_train` — interrupted Adam runs resume bitwise-identically
+    (the workflow/segmented contract, SURVEY.md §5)."""
+
+    def test_interrupted_resume_matches_uninterrupted(
+            self, memory_storage, tmp_path, caplog):
+        import logging
+
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        ingest_sessions(memory_storage)
+
+        def train(iters, ckpt):
+            v = variant_dict()
+            v["algorithms"][0]["params"]["iterations"] = iters
+            variant = EngineVariant.from_dict(v)
+            engine = get_engine(variant.engine_factory)
+            ep = extract_engine_params(engine, variant)
+            ctx = WorkflowContext(
+                storage=memory_storage, seed=1,
+                checkpoint_dir=str(tmp_path / "ck") if ckpt else None,
+                checkpoint_every=10)
+            return engine.train(ctx, ep)[0]
+
+        want = train(40, ckpt=False)
+        train(20, ckpt=True)  # the "interrupted" run
+        cm = CheckpointManager(str(tmp_path / "ck" / "lr"))
+        assert cm.latest_step() == 20
+        with caplog.at_level(logging.INFO):
+            got = train(40, ckpt=True)
+        assert any("resumed from checkpoint step 20" in r.getMessage()
+                   for r in caplog.records)
+        assert cm.latest_step() == 40
+        np.testing.assert_array_equal(got.lr.weights, want.lr.weights)
+        np.testing.assert_array_equal(got.lr.bias, want.lr.bias)

@@ -201,3 +201,39 @@ class TestSimilarProductEvaluation:
         scores = [r.scores[result.metric_name] for r in result.all_results]
         assert all(np.isfinite(s) for s in scores)
         assert result.best.scores[result.metric_name] == max(scores)
+
+
+class TestSimilarProductCheckpoint:
+    """Round 5: the SURVEY.md §5 checkpoint/resume contract reaches every
+    ALS-backed template, not only recommendation — `ctx.checkpoint_dir`
+    plumbs into this template's `als_train` too, and an interrupted train
+    resumes to the uninterrupted result."""
+
+    def _train(self, storage, ckpt_dir, iters):
+        variant = EngineVariant.from_dict(variant_dict(iters=iters))
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=storage, seed=1,
+                              checkpoint_dir=ckpt_dir, checkpoint_every=1)
+        return engine.train(ctx, ep)[0]
+
+    def test_interrupted_resume_matches_uninterrupted(
+            self, memory_storage, tmp_path, caplog):
+        import logging
+
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        ingest_views(memory_storage)
+        want = self._train(memory_storage, None, iters=6)
+        ck = str(tmp_path / "ck")
+        self._train(memory_storage, ck, iters=3)  # the "interrupted" run
+        cm = CheckpointManager(str(tmp_path / "ck" / "als"))
+        assert cm.latest_step() == 3
+        with caplog.at_level(logging.INFO):
+            got = self._train(memory_storage, ck, iters=6)
+        assert any("resumed from checkpoint step 3" in r.getMessage()
+                   for r in caplog.records)
+        assert cm.latest_step() == 6
+        np.testing.assert_allclose(got.item_factors_unit,
+                                   want.item_factors_unit,
+                                   rtol=1e-4, atol=1e-5)
