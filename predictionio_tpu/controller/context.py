@@ -53,6 +53,11 @@ class WorkflowContext:
         self.verbose = verbose
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        # set by Engine.train/eval around each algorithm's train: ".N"
+        # for the N-th duplicate of an algorithm class in one engine, so
+        # same-class entries (legal in engine.json, «algorithmClassMap»
+        # [U]) don't share — and purge — one checkpoint subdir
+        self.algo_ckpt_suffix = ""
         self._metrics = metrics
         self._storage = storage
         self._mesh: Optional["jax.sharding.Mesh"] = None
@@ -72,13 +77,35 @@ class WorkflowContext:
         Adam scan at every-1 would be 200 dispatches + saves)."""
         return self.checkpoint_every if self.checkpoint_every else default
 
+    def algo_checkpoint_scope(self, suffix: str):
+        """Scoped override of `algo_ckpt_suffix` — the ONE way callers
+        that train algorithm instances mark which instance is running,
+        so collision-freedom is structural rather than a set/reset pair
+        every site must remember."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            prev = self.algo_ckpt_suffix
+            self.algo_ckpt_suffix = suffix
+            try:
+                yield
+            finally:
+                self.algo_ckpt_suffix = prev
+
+        return scope()
+
     def algorithm_checkpoint_dir(self, algo_name: str) -> Optional[str]:
-        """Per-algorithm checkpoint subdirectory (None when disabled)."""
+        """Per-algorithm checkpoint subdirectory (None when disabled).
+        `algo_name` is the algorithm's own tag (an algorithm may use
+        several — the text template checkpoints `w2v` and `w2v-head`);
+        `algo_ckpt_suffix` disambiguates duplicate same-class entries."""
         if not self.checkpoint_dir:
             return None
         import os
 
-        return os.path.join(self.checkpoint_dir, algo_name)
+        return os.path.join(self.checkpoint_dir,
+                            algo_name + self.algo_ckpt_suffix)
 
     def algorithm_cache_dir(self, algo_name: str) -> Optional[str]:
         """Per-algorithm on-disk cache directory for derived training

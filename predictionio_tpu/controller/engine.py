@@ -43,6 +43,21 @@ def resolve_component(class_map: dict, name: str, role: str) -> Type:
     raise KeyError(f"Unknown {role} name {name!r} (have {sorted(class_map)})")
 
 
+def _ckpt_suffixes(algos) -> list[str]:
+    """Checkpoint-dir suffix per algorithm instance: "" for the first of
+    a class, ".1"/".2"/… for duplicates. Checkpoint subdirs are keyed by
+    a tag the algorithm CLASS hard-codes, so two entries of one class —
+    legal in engine.json, matching «algorithmClassMap» [U] — would share
+    a subdir and purge each other's saves without this."""
+    counts: dict[type, int] = {}
+    out = []
+    for _, algo in algos:
+        n = counts.get(type(algo), 0)
+        counts[type(algo)] = n + 1
+        out.append(f".{n}" if n else "")
+    return out
+
+
 @dataclasses.dataclass
 class EngineParams:
     """«controller/EngineParams» [U]: per-component (name, params) selections."""
@@ -138,10 +153,11 @@ class Engine:
         if sanity_check:
             run_sanity_check(pd, "prepared data")
         models = []
-        for name, algo in algos:
+        for (name, algo), suffix in zip(algos, _ckpt_suffixes(algos)):
             log.info("Engine.train: training algorithm %r (%s)",
                      name, type(algo).__name__)
-            model = algo.train(ctx, pd)
+            with ctx.algo_checkpoint_scope(suffix):
+                model = algo.train(ctx, pd)
             if sanity_check:
                 run_sanity_check(model, f"model[{name}]")
             models.append(model)
@@ -160,7 +176,10 @@ class Engine:
             log.info("Engine.eval: fold %d/%d (%d queries)",
                      i + 1, len(folds), len(qa_pairs))
             pd = prep.prepare(ctx, td)
-            models = [algo.train(ctx, pd) for _, algo in algos]
+            models = []
+            for (_, algo), suffix in zip(algos, _ckpt_suffixes(algos)):
+                with ctx.algo_checkpoint_scope(suffix):
+                    models.append(algo.train(ctx, pd))
             queries = [q for q, _ in qa_pairs]
             per_algo = [
                 algo.batch_predict(model, queries)
@@ -224,14 +243,20 @@ class Engine:
             pd = prep.prepare(ctx, td)
             # models[e][j] = model for ep e, algorithm position j
             models: list[list[Any]] = [[] for _ in range(n_ep)]
+            # per-POSITION suffixes (duplicate classes across positions
+            # collide exactly as in train); within a position the per-ep
+            # instances deliberately share a subdir — same class, cells
+            # distinguished by config fingerprint
+            pos_suffixes = _ckpt_suffixes(algos_by_ep[0])
             for j, (name, _) in enumerate(base.algorithm_params_list):
                 instances = [algos_by_ep[e][j][1] for e in range(n_ep)]
                 cls = type(instances[0])
-                grid_models = None
-                if all(type(a) is cls for a in instances):
-                    grid_models = cls.train_grid(ctx, pd, instances)
-                if grid_models is None:
-                    grid_models = [a.train(ctx, pd) for a in instances]
+                with ctx.algo_checkpoint_scope(pos_suffixes[j]):
+                    grid_models = None
+                    if all(type(a) is cls for a in instances):
+                        grid_models = cls.train_grid(ctx, pd, instances)
+                    if grid_models is None:
+                        grid_models = [a.train(ctx, pd) for a in instances]
                 for e in range(n_ep):
                     models[e].append(grid_models[e])
             queries = [q for q, _ in qa_pairs]

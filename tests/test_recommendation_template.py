@@ -382,3 +382,48 @@ class TestMultiAlgorithmEngine:
         engine = get_engine(variant.engine_factory)
         ep = extract_engine_params(engine, variant)  # params typecheck
         assert ep.serving_name == "weighted"
+
+
+class TestDuplicateAlgorithmCheckpoints:
+    """Two entries of the SAME algorithm class in one engine (legal in
+    engine.json, «algorithmClassMap» [U]) must not share a checkpoint
+    subdir: without per-instance suffixes the second train's
+    different-config fingerprint would purge the first's saves, silently
+    degrading crash-resume to retrain-from-scratch."""
+
+    def test_duplicate_class_checkpoints_do_not_collide(
+            self, memory_storage, tmp_path):
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        ingest_ratings(memory_storage)
+        v = {
+            "id": "rec-dup",
+            "engineFactory": FACTORY,
+            "datasource": {"params": {"appName": "RecApp"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 3,
+                                           "lambda": 0.05, "seed": 1}},
+                {"name": "als", "params": {"rank": 4, "numIterations": 5,
+                                           "lambda": 0.2, "seed": 2}},
+            ],
+            "serving": {"name": "weighted",
+                        "params": {"weights": [0.5, 0.5]}},
+        }
+        variant = EngineVariant.from_dict(v)
+        engine = get_engine(variant.engine_factory)
+        ep = extract_engine_params(engine, variant)
+        ctx = WorkflowContext(storage=memory_storage, seed=1,
+                              checkpoint_dir=str(tmp_path),
+                              checkpoint_every=1)
+        models = engine.train(ctx, ep)
+        assert len(models) == 2
+        # each instance kept its own full checkpoint history
+        assert CheckpointManager(str(tmp_path / "als")).latest_step() == 3
+        assert CheckpointManager(str(tmp_path / "als.1")).latest_step() == 5
+        assert ctx.algo_ckpt_suffix == ""  # reset after the loop
+
+        # a re-run fully resumes BOTH instances (nothing was purged)
+        again = engine.train(ctx, ep)
+        for got, want in zip(again, models):
+            np.testing.assert_allclose(got.user_factors, want.user_factors,
+                                       rtol=1e-5, atol=1e-6)
