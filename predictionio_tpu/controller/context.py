@@ -9,6 +9,7 @@ storage-only processes (event server, CLI metadata verbs) never pay for it.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import TYPE_CHECKING, Any, Optional
 
@@ -77,23 +78,18 @@ class WorkflowContext:
         Adam scan at every-1 would be 200 dispatches + saves)."""
         return self.checkpoint_every if self.checkpoint_every else default
 
+    @contextlib.contextmanager
     def algo_checkpoint_scope(self, suffix: str):
         """Scoped override of `algo_ckpt_suffix` — the ONE way callers
         that train algorithm instances mark which instance is running,
         so collision-freedom is structural rather than a set/reset pair
         every site must remember."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def scope():
-            prev = self.algo_ckpt_suffix
-            self.algo_ckpt_suffix = suffix
-            try:
-                yield
-            finally:
-                self.algo_ckpt_suffix = prev
-
-        return scope()
+        prev = self.algo_ckpt_suffix
+        self.algo_ckpt_suffix = suffix
+        try:
+            yield
+        finally:
+            self.algo_ckpt_suffix = prev
 
     def algorithm_checkpoint_dir(self, algo_name: str) -> Optional[str]:
         """Per-algorithm checkpoint subdirectory (None when disabled).

@@ -171,13 +171,14 @@ class Engine:
         queries. Returns [(fold_td, [(query, predicted, actual), ...])]."""
         ds, prep, algos, serving = self.components(engine_params)
         folds = ds.read_eval(ctx)
+        suffixes = _ckpt_suffixes(algos)
         results = []
         for i, (td, qa_pairs) in enumerate(folds):
             log.info("Engine.eval: fold %d/%d (%d queries)",
                      i + 1, len(folds), len(qa_pairs))
             pd = prep.prepare(ctx, td)
             models = []
-            for (_, algo), suffix in zip(algos, _ckpt_suffixes(algos)):
+            for (_, algo), suffix in zip(algos, suffixes):
                 with ctx.algo_checkpoint_scope(suffix):
                     models.append(algo.train(ctx, pd))
             queries = [q for q, _ in qa_pairs]
@@ -236,6 +237,15 @@ class Engine:
         algos_by_ep = [self.components(ep)[2] for ep in engine_params_list]
         folds = ds.read_eval(ctx)
         n_ep = len(engine_params_list)
+        # per-POSITION suffixes (duplicate classes across positions
+        # collide exactly as in train). Within one position the per-ep
+        # cells still share a subdir: grid-batched cells skip
+        # checkpointing entirely, and sequential-fallback cells
+        # checkpoint last-writer-wins (a differing-config cell's first
+        # save purges the previous cell's steps) — a crash mid-grid
+        # resumes only the cell that was training, same as before this
+        # suffix existed
+        pos_suffixes = _ckpt_suffixes(algos_by_ep[0])
         results: list[list] = [[] for _ in range(n_ep)]
         for fi, (td, qa_pairs) in enumerate(folds):
             log.info("Engine.eval_grid: fold %d/%d (%d queries, %d grid "
@@ -243,11 +253,6 @@ class Engine:
             pd = prep.prepare(ctx, td)
             # models[e][j] = model for ep e, algorithm position j
             models: list[list[Any]] = [[] for _ in range(n_ep)]
-            # per-POSITION suffixes (duplicate classes across positions
-            # collide exactly as in train); within a position the per-ep
-            # instances deliberately share a subdir — same class, cells
-            # distinguished by config fingerprint
-            pos_suffixes = _ckpt_suffixes(algos_by_ep[0])
             for j, (name, _) in enumerate(base.algorithm_params_list):
                 instances = [algos_by_ep[e][j][1] for e in range(n_ep)]
                 cls = type(instances[0])

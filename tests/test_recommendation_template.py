@@ -427,3 +427,50 @@ class TestDuplicateAlgorithmCheckpoints:
         for got, want in zip(again, models):
             np.testing.assert_allclose(got.user_factors, want.user_factors,
                                        rtol=1e-5, atol=1e-6)
+
+    def test_eval_grid_keeps_duplicate_class_subdirs_separate(
+            self, memory_storage, tmp_path):
+        """The eval-grid sequential fallback runs under the same
+        per-position suffixes Engine.train uses — positions 0 and 1 of
+        a two-ALS engine must land in distinct subdirs. (WITHIN a
+        position, per-ep cells still share that subdir last-writer-wins
+        — pre-existing eval semantics, documented at the eval_grid
+        suffix comment.) Cells get DIFFERENT ranks so no two batch:
+        grid-batched cells deliberately skip checkpointing; the
+        fallback is the checkpointing path."""
+        from predictionio_tpu.workflow.checkpoint import CheckpointManager
+
+        ingest_ratings(memory_storage)
+
+        def ep_for(rank):
+            v = {
+                "id": "rec-dup-grid",
+                "engineFactory": FACTORY,
+                "datasource": {"params": {"appName": "RecApp", "evalK": 2}},
+                "algorithms": [
+                    {"name": "als", "params": {
+                        "rank": rank, "numIterations": 3, "lambda": 0.05,
+                        "seed": 1}},
+                    {"name": "als", "params": {
+                        "rank": rank, "numIterations": 3, "lambda": 0.05,
+                        "seed": 2}},
+                ],
+                "serving": {"name": "weighted",
+                            "params": {"weights": [0.5, 0.5]}},
+            }
+            variant = EngineVariant.from_dict(v)
+            return get_engine(variant.engine_factory), \
+                extract_engine_params(get_engine(variant.engine_factory),
+                                      variant)
+
+        engine, ep_a = ep_for(4)
+        _, ep_b = ep_for(6)
+        ctx = WorkflowContext(storage=memory_storage, seed=1,
+                              checkpoint_dir=str(tmp_path),
+                              checkpoint_every=1)
+        results = engine.eval_grid(ctx, [ep_a, ep_b])
+        assert results is not None and len(results) == 2
+        assert ctx.algo_ckpt_suffix == ""
+        # both positions checkpointed, into distinct namespaces
+        assert CheckpointManager(str(tmp_path / "als")).latest_step() == 3
+        assert CheckpointManager(str(tmp_path / "als.1")).latest_step() == 3
