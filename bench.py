@@ -893,13 +893,29 @@ def bench_soak(duration_s: float = 600.0, emit: bool = True,
         conn.close()
 
     def ingest_loop():
+        # ingested events are mostly "view"s — REAL writes through the
+        # full REST/auth/sqlite path into the SAME app, but outside the
+        # datasource's rate/buy training read. This keeps the retrain
+        # working set ~fixed, so the RSS flatness gate measures SERVER
+        # leaks: with all-"rate" ingest the dataset (hence training-read
+        # RSS) grows linearly with the window and an hours-scale run
+        # fails the gate on correct behavior (training a growing dataset
+        # costs growing memory). Every 100th event IS a "rate" on a
+        # NOVEL item id: bounded growth, and the post-window assert
+        # below proves retrains pick up REST-ingested events (the one
+        # automated exercise of that path — keep it).
         conn = http.client.HTTPConnection("127.0.0.1", es.port, timeout=30)
         i = 0
         while not stop.is_set():
-            ev = {"event": "rate", "entityType": "user",
-                  "entityId": str(i % 40), "targetEntityType": "item",
-                  "targetEntityId": str(i % 30),
-                  "properties": {"rating": float(i % 5 + 1)}}
+            if i % 100 == 99:
+                ev = {"event": "rate", "entityType": "user",
+                      "entityId": str(i % 40), "targetEntityType": "item",
+                      "targetEntityId": f"nov{(i // 100) % 5}",
+                      "properties": {"rating": 5.0}}
+            else:
+                ev = {"event": "view", "entityType": "user",
+                      "entityId": str(i % 40), "targetEntityType": "item",
+                      "targetEntityId": str(i % 30)}
             conn.request("POST", f"/events.json?accessKey={key}",
                          json.dumps(ev),
                          {"Content-Type": "application/json"})
@@ -950,6 +966,22 @@ def bench_soak(duration_s: float = 600.0, emit: bool = True,
     wall = time.perf_counter() - t0
     es.shutdown()
     ps.shutdown()
+
+    if not errors and counts["ingest"] >= 100:
+        # ingest→retrain pickup proof: a final train must see the novel
+        # rate items that arrived over REST during the window
+        from predictionio_tpu.workflow.create_server import (
+            ServerConfig as _SC, load_served_state,
+        )
+
+        run_train(engine_json=engine_json)
+        state = load_served_state(storage, _SC(
+            ip="127.0.0.1", port=0, engine_id="soak",
+            engine_variant="soak"))
+        if state.models[0].item_ids.get("nov0") is None:
+            raise SystemExit(
+                "soak: REST-ingested rate events did not reach the "
+                "retrained model (ingest→retrain pickup broken)")
     end_rss, end_fds, end_threads = _proc_stats()
 
     if errors:
