@@ -83,6 +83,29 @@ def _make_source(storage_spec: str, tmpdir):
     raise SystemExit(f"unsupported --storage spec: {storage_spec!r}")
 
 
+def _scrape_metrics(port: int) -> dict:
+    """GET /metrics and keep the serving-relevant families, so future perf
+    rounds carry the server-side latency histogram in the BENCH json.
+    (Pool mode caveat: the kernel routes the scrape to ONE worker.)"""
+    import http.client
+
+    from predictionio_tpu.telemetry.registry import parse_prometheus
+
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+    except OSError as e:
+        return {"error": str(e)}
+    parsed = parse_prometheus(text)
+    keep = ("http_requests_total", "http_request_duration_seconds",
+            "http_in_flight", "http_errors_total", "engine_predict_seconds",
+            "eventserver_events_total", "storage_op_seconds")
+    return {name: series for name, series in parsed.items()
+            if name.startswith(keep)}
+
+
 def _run_http_load(port: int, path, payloads, n_threads,
                    duration_s, ok_status=(200,)):
     """N keep-alive client threads hammering one endpoint for
@@ -319,6 +342,10 @@ def bench_serving(storage_spec: str = "memory", emit: bool = True,
                 "p50_ms": round(p50 * 1e3, 2),
                 "p95_ms": round(p95 * 1e3, 2),
             }
+        # scrape the server's own telemetry while it is still up, so BENCH
+        # records carry the real served latency histogram alongside the
+        # client-side ladder numbers
+        metrics_snapshot = _scrape_metrics(port)
     finally:
         # the measured record must survive teardown trouble, and a
         # Ctrl-C mid-ladder must not orphan a live SO_REUSEPORT pool
@@ -338,6 +365,7 @@ def bench_serving(storage_spec: str = "memory", emit: bool = True,
         "ladder": ladder,
         "storage": storage_spec,
         "workers": workers,
+        "metrics_snapshot": metrics_snapshot,
         "vs_baseline": None,
     }
     if emit:
@@ -401,6 +429,7 @@ def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
             }
         head_n = n_threads if n_threads in ladder else next(iter(ladder))
         results[mode] = {**ladder[head_n], "ladder": ladder}
+    metrics_snapshot = _scrape_metrics(port)
     server.shutdown()
     storage.close()
     Storage.reset(None)
@@ -412,6 +441,7 @@ def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
         "batch": {**results["batch"], "batch_size": batch_size},
         "concurrency": head_n,
         "storage": storage_spec or "sqlite",
+        "metrics_snapshot": metrics_snapshot,
         "vs_baseline": None,
     }
     if emit:

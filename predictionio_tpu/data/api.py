@@ -22,12 +22,12 @@ this path, so throughput is bounded by SQLite writes, not the server.
 from __future__ import annotations
 
 import json
-import threading
 import time
-from collections import Counter
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 from predictionio_tpu.data.events import (
@@ -44,26 +44,44 @@ BATCH_LIMIT = 50  # reference rejects >50 events per batch POST [U]
 DEFAULT_FIND_LIMIT = 20
 
 
+# Shared across all EventServer instances in the process; each Stats
+# instance subtracts its construction-time baseline to keep the
+# "since this server started" /stats.json contract.
+EVENTS_TOTAL = REGISTRY.counter(
+    "eventserver_events_total",
+    "Events processed by the event server, by app/event/status",
+    labelnames=("app_id", "event", "status"))
+
+
 class Stats:
     """Per-app event counters (the reference's `Stats`/`StatsActor` [U]),
-    exposed at GET /stats.json. Counts (appId, event, status) since start."""
+    exposed at GET /stats.json.
+
+    Backed by the telemetry registry: the pre-telemetry version bumped a
+    plain collections.Counter without holding its lock on the update path,
+    which under ThreadingHTTPServer (one thread per connection) could drop
+    increments. Registry counters take their family lock on every inc."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Counter = Counter()
         self.start_time = time.time()
+        self._baseline = self._totals()
+
+    @staticmethod
+    def _totals() -> dict:
+        return dict(EVENTS_TOTAL.collect())
 
     def update(self, app_id: int, event_name: str, status: int) -> None:
-        with self._lock:
-            self._counts[(app_id, event_name, status)] += 1
+        EVENTS_TOTAL.labels(app_id=str(app_id), event=event_name,
+                            status=str(status)).inc()
 
     def snapshot(self, app_id: int) -> dict:
-        with self._lock:
-            items = [
-                {"event": ev, "status": status, "count": n}
-                for (aid, ev, status), n in sorted(self._counts.items())
-                if aid == app_id
-            ]
+        base = self._baseline
+        items = []
+        for (aid, ev, status), n in sorted(self._totals().items()):
+            n -= base.get((aid, ev, status), 0)
+            if aid == str(app_id) and n > 0:
+                items.append({"event": ev, "status": int(status),
+                              "count": int(n)})
         return {"uptime_s": round(time.time() - self.start_time, 1), "counts": items}
 
 
@@ -138,14 +156,15 @@ class _EventHandler(JsonRequestHandler):
         return event
 
     def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
-        event = self._validate_event(d, access_key, app_id, channel_id)
-        le = self.storage.l_events()
-        try:
-            eid = le.insert(event, app_id, channel_id)
-        except le.integrity_errors as e:
-            raise EventValidationError(
-                f"duplicate eventId {event.event_id!r}"
-            ) from e
+        with tracing.span("eventserver insert_event"):
+            event = self._validate_event(d, access_key, app_id, channel_id)
+            le = self.storage.l_events()
+            try:
+                eid = le.insert(event, app_id, channel_id)
+            except le.integrity_errors as e:
+                raise EventValidationError(
+                    f"duplicate eventId {event.event_id!r}"
+                ) from e
         if self.stats:
             self.stats.update(app_id, event.event, 201)
         return eid
@@ -347,7 +366,8 @@ class EventServer(HttpService):
             {"storage": self.storage, "stats": self.stats,
              "plugins": self.plugins},
         )
-        super().__init__(config.ip, config.port, handler)
+        super().__init__(config.ip, config.port, handler,
+                         server_name="eventserver")
 
 
 def create_event_server(

@@ -28,6 +28,8 @@ import uuid
 from datetime import datetime, timezone
 from typing import Any, Optional, Sequence, Union
 
+from predictionio_tpu.telemetry import tracing
+
 
 class PredictionIOError(Exception):
     """Non-2xx server response; `.status` and `.message` carry details."""
@@ -59,6 +61,9 @@ class _BaseClient:
     def __init__(self, url: str, timeout: float = 10.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # Trace id echoed by the server on the most recent response —
+        # the client-side half of end-to-end X-PIO-Trace-Id propagation.
+        self.last_trace_id: Optional[str] = None
         parts = urllib.parse.urlsplit(self.url)
         if parts.scheme not in ("http", "https", ""):
             raise ValueError(
@@ -102,6 +107,10 @@ class _BaseClient:
             target += "?" + urllib.parse.urlencode(q)
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
+        # Every call carries a trace id: the active context's when the
+        # caller opened `tracing.trace(...)`, else a fresh one per request.
+        # The retry loop reuses the same id — a replay is the same request.
+        sent_trace_id = tracing.inject_headers(headers)
         idempotent = idempotent or method in ("GET", "DELETE")
         for attempt in (0, 1):
             conn, fresh = self._conn()
@@ -112,6 +121,8 @@ class _BaseClient:
                 resp = conn.getresponse()
                 payload = resp.read()
                 status = resp.status
+                self.last_trace_id = (resp.getheader(tracing.TRACE_HEADER)
+                                      or sent_trace_id)
                 break
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 self._drop_conn()

@@ -1,0 +1,24 @@
+"""Runtime telemetry: metrics registry, tracing, and HTTP middleware.
+
+Import surface is deliberately light (stdlib only) — the SDK and event
+server import this without pulling in jax. See docs/observability.md.
+"""
+
+from predictionio_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    parse_prometheus,
+)
+from predictionio_tpu.telemetry.tracing import (  # noqa: F401
+    TRACE_HEADER,
+    TraceContext,
+    TraceIdFilter,
+    current_trace_id,
+    install_log_record_factory,
+    span,
+    trace,
+)

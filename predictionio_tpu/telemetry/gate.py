@@ -1,0 +1,150 @@
+"""Telemetry gate — CI check that no HTTP surface escapes the middleware.
+
+Run via `python quality.py --telemetry-gate`. Two layers:
+
+1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
+   every HTTP server must go through `utils/http.py`'s HttpService —
+   flag direct `HTTPServer`/`ThreadingHTTPServer` construction or
+   `BaseHTTPRequestHandler` subclassing elsewhere, and any
+   `instrument=False` (the opt-out exists for out-of-package A/B
+   overhead measurement only).
+
+2. Runtime check: construct an HttpService on an ephemeral port, verify
+   every `do_*` route handler carries the middleware's wrapped marker,
+   and that one served request makes `GET /metrics` expose the required
+   `http_requests_total` / `http_request_duration_seconds` /
+   `http_in_flight` families.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# utils/http.py legitimately subclasses ThreadingHTTPServer and defines the
+# one sanctioned instrument= parameter; the telemetry package is the
+# middleware itself.
+_EXEMPT = {
+    os.path.join("utils", "http.py"),
+    os.path.join("telemetry", "gate.py"),
+    os.path.join("telemetry", "middleware.py"),
+    # speaks the S3 wire protocol (XML errors, SigV4, raw object bodies) —
+    # a dev/CI emulation of an external service, not a pio JSON service,
+    # so JsonRequestHandler/HttpService is the wrong base for it
+    os.path.join("storage", "objectstore_server.py"),
+}
+
+_SERVER_NAMES = {"HTTPServer", "ThreadingHTTPServer", "TCPServer"}
+_HANDLER_NAMES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+
+def _name_of(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _scan_file(path: str, rel: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            return [f"{rel}: unparseable ({e})"]
+    problems = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _name_of(node.func) in _SERVER_NAMES:
+            problems.append(
+                f"{rel}:{node.lineno}: constructs {_name_of(node.func)} "
+                f"directly — route it through utils.http.HttpService so the "
+                f"telemetry middleware applies")
+        if isinstance(node, ast.ClassDef):
+            for b in node.bases:
+                if _name_of(b) in _HANDLER_NAMES:
+                    problems.append(
+                        f"{rel}:{node.lineno}: class {node.name} subclasses "
+                        f"{_name_of(b)} directly — subclass "
+                        f"JsonRequestHandler instead")
+        if isinstance(node, ast.keyword) and node.arg == "instrument":
+            v = node.value
+            if isinstance(v, ast.Constant) and v.value is False:
+                problems.append(
+                    f"{rel}:{node.lineno}: instrument=False inside the "
+                    f"package — every in-tree HttpService must be metered")
+    return problems
+
+
+def _static_scan() -> list[str]:
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _PKG_DIR)
+            if rel in _EXEMPT:
+                continue
+            problems.extend(_scan_file(path, rel))
+    return problems
+
+
+def _runtime_check() -> list[str]:
+    import http.client
+    import json
+
+    from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+    class _ProbeHandler(JsonRequestHandler):
+        def do_GET(self):
+            self.send_json(200, {"ok": True})
+
+    problems = []
+    svc = HttpService("127.0.0.1", 0, _ProbeHandler, server_name="gateprobe")
+    for name in dir(svc.httpd.RequestHandlerClass):
+        if name.startswith("do_"):
+            fn = getattr(svc.httpd.RequestHandlerClass, name)
+            if not getattr(fn, "_pio_telemetry_wrapped", False):
+                problems.append(
+                    f"runtime: {name} on an HttpService handler lacks the "
+                    f"middleware wrapper")
+    svc.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+        conn.request("GET", "/")
+        json.loads(conn.getresponse().read())
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        for family in ("http_requests_total", "http_request_duration_seconds",
+                       "http_in_flight"):
+            if f"# TYPE {family} " not in text:
+                problems.append(f"runtime: /metrics is missing {family}")
+        if 'server="gateprobe"' not in text:
+            problems.append("runtime: served request did not reach "
+                            "http_requests_total")
+    finally:
+        svc.shutdown()
+    return problems
+
+
+def run_gate() -> int:
+    problems = _static_scan()
+    try:
+        problems += _runtime_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"runtime check crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"telemetry gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

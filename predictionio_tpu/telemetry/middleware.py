@@ -1,0 +1,198 @@
+"""HTTP instrumentation middleware for HttpService.
+
+`instrument(handler_cls, server_name)` returns a subclass whose `do_*`
+methods are wrapped with:
+
+  - request counter        http_requests_total{server,method,route,status}
+  - latency histogram      http_request_duration_seconds{server,route}
+  - in-flight gauge        http_in_flight{server}
+  - trace propagation      inbound X-PIO-Trace-Id adopted (or a fresh id
+                           minted), echoed on the response, active in the
+                           contextvar for the handler's whole run
+  - a shared GET /metrics  Prometheus exposition of the default registry
+
+Route labels use templates (`/events/<id>.json`, not the raw path) so an
+attacker spraying 404s can't explode label cardinality.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Type
+from urllib.parse import urlparse
+
+from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+access_logger = logging.getLogger("predictionio_tpu.http.access")
+
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+HTTP_REQUESTS = REGISTRY.counter(
+    "http_requests_total", "HTTP requests served",
+    labelnames=("server", "method", "route", "status"))
+HTTP_DURATION = REGISTRY.histogram(
+    "http_request_duration_seconds", "HTTP request latency in seconds",
+    labelnames=("server", "route"))
+HTTP_IN_FLIGHT = REGISTRY.gauge(
+    "http_in_flight", "Requests currently being handled",
+    labelnames=("server",))
+HTTP_ERRORS = REGISTRY.counter(
+    "http_errors_total", "Handler exceptions that escaped a route",
+    labelnames=("server",))
+
+# Template routes across all four servers: exact paths first, then prefix
+# templates. Anything else (scanner noise, typos) collapses to "<other>".
+_EXACT_ROUTES = frozenset({
+    "/", "/index.html", "/metrics",
+    "/events.json", "/batch/events.json", "/stats.json",   # event server
+    "/queries.json", "/reload", "/stop",                   # prediction server
+    "/cmd/app",                                            # admin server
+})
+_PREFIX_ROUTES = (
+    ("/events/", ".json", "/events/<id>.json"),
+    ("/webhooks/", ".json", "/webhooks/<connector>.json"),
+)
+
+
+def route_template(path: str) -> str:
+    if path in _EXACT_ROUTES:
+        return path
+    for prefix, suffix, template in _PREFIX_ROUTES:
+        if path.startswith(prefix) and path.endswith(suffix):
+            return template
+    if path.startswith("/cmd/app/"):
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 3:
+            return "/cmd/app/<name>"
+        if len(parts) == 4 and parts[3] == "data":
+            return "/cmd/app/<name>/data"
+    return "<other>"
+
+
+# Label children cached by plain-dict lookup: labels() validates kwargs and
+# takes the family lock on every call, which is measurable per request. The
+# key space is bounded — server names × methods × route *templates* ×
+# statuses — so the caches can't grow past a few hundred entries.
+_REQ_CHILDREN: dict = {}
+_INFLIGHT_CHILDREN: dict = {}
+
+
+def record_request(server: str, method: str, route: str, status: int,
+                   duration_s: float) -> None:
+    """The per-request bookkeeping, factored out so the overhead test can
+    time exactly what every instrumented request pays."""
+    key = (server, method, route, status)
+    pair = _REQ_CHILDREN.get(key)
+    if pair is None:
+        pair = _REQ_CHILDREN[key] = (
+            HTTP_REQUESTS.labels(server=server, method=method, route=route,
+                                 status=str(status)),
+            HTTP_DURATION.labels(server=server, route=route))
+    pair[0].inc()
+    pair[1].observe(duration_s)
+
+
+def _in_flight(server: str):
+    child = _INFLIGHT_CHILDREN.get(server)
+    if child is None:
+        child = _INFLIGHT_CHILDREN[server] = \
+            HTTP_IN_FLIGHT.labels(server=server)
+    return child
+
+
+def serve_metrics(handler) -> None:
+    body = REGISTRY.render().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", METRICS_CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _run_instrumented(self, http_method: str, orig) -> None:
+    server = self.pio_server_name
+    path = urlparse(self.path).path
+    route = route_template(path)
+    ctx, inbound = tracing.context_from_headers(self.headers)
+    token = tracing.activate(ctx)
+    self._pio_trace_id = ctx.trace_id
+    self._pio_status = None
+    in_flight = _in_flight(server)
+    in_flight.inc()
+    t0 = time.perf_counter()
+    failed = False
+    try:
+        if http_method == "GET" and path == "/metrics":
+            serve_metrics(self)
+        elif "jax" in sys.modules:
+            # The request-level span only exists to line the request up
+            # with XLA timelines; open one when jax is loaded. Elsewhere
+            # the request context (fresh span_id) already is the span.
+            with tracing.span(f"{server} {http_method} {route}"):
+                orig(self)
+        else:
+            orig(self)
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        in_flight.dec()
+        duration = time.perf_counter() - t0
+        status = self._pio_status if self._pio_status is not None else 500
+        record_request(server, http_method, route, status, duration)
+        # Propagated requests (caller sent a trace header) log at INFO so a
+        # trace id is findable in server logs; local noise stays at DEBUG.
+        access_logger.log(
+            logging.INFO if inbound else logging.DEBUG,
+            "%s %s %s -> %s %.1fms trace=%s",
+            server, http_method, route, status, duration * 1e3, ctx.trace_id)
+        if not failed:
+            # On exceptions the contextvar stays set so _Server.handle_error
+            # (same thread, runs after us) can log the trace id; the
+            # per-connection thread dies right after, so nothing leaks.
+            tracing.deactivate(token)
+
+
+def instrument(handler_cls: Type, server_name: str) -> Type:
+    """Build an instrumented subclass of a BaseHTTPRequestHandler class."""
+
+    def make_wrapper(method_name: str, orig):
+        http_method = method_name[3:]
+
+        def wrapped(self):
+            _run_instrumented(self, http_method, orig)
+
+        wrapped.__name__ = method_name
+        wrapped.__qualname__ = f"{handler_cls.__name__}.{method_name}"
+        wrapped._pio_telemetry_wrapped = True
+        return wrapped
+
+    ns = {"pio_server_name": server_name}
+    for name in dir(handler_cls):
+        if not name.startswith("do_"):
+            continue
+        orig = getattr(handler_cls, name)
+        if not callable(orig) or getattr(orig, "_pio_telemetry_wrapped", False):
+            continue
+        ns[name] = make_wrapper(name, orig)
+    # The GET /metrics route must exist even on handlers without do_GET.
+    if "do_GET" not in ns and not hasattr(handler_cls, "do_GET"):
+        def _metrics_only_get(self):
+            path = urlparse(self.path).path
+            if path == "/metrics":
+                return serve_metrics(self)
+            self.send_error(501, "Unsupported method ('GET')")
+        ns["do_GET"] = make_wrapper("do_GET", _metrics_only_get)
+
+    def send_response(self, code, message=None):
+        self._pio_status = code
+        handler_cls.send_response(self, code, message)
+        tid = getattr(self, "_pio_trace_id", None)
+        if tid:
+            self.send_header(tracing.TRACE_HEADER, tid)
+
+    ns["send_response"] = send_response
+    return type(handler_cls.__name__ + "Instrumented", (handler_cls,), ns)

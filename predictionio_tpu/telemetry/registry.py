@@ -1,0 +1,319 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+Zero-dependency Counter/Gauge/Histogram in the Prometheus data model
+(https://prometheus.io/docs/instrumenting/exposition_formats/): pull-based,
+rendered on demand by `MetricsRegistry.render()`, served by the shared
+`GET /metrics` route that telemetry.middleware adds to every HttpService.
+
+Thread-safety: every metric family holds one lock guarding its child map
+and all child values. Handler threads (ThreadingHTTPServer spawns one per
+connection) touch a metric for nanoseconds under the lock; render() takes
+the same locks family-by-family so a scrape never sees a torn histogram
+(count ahead of buckets).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+# Latency-oriented defaults (seconds): spans 1 ms loopback JSON requests
+# to 10 s checkpoint restores. Same shape as prometheus/client_python.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_INF = float("inf")
+
+
+def _format_value(v: float) -> str:
+    if v == _INF:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(labelnames: Sequence[str], labelvalues: Sequence[str],
+                   extra: Sequence[Tuple[str, str]] = ()) -> str:
+    pairs = list(zip(labelnames, labelvalues)) + list(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labelled time series of a Counter or Gauge."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self, lock: threading.Lock):
+        self._value = 0.0
+        self._lock = lock
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+
+class _HistogramChild:
+    """One labelled histogram series: cumulative bucket counts + sum."""
+
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, buckets: Tuple[float, ...]):
+        self._lock = lock
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)  # per-bucket (non-cumulative) counts
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.sum += value
+            self.count += 1
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self.counts[i] += 1
+                    break
+            # above the last finite bound → only the implicit +Inf bucket,
+            # which is rendered as `count` (always cumulative-total)
+
+
+class _MetricFamily:
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 metric_type: str):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.type = metric_type
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, labelkw: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labelkw) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} expects labels {self.labelnames}, "
+                f"got {tuple(sorted(labelkw))}")
+        return tuple(str(labelkw[n]) for n in self.labelnames)
+
+
+class Counter(_MetricFamily):
+    """Monotonic counter family. `labels(**kw).inc()`; `inc()` shorthand
+    when the family has no labels."""
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames, "counter")
+
+    def labels(self, **labelkw: str) -> _Child:
+        key = self._key(labelkw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _Child(self._lock)
+        return child
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self.labelnames:
+            raise ValueError(f"metric {self.name!r} needs labels()")
+        self.labels().inc(amount)
+
+    @property
+    def value(self) -> float:
+        if self.labelnames:
+            raise ValueError(f"metric {self.name!r} needs labels()")
+        return self.labels().value
+
+    def collect(self) -> Iterable[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return [(k, c.value) for k, c in self._children.items()]
+
+
+class Gauge(Counter):
+    """Like Counter, but can go down (`set`, `dec`)."""
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        _MetricFamily.__init__(self, name, help, labelnames, "gauge")
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set(self, value: float) -> None:
+        if self.labelnames:
+            raise ValueError(f"metric {self.name!r} needs labels()")
+        self.labels().set(value)
+
+
+class Histogram(_MetricFamily):
+    """Histogram family with fixed bucket boundaries (seconds by default)."""
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, "histogram")
+        bl = tuple(sorted(float(b) for b in buckets))
+        if not bl:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = bl
+
+    def labels(self, **labelkw: str) -> _HistogramChild:
+        key = self._key(labelkw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _HistogramChild(
+                    self._lock, self.buckets)
+        return child
+
+    def observe(self, value: float) -> None:
+        if self.labelnames:
+            raise ValueError(f"metric {self.name!r} needs labels()")
+        self.labels().observe(value)
+
+    def time(self, **labelkw: str):
+        """Context manager: observe the elapsed wall time of the block."""
+        return _Timer(self.labels(**labelkw) if self.labelnames
+                      else self.labels())
+
+    def collect(self):
+        with self._lock:
+            return [(k, (list(c.counts), c.sum, c.count))
+                    for k, c in self._children.items()]
+
+
+class _Timer:
+    __slots__ = ("_child", "_t0")
+
+    def __init__(self, child: _HistogramChild):
+        self._child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    """Get-or-create metric families; renders them all as Prometheus text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _MetricFamily] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw) -> _MetricFamily:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or (
+                        existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.type} with labels {existing.labelnames}")
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_MetricFamily]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            families = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in families:
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.type}")
+            if isinstance(m, Histogram):
+                for key, (counts, total, count) in sorted(m.collect()):
+                    cum = 0
+                    for bound, n in zip(m.buckets, counts):
+                        cum += n
+                        labels = _render_labels(
+                            m.labelnames, key,
+                            extra=[("le", _format_value(bound))])
+                        lines.append(f"{m.name}_bucket{labels} {cum}")
+                    inf_labels = _render_labels(m.labelnames, key,
+                                                extra=[("le", "+Inf")])
+                    lines.append(f"{m.name}_bucket{inf_labels} {count}")
+                    labels = _render_labels(m.labelnames, key)
+                    lines.append(f"{m.name}_sum{labels} {_format_value(total)}")
+                    lines.append(f"{m.name}_count{labels} {count}")
+            else:
+                for key, value in sorted(m.collect()):
+                    labels = _render_labels(m.labelnames, key)
+                    lines.append(f"{m.name}{labels} {_format_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse exposition text into {metric_name: {label_string: value}}.
+
+    Minimal inverse of render() for tests and bench snapshots — handles
+    the subset render() emits (no escapes inside parsed label values
+    beyond the literal text)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            continue
+        if "{" in name_part:
+            name, rest = name_part.split("{", 1)
+            labels = "{" + rest
+        else:
+            name, labels = name_part, ""
+        try:
+            value = float(value_part)
+        except ValueError:
+            continue
+        out.setdefault(name, {})[labels] = value
+    return out
+
+
+# The process-wide default registry: every server in one process shares it,
+# so a combined deploy (worker pool forks) still exposes one coherent view.
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,209 @@
+"""Dapper-style request tracing: contextvar trace context + HTTP propagation.
+
+A trace is born at the first server (or SDK client) that sees a request
+without an `X-PIO-Trace-Id` header; every hop after that reuses the id, so
+one event → store → train → serve path shares one trace_id across the
+event server, storage layer, and prediction server logs.
+
+Import cost matters: this module is imported by the SDK and the event
+server, neither of which should pull in jax. `span()` therefore only emits
+a `jax.profiler.TraceAnnotation` when jax is *already* imported in the
+process (training / prediction servers), so request spans line up with the
+XLA timelines captured by `utils/profiling.maybe_trace` without making
+every ingest process pay the jax import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import random
+import re
+import sys
+from typing import Optional
+
+TRACE_HEADER = "X-PIO-Trace-Id"
+
+# Inbound header values come from the network: accept only modest opaque
+# tokens so log lines and metric labels can't be injected into.
+_SAFE_TRACE_ID = re.compile(r"^[0-9a-zA-Z_-]{1,64}$")
+
+
+class TraceContext:
+    """Immutable-by-convention trace coordinates. A plain __slots__ class,
+    not a dataclass: one is built per request + per span on the serving
+    hot path, where dataclass __init__ overhead is measurable against the
+    ≤5% instrumentation budget."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, _new_id(), self.span_id)
+
+    def __repr__(self) -> str:
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, "
+                f"parent_span_id={self.parent_span_id!r})")
+
+
+_current: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("pio_trace_context", default=None)
+
+# Trace ids need uniqueness, not cryptographic strength: a urandom-seeded
+# Mersenne generator is ~4× cheaper per id than secrets.token_hex. Reseed
+# after fork (worker_pool pre-forks N servers) so siblings don't replay
+# one id stream.
+_rng = random.Random()
+_rng_pid = os.getpid()
+
+
+def _new_id() -> str:
+    global _rng, _rng_pid
+    pid = os.getpid()
+    if pid != _rng_pid:
+        _rng = random.Random()
+        _rng_pid = pid
+    return f"{_rng.getrandbits(64):016x}"
+
+
+def new_context(trace_id: Optional[str] = None) -> TraceContext:
+    return TraceContext(trace_id=trace_id or _new_id(), span_id=_new_id())
+
+
+def current() -> Optional[TraceContext]:
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _current.get()
+    return ctx.trace_id if ctx else None
+
+
+def activate(ctx: TraceContext) -> contextvars.Token:
+    return _current.set(ctx)
+
+
+def deactivate(token: contextvars.Token) -> None:
+    _current.reset(token)
+
+
+def context_from_headers(headers) -> tuple[TraceContext, bool]:
+    """Resolve the trace context for an inbound request.
+
+    Returns (context, inbound): `inbound` is True when the request carried
+    a valid trace header — i.e. the caller is participating in a trace —
+    which servers use to log propagated requests at INFO rather than DEBUG.
+    """
+    raw = headers.get(TRACE_HEADER) if headers is not None else None
+    if raw and _SAFE_TRACE_ID.match(raw):
+        return new_context(trace_id=raw), True
+    return new_context(), False
+
+
+def inject_headers(headers: dict, ctx: Optional[TraceContext] = None) -> str:
+    """Set the trace header on an outbound request dict; returns the id."""
+    ctx = ctx or current() or new_context()
+    headers[TRACE_HEADER] = ctx.trace_id
+    return ctx.trace_id
+
+
+@contextlib.contextmanager
+def trace(trace_id: Optional[str] = None):
+    """Open (or join) a trace for the duration of the block."""
+    parent = current()
+    if parent is not None and trace_id in (None, parent.trace_id):
+        ctx = parent.child()
+    else:
+        ctx = new_context(trace_id)
+    token = activate(ctx)
+    try:
+        yield ctx
+    finally:
+        deactivate(token)
+
+
+def _jax_annotation(name: str):
+    # Only annotate when jax is already loaded — never import it here.
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        return jax_mod.profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable on exotic backends
+        return None
+
+
+class span:
+    """A named span inside the current trace (child context + optional
+    jax.profiler.TraceAnnotation so request spans appear on XLA traces).
+
+    A class-based context manager rather than @contextmanager: it sits on
+    the per-request serving path, where the generator protocol costs a
+    few extra microseconds per request."""
+
+    __slots__ = ("name", "ctx", "_token", "_ann")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self) -> TraceContext:
+        parent = _current.get()
+        ctx = self.ctx = parent.child() if parent else new_context()
+        self._token = _current.set(ctx)
+        ann = self._ann = _jax_annotation(self.name)
+        if ann is not None:
+            try:
+                ann.__enter__()
+            except Exception:
+                self._ann = None
+        return ctx
+
+    def __exit__(self, *exc) -> bool:
+        ann = self._ann
+        if ann is not None:
+            try:
+                ann.__exit__(*exc)
+            except Exception:
+                pass
+        _current.reset(self._token)
+        return False
+
+
+# -- logging integration ----------------------------------------------------
+
+class TraceIdFilter(logging.Filter):
+    """Stamps `record.trace_id` so formats may include %(trace_id)s."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "trace_id"):
+            record.trace_id = current_trace_id() or "-"
+        return True
+
+
+_factory_installed = False
+
+
+def install_log_record_factory() -> None:
+    """Make every LogRecord carry `trace_id` (filters only run on the
+    logger they're attached to; the record factory covers all of them).
+    Idempotent, and composes with any factory installed before it."""
+    global _factory_installed
+    if _factory_installed:
+        return
+    _factory_installed = True
+    prev = logging.getLogRecordFactory()
+
+    def factory(*args, **kwargs):
+        record = prev(*args, **kwargs)
+        record.trace_id = current_trace_id() or "-"
+        return record
+
+    logging.setLogRecordFactory(factory)

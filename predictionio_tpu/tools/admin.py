@@ -70,4 +70,4 @@ class AdminServer(HttpService):
                         return self.send_json(404, {"message": "Not Found"})
                 return self.send_json(404, {"message": "Not Found"})
 
-        super().__init__(ip, port, Handler)
+        super().__init__(ip, port, Handler, server_name="adminserver")

@@ -595,9 +595,16 @@ def main(argv=None) -> int:
               "WARN": logging.WARNING, "INFO": logging.INFO,
               "DEBUG": logging.DEBUG, "NOTSET": logging.NOTSET}
     level = int(name) if name.isdigit() else levels.get(name, logging.WARNING)
+    # Every record carries the active request's trace id (or "-") so one
+    # X-PIO-Trace-Id can be grepped across event-server, prediction-server,
+    # and storage log lines. Must install before basicConfig snapshots a
+    # formatter.
+    from predictionio_tpu.telemetry.tracing import install_log_record_factory
+
+    install_log_record_factory()
     logging.basicConfig(
         level=level,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+        format="%(asctime)s %(levelname)s %(name)s [%(trace_id)s]: %(message)s")
     return args.func(args)
 
 

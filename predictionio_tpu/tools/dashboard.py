@@ -13,6 +13,7 @@ import json
 from typing import Optional
 
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.telemetry.registry import REGISTRY, Histogram
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 _PAGE = """<!doctype html>
@@ -33,6 +34,10 @@ pre {{ margin: 0; font-size: 12px; white-space: pre-wrap; max-width: 48em; }}
 {evals}
 <h2>Engine instances</h2>
 {instances}
+<h2>Telemetry</h2>
+<p>Process-local metrics; the raw Prometheus view is at
+<a href="/metrics">/metrics</a>.</p>
+{telemetry}
 </body></html>"""
 
 
@@ -73,6 +78,40 @@ def _instance_table(rows) -> str:
     return "".join(out)
 
 
+def _label_str(names, values) -> str:
+    return ", ".join(f"{n}={v}" for n, v in zip(names, values)) or "—"
+
+
+def _telemetry_table(registry=REGISTRY) -> str:
+    """Summary panel: one row per labelled series. Histograms collapse to
+    count + mean (the full distribution lives at /metrics)."""
+    rows = []
+    for name in ("http_requests_total", "http_in_flight", "http_errors_total",
+                 "http_request_duration_seconds", "engine_predict_seconds",
+                 "eventserver_events_total", "storage_op_seconds"):
+        m = registry.get(name)
+        if m is None:
+            continue
+        if isinstance(m, Histogram):
+            for key, (_, total, count) in sorted(m.collect()):
+                mean_ms = (total / count * 1e3) if count else 0.0
+                rows.append((name, _label_str(m.labelnames, key),
+                             f"n={count} mean={mean_ms:.1f}ms"))
+        else:
+            for key, value in sorted(m.collect()):
+                rows.append((name, _label_str(m.labelnames, key),
+                             f"{value:g}"))
+    if not rows:
+        return "<p>No samples yet.</p>"
+    out = ["<table><tr><th>Metric</th><th>Labels</th><th>Value</th></tr>"]
+    for name, labels, value in rows:
+        out.append(f"<tr><td>{html.escape(name)}</td>"
+                   f"<td>{html.escape(labels)}</td>"
+                   f"<td>{html.escape(value)}</td></tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
 class Dashboard(HttpService):
     def __init__(self, ip: str = "0.0.0.0", port: int = 9000,
                  storage: Optional[Storage] = None):
@@ -89,6 +128,7 @@ class Dashboard(HttpService):
                 return self.send_html(200, _PAGE.format(
                     evals=_eval_table(evals),
                     instances=_instance_table(instances),
+                    telemetry=_telemetry_table(),
                 ))
 
-        super().__init__(ip, port, Handler)
+        super().__init__(ip, port, Handler, server_name="dashboard")

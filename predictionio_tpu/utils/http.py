@@ -14,6 +14,9 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Type
 
+from predictionio_tpu.telemetry import middleware as telemetry_middleware
+from predictionio_tpu.telemetry import tracing
+
 logger = logging.getLogger("predictionio_tpu.http")
 
 
@@ -61,20 +64,28 @@ class _Server(ThreadingHTTPServer):
     request_queue_size = 128
     daemon_threads = True
 
+    pio_server_name = "http"
+
     def handle_error(self, request, client_address):
         # socketserver's default prints a raw traceback to stderr; a
         # framework that silences its access log must own its error
         # channel too. Client disconnects mid-request (reset/broken
         # pipe — routine under load tests and kill drills) are debug
-        # noise; real handler bugs are errors, with the traceback kept
-        # in the logging record.
+        # noise; real handler bugs are counted and logged at warning
+        # with the request's trace id, traceback kept in the logging
+        # record. The middleware leaves the trace contextvar set on the
+        # exception path precisely so it is still visible here.
         exc = sys.exc_info()[1]
         if isinstance(exc, (ConnectionError, BrokenPipeError, TimeoutError)):
             logger.debug("client %s dropped mid-request: %r",
                          client_address, exc)
         else:
-            logger.error("exception processing request from %s",
-                         client_address, exc_info=True)
+            telemetry_middleware.HTTP_ERRORS.labels(
+                server=self.pio_server_name).inc()
+            logger.warning("exception processing request from %s trace=%s",
+                           client_address,
+                           tracing.current_trace_id() or "-",
+                           exc_info=True)
 
 
 class _ReusePortServer(_Server):
@@ -96,9 +107,19 @@ class HttpService:
 
     def __init__(self, ip: str, port: int,
                  handler_cls: Type[BaseHTTPRequestHandler],
-                 reuse_port: bool = False):
+                 reuse_port: bool = False,
+                 server_name: Optional[str] = None,
+                 instrument: bool = True):
+        # Telemetry is on for every service; `instrument=False` exists for
+        # out-of-package A/B overhead measurement only (quality.py's
+        # telemetry gate rejects it inside predictionio_tpu/).
+        name = server_name or type(self).__name__.lower()
+        if instrument:
+            handler_cls = telemetry_middleware.instrument(handler_cls, name)
+        self.server_name = name
         cls = _ReusePortServer if reuse_port else _Server
         self.httpd = cls((ip, port), handler_cls)
+        self.httpd.pio_server_name = name
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -28,11 +28,22 @@ from typing import Any, Optional
 
 import numpy as np
 
+from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils import faults
 
 log = logging.getLogger(__name__)
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+CKPT_SAVE_SECONDS = REGISTRY.histogram(
+    "checkpoint_save_seconds", "Checkpoint save latency in seconds")
+CKPT_RESTORE_SECONDS = REGISTRY.histogram(
+    "checkpoint_restore_seconds", "Checkpoint restore latency in seconds")
+CKPT_SAVES = REGISTRY.counter(
+    "checkpoint_saves_total", "Checkpoint steps saved")
+CKPT_RESTORES = REGISTRY.counter(
+    "checkpoint_restores_total", "Checkpoint steps restored")
 
 
 def _flatten(tree: Any, prefix: str = "") -> tuple[dict, Any]:
@@ -118,6 +129,13 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, tree: Any, metadata: Optional[dict] = None) -> str:
+        with tracing.span(f"checkpoint save step_{step}"), \
+                CKPT_SAVE_SECONDS.time():
+            out = self._save(step, tree, metadata)
+        CKPT_SAVES.inc()
+        return out
+
+    def _save(self, step: int, tree: Any, metadata: Optional[dict]) -> str:
         arrays, spec = _flatten(tree)
         tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
         final = self._step_dir(step)
@@ -157,12 +175,16 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"No checkpoints under {self.directory}")
-        d = self._step_dir(step)
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
-        with np.load(os.path.join(d, "arrays.npz")) as z:
-            arrays = {k: z[k] for k in z.files}
-        return _unflatten(meta["spec"], arrays), meta.get("metadata", {})
+        with tracing.span(f"checkpoint restore step_{step}"), \
+                CKPT_RESTORE_SECONDS.time():
+            d = self._step_dir(step)
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+            with np.load(os.path.join(d, "arrays.npz")) as z:
+                arrays = {k: z[k] for k in z.files}
+            out = _unflatten(meta["spec"], arrays), meta.get("metadata", {})
+        CKPT_RESTORES.inc()
+        return out
 
     def _gc(self) -> None:
         steps = self.all_steps()

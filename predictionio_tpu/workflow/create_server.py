@@ -25,6 +25,8 @@ import threading
 from typing import Any, Optional
 
 from predictionio_tpu.plugins import PluginRejection
+from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 from predictionio_tpu.storage.base import EngineInstance
@@ -36,6 +38,13 @@ from predictionio_tpu.workflow.workflow_utils import (
 )
 
 log = logging.getLogger(__name__)
+
+# The query hot path, separated from the HTTP envelope so engine time is
+# distinguishable from request parsing/serialization in one scrape.
+PREDICT_SECONDS = REGISTRY.histogram(
+    "engine_predict_seconds", "engine.predict latency in seconds")
+QUERIES_FAILED = REGISTRY.counter(
+    "engine_queries_failed_total", "Queries answered with a non-200 status")
 
 
 class ServerConfig:
@@ -170,15 +179,19 @@ class PredictionServer(HttpService):
                     state = server._state  # snapshot; reload swaps atomically
                     try:
                         query = json.loads(body or b"{}")
-                        result = state.engine.predict(
-                            state.engine_params, state.models, query,
-                            components=state.components,
-                        )
+                        with tracing.span("predictionserver predict"), \
+                                PREDICT_SECONDS.time():
+                            result = state.engine.predict(
+                                state.engine_params, state.models, query,
+                                components=state.components,
+                            )
                         result = server.plugins.on_prediction(
                             query, result, state.instance.id)
                     except PluginRejection as e:
+                        QUERIES_FAILED.inc()
                         return self._send(403, {"message": str(e)})
                     except Exception as e:
+                        QUERIES_FAILED.inc()
                         log.warning("Query failed: %s", e)
                         return self._send(400, {"message": str(e)})
                     return self._send(200, result)
@@ -214,7 +227,8 @@ class PredictionServer(HttpService):
                 return self._send(404, {"message": "Not Found"})
 
         HttpService.__init__(self, config.ip, config.port, Handler,
-                             reuse_port=reuse_port)
+                             reuse_port=reuse_port,
+                             server_name="predictionserver")
 
     def reload(self) -> None:
         """Swap to the newest COMPLETED instance (idempotent, atomic).

@@ -39,9 +39,25 @@ import struct
 import sys
 import threading
 
+from predictionio_tpu.telemetry.registry import REGISTRY
+
 log = logging.getLogger(__name__)
 
 _READY_FMT = "!iq"  # (pid, server_port)
+
+# Supervisor-side pool telemetry. Workers are separate processes with
+# their own registries; these series describe the supervisor's view
+# (spawns, respawns, live count) — per-worker request metrics live in
+# each worker's own /metrics.
+POOL_WORKERS = REGISTRY.gauge(
+    "worker_pool_workers", "Live workers in the SO_REUSEPORT pool")
+POOL_SPAWNED = REGISTRY.counter(
+    "worker_pool_spawned_total", "Workers forked over the pool's lifetime")
+POOL_RESPAWNS = REGISTRY.counter(
+    "worker_pool_respawns_total", "Workers respawned after dying ready")
+POOL_STARTUP_FAILURES = REGISTRY.counter(
+    "worker_pool_startup_failures_total",
+    "Workers that died before ever becoming ready")
 
 
 def _worker_main(config, supervisor_pid: int, ready_fd: int) -> int:
@@ -134,6 +150,8 @@ def run_worker_pool(config, n_workers: int) -> int:
             finally:
                 os._exit(code)
         workers[pid] = False
+        POOL_SPAWNED.inc()
+        POOL_WORKERS.set(len(workers))
         return pid
 
     def _ready_reader():
@@ -202,6 +220,7 @@ def run_worker_pool(config, n_workers: int) -> int:
 
                 time.sleep(0.2)
             was_ready = workers.pop(pid, False)
+            POOL_WORKERS.set(len(workers))
             if state["shutting_down"]:
                 continue
             rc = (os.waitstatus_to_exitcode(status)
@@ -210,12 +229,14 @@ def run_worker_pool(config, n_workers: int) -> int:
                 # died before serving a single request: config/model
                 # error — fail the pool fast, don't crash-loop
                 log.error("worker %d failed at startup (%s)", pid, rc)
+                POOL_STARTUP_FAILURES.inc()
                 state["startup_failed"] = True
                 state["shutting_down"] = True
                 _broadcast(signal.SIGTERM)
                 exit_code = 1
                 continue
             log.warning("worker %d died (%s) — respawning", pid, rc)
+            POOL_RESPAWNS.inc()
             spawn()
     finally:
         os.close(write_fd)

@@ -19,6 +19,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--telemetry-gate", action="store_true",
+                   help="run the observability CI gate (no jax, no data): "
+                        "fails if any in-package HTTP surface bypasses the "
+                        "telemetry middleware")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -35,6 +39,11 @@ def main():
                    help="run the TPU path on the CPU backend")
     args = p.parse_args()
 
+    if args.telemetry_gate:
+        from predictionio_tpu.telemetry.gate import run_gate
+
+        return run_gate()
+
     if args.cpu:
         import jax
 
@@ -50,4 +59,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -29,10 +29,14 @@ def _get(port, path):
 
 
 def test_handler_exception_logs_not_stderr(capfd, caplog):
-    svc = HttpService("127.0.0.1", 0, _BoomHandler)
+    from predictionio_tpu.telemetry.middleware import HTTP_ERRORS
+
+    svc = HttpService("127.0.0.1", 0, _BoomHandler, server_name="boomsvc")
+    errors_before = HTTP_ERRORS.labels(server="boomsvc").value
     svc.start()
     try:
-        with caplog.at_level(logging.ERROR, logger="predictionio_tpu.http"):
+        # handler bugs are warnings (counted, traced), not errors
+        with caplog.at_level(logging.WARNING, logger="predictionio_tpu.http"):
             try:
                 _get(svc.port, "/boom")
             except (http.client.HTTPException, ConnectionError, OSError):
@@ -44,10 +48,14 @@ def test_handler_exception_logs_not_stderr(capfd, caplog):
     err = capfd.readouterr().err
     assert "Traceback" not in err
     assert "Exception occurred during processing of request" not in err
-    assert any("exception processing request" in r.message
-               for r in caplog.records), "handler bug must reach logging"
-    assert any(r.exc_info for r in caplog.records), \
+    crash_records = [r for r in caplog.records
+                     if "exception processing request" in r.message]
+    assert crash_records, "handler bug must reach logging"
+    assert any(r.exc_info for r in crash_records), \
         "traceback belongs in the logging record"
+    # the record carries the request's trace id, not the "-" placeholder
+    assert all("trace=-" not in r.getMessage() for r in crash_records)
+    assert HTTP_ERRORS.labels(server="boomsvc").value == errors_before + 1
 
 
 def test_client_disconnect_is_not_an_error(capfd, caplog):

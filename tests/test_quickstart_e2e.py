@@ -18,6 +18,7 @@ import time
 import pytest
 
 from predictionio_tpu.sdk import EngineClient, EventClient
+from predictionio_tpu.telemetry import tracing
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PIO = str(REPO / "bin" / "pio")
@@ -43,6 +44,9 @@ class PioRig:
         self.env.update(
             PIO_CONF_DIR=str(self.conf),
             JAX_PLATFORMS="cpu",
+            # INFO so each service's access log (which carries trace ids)
+            # reaches the captured stdout for the propagation asserts
+            PIO_LOG_LEVEL="INFO",
         )
         self.procs: list[subprocess.Popen] = []
 
@@ -82,6 +86,18 @@ class PioRig:
             if m:
                 return int(m.group(1))
         raise AssertionError(f"service {args} never became ready:\n" + "".join(lines))
+
+    def finish(self, p) -> str:
+        """Terminate one service and return its remaining output (the
+        readiness lines were already consumed by serve())."""
+        if p.poll() is None:
+            p.terminate()
+        try:
+            out, _ = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        return out or ""
 
     def teardown(self):
         for p in self.procs:
@@ -179,6 +195,32 @@ def test_quickstart_recommendation(rig, tmp_path):
     cold = engine.send_query({"user": "never-seen", "num": 4})
     assert len(cold["itemScores"]) == 4, cold
     assert all(1 <= int(r["item"]) <= 30 for r in cold["itemScores"])
+
+    # 8. observability (ISSUE 2): one trace id through event server and
+    # prediction server — echoed in response headers, visible in both
+    # services' logs — and /metrics live on both real processes
+    tid = "quickstarttrace1"
+    with tracing.trace(tid):
+        client.create_event(
+            event="rate", entity_type="user", entity_id="1",
+            target_entity_type="item", target_entity_id="1",
+            properties={"rating": 5.0})
+        assert client.last_trace_id == tid
+        engine.send_query({"user": "1", "num": 1})
+        assert engine.last_trace_id == tid
+
+    import urllib.request
+    for port in (es_port, dp_port):
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "# TYPE http_requests_total counter" in text
+        assert "# TYPE http_request_duration_seconds histogram" in text
+
+    es_proc, dp_proc = rig.procs[0], rig.procs[1]
+    es_out = rig.finish(es_proc)
+    dp_out = rig.finish(dp_proc)
+    assert f"trace={tid}" in es_out, es_out[-2000:]
+    assert f"trace={tid}" in dp_out, dp_out[-2000:]
 
 
 def test_eventserver_rest_conformance(rig):
