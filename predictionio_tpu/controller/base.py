@@ -93,9 +93,23 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, R]):
     `train` should build jitted XLA programs under `ctx.mesh`; `predict`
     serves one query from an in-memory model (the serving hot path);
     `batch_predict` is the bulk-scoring path used by evaluation
-    (`batchPredictBase` [U]) — override it with a vmapped/jitted version
-    for speed, the default just loops `predict`.
+    (`batchPredictBase` [U]) and by the serving micro-batcher — override
+    it with a vmapped/jitted version for speed, the default just loops
+    `predict`.
     """
+
+    # Checkpoint-subdir tags this class passes to
+    # ctx.algorithm_checkpoint_dir during train. Engine._ckpt_suffixes
+    # keys duplicate detection on these so two DIFFERENT classes sharing
+    # a tag (e.g. two ALS variants both tagged "als") get distinct
+    # suffixes instead of purging each other's checkpoints. () means
+    # "no persistent checkpoints" and falls back to per-class keying.
+    checkpoint_tags: tuple = ()
+
+    # True for algorithms whose predict is cheap enough (and needs no
+    # per-user state) to answer under saturation — the serving plane's
+    # degraded-mode fallback (e.g. a popularity model).
+    degraded_capable: bool = False
 
     @abc.abstractmethod
     def train(self, ctx: WorkflowContext, prepared_data: PD) -> M: ...

@@ -44,16 +44,25 @@ def resolve_component(class_map: dict, name: str, role: str) -> Type:
 
 
 def _ckpt_suffixes(algos) -> list[str]:
-    """Checkpoint-dir suffix per algorithm instance: "" for the first of
-    a class, ".1"/".2"/… for duplicates. Checkpoint subdirs are keyed by
-    a tag the algorithm CLASS hard-codes, so two entries of one class —
-    legal in engine.json, matching «algorithmClassMap» [U] — would share
-    a subdir and purge each other's saves without this."""
-    counts: dict[type, int] = {}
+    """Checkpoint-dir suffix per algorithm instance: "" for the first
+    user of a checkpoint tag, ".1"/".2"/… for later ones. Checkpoint
+    subdirs are keyed by a tag the algorithm CLASS hard-codes
+    (`Algorithm.checkpoint_tags`), so collisions follow the TAG, not the
+    class: two entries of one class — legal in engine.json, matching
+    «algorithmClassMap» [U] — and equally two different classes that
+    declare the same tag (e.g. ALS variants both tagged "als") would
+    purge each other's saves without this. Classes declaring no tags
+    fall back to per-class keying (they may still checkpoint under an
+    undeclared name; same-class duplicates stay disambiguated)."""
+    counts: dict = {}
     out = []
     for _, algo in algos:
-        n = counts.get(type(algo), 0)
-        counts[type(algo)] = n + 1
+        keys = tuple(getattr(algo, "checkpoint_tags", ()) or ()) or (type(algo),)
+        # an instance whose class uses several tags must not reuse ANY of
+        # them, so its suffix ordinal is the max across its tags
+        n = max(counts.get(k, 0) for k in keys)
+        for k in keys:
+            counts[k] = n + 1
         out.append(f".{n}" if n else "")
     return out
 
@@ -332,6 +341,50 @@ class Engine:
             algo.predict(model, query) for (_, algo), model in zip(algos, models)
         ]
         return serving.serve(query, predictions)
+
+    def predict_batch(
+        self,
+        engine_params: EngineParams,
+        models: Sequence[Any],
+        queries: Sequence[Any],
+        components=None,
+    ) -> list[Any]:
+        """Serve a coalesced batch of queries in one pass — the serving
+        micro-batcher's dispatch target. Each algorithm scores the whole
+        batch via `batch_predict` (vectorized where the template overrides
+        it, a predict loop otherwise), then Serving combines per query
+        exactly as `predict` does, so results are positionally identical
+        to per-query `predict` calls."""
+        if components is None:
+            components = self.components(engine_params)
+        _, _, algos, serving = components
+        per_algo = [
+            algo.batch_predict(model, list(queries))
+            for (_, algo), model in zip(algos, models)
+        ]
+        return [
+            serving.serve(q, [preds[i] for preds in per_algo])
+            for i, q in enumerate(queries)
+        ]
+
+    def degraded_predict(
+        self,
+        engine_params: EngineParams,
+        models: Sequence[Any],
+        query: Any,
+        components=None,
+    ) -> Optional[Any]:
+        """Serve one query through the first `degraded_capable` algorithm
+        alone (bypassing Serving combination — the other algorithms did
+        not run). Returns None when no algorithm volunteers; the serving
+        plane then sheds normally."""
+        if components is None:
+            components = self.components(engine_params)
+        _, _, algos, _ = components
+        for (_, algo), model in zip(algos, models):
+            if getattr(algo, "degraded_capable", False):
+                return algo.predict(model, query)
+        return None
 
 
 class EngineFactory:

@@ -94,7 +94,19 @@ def recommend_topk(
         # size that fits serving, and it keeps the prediction server off
         # the accelerator entirely — a deployed server must not hold the
         # (single-tenant) TPU that a concurrent `pio train` needs.
-        scores = user_factors[user_ids] @ item_factors.T
+        #
+        # Scored per row (gemv), NOT as one [B,K]@[K,N] gemm: BLAS gemm
+        # blocks the reduction differently per shape, so a user's scores
+        # would shift in the last ulp with the batch they arrived in —
+        # and the serving micro-batcher promises batched ≡ sequential
+        # bitwise. Per-row gemv is batch-size-invariant; at serving
+        # batch sizes (≤ SERVE_HOST_MAX_BATCH) the gemv loop is still
+        # hundreds of microseconds against a millisecond-scale request.
+        it_t = item_factors.T
+        scores = np.empty((len(user_ids), n_items),
+                          dtype=np.result_type(user_factors, item_factors))
+        for i, uid in enumerate(user_ids):
+            scores[i] = user_factors[uid] @ it_t
         if masked:
             for i, uid in enumerate(user_ids):
                 ex = exclude.get(int(uid))

@@ -1,0 +1,35 @@
+"""Dynamic micro-batching serving plane.
+
+Sits between the HTTP layer (workflow/create_server.py) and the engine:
+
+- `admission` — deadline-aware admission control: bounded queue depth,
+  per-request deadlines from the `X-PIO-Deadline-Ms` header, load
+  shedding (429 + Retry-After) when saturated, 503 on expired deadlines.
+- `batcher` — per-engine-instance micro-batching: concurrent predict
+  requests coalesce into one padded, fixed-bucket batched dispatch.
+- `plane` — ServingPlane ties both together and carries the degraded-mode
+  hook (e.g. popularity fallback instead of hard failure).
+
+The design constraint inherited from ops/ranking.py stands: serving stays
+off the TPU by default (max_batch ≤ the host-scoring threshold); bucket
+padding exists so a configuration that does cross onto the device reuses
+compiles instead of recompiling per batch size.
+
+See docs/serving.md for the config knobs and the HTTP contract.
+"""
+
+from predictionio_tpu.serving.admission import (  # noqa: F401
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineExceeded,
+    ShedLoad,
+    deadline_from_headers,
+)
+from predictionio_tpu.serving.batcher import (  # noqa: F401
+    BatcherConfig,
+    MicroBatcher,
+)
+from predictionio_tpu.serving.plane import (  # noqa: F401
+    ServingConfig,
+    ServingPlane,
+)

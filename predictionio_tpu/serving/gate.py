@@ -1,0 +1,191 @@
+"""Serving gate — CI check that no predict route bypasses admission.
+
+Run via `python quality.py --serving-gate`. Mirrors the telemetry gate's
+two layers:
+
+1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
+   any `do_*` HTTP handler that routes `/queries.json` must call the
+   serving plane's `handle_query` (which is admit → dispatch → release),
+   and must not call an engine `predict`/`predict_batch` itself — a
+   handler that dispatches directly has no queue bound, no deadline
+   handling, and no shed path, which is exactly the saturation-collapse
+   mode this subsystem exists to prevent.
+
+2. Runtime check: saturate a tiny ServingPlane (max_queue=1) and verify
+   the second concurrent request raises ShedLoad carrying a positive
+   Retry-After; verify an expired deadline raises DeadlineExceeded
+   WITHOUT the dispatch function ever running; verify the serving_*
+   telemetry families render on the registry.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXEMPT = {
+    os.path.join("serving", "gate.py"),
+}
+
+_QUERY_ROUTE = "/queries.json"
+# engine dispatch spellings a predict handler must not call directly
+_DIRECT_DISPATCH = {"predict", "predict_batch"}
+# the admission-controlled entry point (ServingPlane.handle_query)
+_PLANE_ENTRY = "handle_query"
+
+
+def _contains_query_route(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and node.value == _QUERY_ROUTE:
+            return True
+    return False
+
+
+def _scan_handler(fn: ast.FunctionDef, rel: str) -> list[str]:
+    problems = []
+    calls = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            calls.add(node.func.attr)
+    if _PLANE_ENTRY not in calls:
+        problems.append(
+            f"{rel}:{fn.lineno}: {fn.name} routes {_QUERY_ROUTE} without "
+            f"calling the serving plane's {_PLANE_ENTRY}() — predict "
+            f"requests must pass admission control")
+    direct = calls & _DIRECT_DISPATCH
+    if direct:
+        problems.append(
+            f"{rel}:{fn.lineno}: {fn.name} calls {sorted(direct)} directly "
+            f"in the {_QUERY_ROUTE} handler — dispatch belongs behind "
+            f"ServingPlane.{_PLANE_ENTRY} (queue bound, deadlines, shed)")
+    return problems
+
+
+def _scan_file(path: str, rel: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            return [f"{rel}: unparseable ({e})"]
+    problems = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.FunctionDef) and node.name.startswith("do_")
+                and _contains_query_route(node)):
+            problems.extend(_scan_handler(node, rel))
+    return problems
+
+
+def _static_scan() -> list[str]:
+    problems = []
+    found_route = False
+    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _PKG_DIR)
+            if rel in _EXEMPT:
+                continue
+            file_problems = _scan_file(path, rel)
+            problems.extend(file_problems)
+            if not file_problems:
+                with open(path, encoding="utf-8") as f:
+                    if _QUERY_ROUTE in f.read():
+                        found_route = True
+    if not found_route:
+        # the gate must notice if the predict route itself disappears —
+        # an empty scan proves nothing
+        problems.append(
+            f"static: no in-package handler routes {_QUERY_ROUTE}; "
+            f"the serving gate has nothing to hold")
+    return problems
+
+
+def _runtime_check() -> list[str]:
+    import threading
+    import time
+
+    from predictionio_tpu.serving import (
+        AdmissionConfig,
+        DeadlineExceeded,
+        ServingConfig,
+        ServingPlane,
+        ShedLoad,
+    )
+    from predictionio_tpu.serving.admission import DEADLINE_HEADER
+    from predictionio_tpu.telemetry.registry import REGISTRY
+
+    problems = []
+    release = threading.Event()
+    dispatched = []
+
+    def blocking_dispatch(queries):
+        dispatched.append(list(queries))
+        release.wait(10)
+        return queries
+
+    cfg = ServingConfig(
+        admission=AdmissionConfig(max_queue=1, retry_after_s=0.25))
+    plane = ServingPlane(blocking_dispatch, config=cfg, name="servinggate")
+    try:
+        t = threading.Thread(
+            target=lambda: plane.handle_query({"probe": 1}), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not dispatched and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if not dispatched:
+            problems.append("runtime: occupying request never dispatched")
+        try:
+            plane.handle_query({"probe": 2})
+            problems.append("runtime: saturated plane (max_queue=1) "
+                            "admitted a second request instead of shedding")
+        except ShedLoad as e:
+            if not e.retry_after_s > 0:
+                problems.append("runtime: ShedLoad carries no positive "
+                                "Retry-After")
+        n_before = len(dispatched)
+        try:
+            plane.handle_query({"probe": 3}, {DEADLINE_HEADER: "0.0001"})
+            problems.append("runtime: expired deadline was served instead "
+                            "of rejected")
+        except (DeadlineExceeded, ShedLoad):
+            pass
+        if len(dispatched) != n_before:
+            problems.append("runtime: expired-deadline request reached the "
+                            "dispatch function")
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        plane.close()
+    text = REGISTRY.render()
+    for family in ("serving_shed_total", "serving_deadline_misses_total",
+                   "serving_admitted_in_flight", "serving_batch_size",
+                   "serving_queue_depth", "serving_queue_wait_seconds",
+                   "serving_batches_total", "serving_degraded_total"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"runtime: /metrics is missing {family}")
+    return problems
+
+
+def run_gate() -> int:
+    problems = _static_scan()
+    try:
+        problems += _runtime_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"runtime check crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"serving gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

@@ -1,0 +1,155 @@
+"""ServingPlane: admission control + micro-batching + graceful degradation.
+
+This is the single object the HTTP layer talks to. Per request:
+
+    result, degraded = plane.handle_query(query, headers)
+
+which is admit → (batched or direct) dispatch → release, with the
+degraded-mode hook tried when admission sheds. The HTTP handler maps the
+two exceptions that can escape — ShedLoad → 429, DeadlineExceeded → 503,
+both with Retry-After — and everything else stays the 400 it always was.
+
+Degradation fires ONLY on saturation (ShedLoad): a cheap fallback answer
+(e.g. the popularity model, which needs no per-user work) beats a 429
+when the engine offers one. Deadline misses do NOT degrade — the client
+declared the answer worthless after the deadline, so any answer, however
+cheap, is wasted bytes.
+
+Configuration resolves from PIO_SERVING_* environment variables
+(`ServingConfig.from_env`) so the pre-fork worker pool — where each
+worker builds its own PredictionServer in a fresh process — picks up one
+consistent serving posture without plumbing flags through exec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Callable, List, Optional, Tuple
+
+from predictionio_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ShedLoad,
+    deadline_from_headers,
+)
+from predictionio_tpu.serving.batcher import BatcherConfig, MicroBatcher
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+log = logging.getLogger(__name__)
+
+DEGRADED = REGISTRY.counter(
+    "serving_degraded_total",
+    "Predict requests answered by the degraded-mode fallback under shed")
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("ignoring unparseable %s=%r", name, raw)
+        return default
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    # micro-batching on/off; admission control is NOT optional — with
+    # batching off, requests still admit/release around a direct dispatch
+    batching: bool = True
+    admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
+    batcher: BatcherConfig = dataclasses.field(default_factory=BatcherConfig)
+
+    @classmethod
+    def from_env(cls) -> "ServingConfig":
+        """Resolve from PIO_SERVING_* (every knob optional):
+
+        PIO_SERVING_BATCHING=0|1, PIO_SERVING_MAX_BATCH,
+        PIO_SERVING_MAX_WAIT_MS, PIO_SERVING_MAX_QUEUE,
+        PIO_SERVING_DEFAULT_DEADLINE_MS, PIO_SERVING_RETRY_AFTER_S."""
+        cfg = cls()
+        raw = os.environ.get("PIO_SERVING_BATCHING")
+        if raw is not None:
+            cfg.batching = raw.strip().lower() in _TRUTHY
+        cfg.batcher.max_batch = int(
+            _env_float("PIO_SERVING_MAX_BATCH", cfg.batcher.max_batch))
+        cfg.batcher.max_wait_ms = _env_float(
+            "PIO_SERVING_MAX_WAIT_MS", cfg.batcher.max_wait_ms)
+        cfg.admission.max_queue = int(
+            _env_float("PIO_SERVING_MAX_QUEUE", cfg.admission.max_queue))
+        cfg.admission.default_deadline_ms = _env_float(
+            "PIO_SERVING_DEFAULT_DEADLINE_MS",
+            cfg.admission.default_deadline_ms)
+        cfg.admission.retry_after_s = _env_float(
+            "PIO_SERVING_RETRY_AFTER_S", cfg.admission.retry_after_s)
+        return cfg
+
+
+class ServingPlane:
+    """Admission-gated (optionally batched) dispatch for one engine
+    instance.
+
+    `dispatch_fn(queries: list) -> list[results]` — the batched predict
+    path (Engine.predict_batch bound to the served state).
+    `degraded_fn(query) -> result` — optional cheap fallback used when
+    admission sheds; raise/return None to decline."""
+
+    def __init__(self,
+                 dispatch_fn: Callable[[List], List],
+                 degraded_fn: Optional[Callable] = None,
+                 config: Optional[ServingConfig] = None,
+                 name: str = "predictionserver"):
+        self.config = config or ServingConfig()
+        self.dispatch_fn = dispatch_fn
+        self.degraded_fn = degraded_fn
+        self.admission = AdmissionController(self.config.admission)
+        self.batcher: Optional[MicroBatcher] = None
+        if self.config.batching:
+            # the admitted count is the batcher's fill signal: a forming
+            # batch stops waiting the moment it holds every admitted
+            # request (see batcher module docstring)
+            self.batcher = MicroBatcher(
+                dispatch_fn, config=self.config.batcher, name=name,
+                pending_fn=lambda: self.admission.admitted)
+
+    def handle_query(self, query, headers=None) -> Tuple[object, bool]:
+        """Admit, dispatch, release. Returns (result, degraded_flag).
+
+        Raises ShedLoad (→ 429) when saturated and no degraded answer
+        exists; DeadlineExceeded (→ 503) when the request's deadline
+        expired before a result was produced."""
+        deadline = deadline_from_headers(headers, self.config.admission)
+        try:
+            self.admission.admit(deadline)
+        except ShedLoad:
+            degraded = self._try_degraded(query)
+            if degraded is not None:
+                return degraded, True
+            raise
+        try:
+            if self.batcher is not None:
+                return self.batcher.submit(query, deadline), False
+            return self.dispatch_fn([query])[0], False
+        finally:
+            self.admission.release()
+
+    def _try_degraded(self, query):
+        if self.degraded_fn is None:
+            return None
+        try:
+            result = self.degraded_fn(query)
+        except Exception:  # noqa: BLE001 — degraded path must never mask the shed
+            log.exception("degraded-mode fallback failed; shedding instead")
+            return None
+        if result is not None:
+            DEGRADED.inc()
+        return result
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()

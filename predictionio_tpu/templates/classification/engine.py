@@ -254,6 +254,7 @@ class LogisticRegressionAlgorithm(Algorithm):
     regression (Adam full-batch; psum gradient allreduce under the mesh)."""
 
     params_class = LogisticRegressionParams
+    checkpoint_tags = ("lr",)
 
     def __init__(self, params: LogisticRegressionParams):
         self.params = params

@@ -228,6 +228,7 @@ class ECommAlgorithm(Algorithm):
     here (not in Serving) to match the reference's shape."""
 
     params_class = ECommAlgorithmParams
+    checkpoint_tags = ("als",)
 
     def __init__(self, params: ECommAlgorithmParams):
         self.params = params

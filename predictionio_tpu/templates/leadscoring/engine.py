@@ -195,6 +195,7 @@ class LeadScoringParams(Params):
 
 class LeadScoringAlgorithm(Algorithm):
     params_class = LeadScoringParams
+    checkpoint_tags = ("lr",)
 
     def __init__(self, params: LeadScoringParams):
         self.params = params

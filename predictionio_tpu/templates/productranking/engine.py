@@ -21,6 +21,8 @@ Wire shapes:
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from predictionio_tpu.controller import Engine, EngineFactory, FirstServing
@@ -41,17 +43,8 @@ PredictedResult = dict
 class RankingALSAlgorithm(_RecommendationALS):
     """Recommendation's ALS train + ranking-specific serving."""
 
-    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        items = [str(i) for i in (query.get("items") or [])]
-        user = str(query.get("user", ""))
-        urow = model.user_ids.get(user)
-        if urow is None or not items:
-            # upstream contract: can't personalize → echo the original
-            # order and say so
-            return {"itemScores": [{"item": i, "score": 0.0}
-                                   for i in items],
-                    "isOriginal": True}
-        uvec = model.user_factors[int(urow)]
+    @staticmethod
+    def _rank(model: ALSModel, uvec: np.ndarray, items: list) -> list:
         # unknown items enter the ranking at score 0 (upstream contract),
         # NOT appended after known ones — an explicit-feedback model can
         # score disliked items negative, and the response must stay
@@ -63,8 +56,47 @@ class RankingALSAlgorithm(_RecommendationALS):
                      else float(uvec @ model.item_factors[int(row)]))
             scored.append((score, pos, item))
         scored.sort(key=lambda t: (-t[0], t[1]))
-        out = [{"item": item, "score": s} for s, _, item in scored]
-        return {"itemScores": out, "isOriginal": False}
+        return [{"item": item, "score": s} for s, _, item in scored]
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        items = [str(i) for i in (query.get("items") or [])]
+        user = str(query.get("user", ""))
+        urow = model.user_ids.get(user)
+        if urow is None or not items:
+            # upstream contract: can't personalize → echo the original
+            # order and say so
+            return {"itemScores": [{"item": i, "score": 0.0}
+                                   for i in items],
+                    "isOriginal": True}
+        return {"itemScores": self._rank(model, model.user_factors[int(urow)],
+                                         items),
+                "isOriginal": False}
+
+    def batch_predict(self, model: ALSModel, queries) -> list[PredictedResult]:
+        """Batched path for the serving micro-batcher (overrides the
+        recommendation template's user-grouped top-k, which serves a
+        different query shape). Scoring rides the same `_rank` ops per
+        query — batched ≡ sequential bitwise by construction — and the
+        batch win is resolving each hot user's factor row once per batch
+        instead of once per co-batched request."""
+        uvecs: dict[str, Optional[np.ndarray]] = {}
+        out = []
+        for q in queries:
+            items = [str(i) for i in (q.get("items") or [])]
+            user = str(q.get("user", ""))
+            if user not in uvecs:
+                urow = model.user_ids.get(user)
+                uvecs[user] = (None if urow is None
+                               else model.user_factors[int(urow)])
+            uvec = uvecs[user]
+            if uvec is None or not items:
+                out.append({"itemScores": [{"item": i, "score": 0.0}
+                                           for i in items],
+                            "isOriginal": True})
+            else:
+                out.append({"itemScores": self._rank(model, uvec, items),
+                            "isOriginal": False})
+        return out
 
 
 class ProductRankingEngine(EngineFactory):

@@ -206,6 +206,7 @@ class ALSAlgorithm(Algorithm):
     bimaps + seen items for serve-time exclusion."""
 
     params_class = ALSAlgorithmParams
+    checkpoint_tags = ("als",)
 
     def __init__(self, params: ALSAlgorithmParams):
         self.params = params
@@ -376,6 +377,9 @@ class PopularityAlgorithm(Algorithm):
     (no per-event Python)."""
 
     params_class = PopularityParams
+    # no per-user device work and O(num) serve cost: this is the serving
+    # plane's degraded-mode answer when admission sheds under saturation
+    degraded_capable = True
 
     def __init__(self, params: PopularityParams):
         self.params = params

@@ -269,6 +269,7 @@ class ALSAlgorithm(Algorithm):
     """«ALSAlgorithm.train» (implicit) → cosine item-item model [U]."""
 
     params_class = ALSAlgorithmParams
+    checkpoint_tags = ("als",)
 
     def __init__(self, params: ALSAlgorithmParams):
         self.params = params
@@ -336,6 +337,64 @@ class ALSAlgorithm(Algorithm):
             black_list=query.get("blackList"),
         )
         return {"itemScores": [{"item": i, "score": s} for i, s in sims]}
+
+    def batch_predict(self, model: SimilarProductModel,
+                      queries) -> list[PredictedResult]:
+        """Batched path for the serving micro-batcher: filterless
+        same-`num` queries share one vectorized mask/top-k pass over a
+        stacked [B, n_items] score matrix; anything with category/white/
+        black filters (or an empty basket) falls back to per-query
+        `predict`. Score rows are computed with the exact expression
+        `similar()` uses, and argpartition/argsort along axis=1 match
+        their 1-D forms row for row, so batched results are bitwise
+        identical to sequential ones."""
+        unit = model.item_factors_unit
+        n_items = unit.shape[0]
+        out: list[PredictedResult] = [None] * len(queries)  # type: ignore
+        groups: dict[int, list[tuple[int, np.ndarray]]] = {}
+        for pos, q in enumerate(queries):
+            known = [str(i) for i in (q.get("items") or [])
+                     if model.item_ids.contains(str(i))]
+            num = int(q.get("num", 10))
+            if (not known or num <= 0 or q.get("categories")
+                    or q.get("whiteList") or q.get("blackList")):
+                out[pos] = self.predict(model, q)
+                continue
+            groups.setdefault(num, []).append(
+                (pos, model.item_ids.to_index(known)))
+        for num, entries in groups.items():
+            scores = np.empty((len(entries), n_items), dtype=unit.dtype)
+            mask = np.ones((len(entries), n_items), dtype=bool)
+            for r, (_, ki) in enumerate(entries):
+                scores[r] = (unit[ki] @ unit.T).mean(axis=0)
+                mask[r, ki] = False
+            # rows whose post-mask candidate count undercuts num need a
+            # per-row k — rare (giant basket vs tiny catalog); punt them
+            # to predict so the vectorized rows keep one uniform k
+            avail = mask.sum(axis=1)
+            k = min(num, n_items)
+            live = []
+            for r, (pos, _) in enumerate(entries):
+                if avail[r] < k:
+                    out[pos] = self.predict(model, queries[pos])
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            s = np.where(mask[live], scores[live], -np.inf)
+            idx = np.argpartition(-s, k - 1, axis=1)[:, :k]
+            part = np.take_along_axis(s, idx, axis=1)
+            order = np.argsort(-part, axis=1)
+            top = np.take_along_axis(idx, order, axis=1)
+            top_scores = np.take_along_axis(part, order, axis=1)
+            names = model.item_ids.from_index(top.ravel())
+            for j, r in enumerate(live):
+                pos = entries[r][0]
+                base = j * k
+                out[pos] = {"itemScores": [
+                    {"item": names[base + c], "score": float(top_scores[j, c])}
+                    for c in range(k)]}
+        return out
 
 
 class SimilarProductEngine(EngineFactory):

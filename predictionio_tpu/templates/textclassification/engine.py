@@ -249,6 +249,7 @@ class LRAlgorithm(Algorithm):
     """«LRAlgorithm» (LogisticRegression variant) [U]."""
 
     params_class = LRParams
+    checkpoint_tags = ("lr",)
 
     def __init__(self, params: LRParams):
         self.params = params
@@ -334,6 +335,7 @@ class Word2VecAlgorithm(Algorithm):
     """Word2Vec variant [U]: train embeddings, classify mean doc vectors."""
 
     params_class = Word2VecParams
+    checkpoint_tags = ("w2v", "w2v-head")
 
     def __init__(self, params: Word2VecParams):
         self.params = params

@@ -32,11 +32,17 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def send_json(self, code: int, payload) -> None:
+    def send_json(self, code: int, payload,
+                  headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
+        if headers:
+            # extra response headers (e.g. Retry-After on 429/503 shed
+            # responses, X-PIO-Degraded on fallback answers)
+            for k, v in headers.items():
+                self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 

@@ -25,6 +25,12 @@ import threading
 from typing import Any, Optional
 
 from predictionio_tpu.plugins import PluginRejection
+from predictionio_tpu.serving import (
+    DeadlineExceeded,
+    ServingConfig,
+    ServingPlane,
+    ShedLoad,
+)
 from predictionio_tpu.telemetry import tracing
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
@@ -42,7 +48,9 @@ log = logging.getLogger(__name__)
 # The query hot path, separated from the HTTP envelope so engine time is
 # distinguishable from request parsing/serialization in one scrape.
 PREDICT_SECONDS = REGISTRY.histogram(
-    "engine_predict_seconds", "engine.predict latency in seconds")
+    "engine_predict_seconds",
+    "Engine predict dispatch latency in seconds (one observation per "
+    "batched dispatch; serving_batch_size gives queries per dispatch)")
 QUERIES_FAILED = REGISTRY.counter(
     "engine_queries_failed_total", "Queries answered with a non-200 status")
 
@@ -137,7 +145,8 @@ class PredictionServer(HttpService):
 
     def __init__(self, config: ServerConfig, storage: Optional[Storage] = None,
                  plugins=None, reuse_port: bool = False,
-                 supervisor_pid: Optional[int] = None):
+                 supervisor_pid: Optional[int] = None,
+                 serving_config: Optional[ServingConfig] = None):
         from predictionio_tpu.plugins import load_plugins_from_env
 
         self.config = config
@@ -149,6 +158,29 @@ class PredictionServer(HttpService):
         self._state_lock = threading.Lock()
         worker_pid = os.getpid()
         server = self
+
+        # The serving plane (admission + micro-batching) outlives reloads:
+        # its dispatch reads server._state at dispatch time, so a batch
+        # coalesced across a /reload simply scores on whichever state is
+        # current — same snapshot semantics the single-query path had.
+        def _dispatch(queries):
+            state = server._state
+            with tracing.span("predictionserver predict"), \
+                    PREDICT_SECONDS.time():
+                return state.engine.predict_batch(
+                    state.engine_params, state.models, queries,
+                    components=state.components)
+
+        def _degraded(query):
+            state = server._state
+            return state.engine.degraded_predict(
+                state.engine_params, state.models, query,
+                components=state.components)
+
+        self.serving = ServingPlane(
+            _dispatch, degraded_fn=_degraded,
+            config=serving_config or ServingConfig.from_env(),
+            name="predictionserver")
 
         class Handler(JsonRequestHandler):
             server_version = "pio-tpu-server/0.1"
@@ -176,17 +208,25 @@ class PredictionServer(HttpService):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if self.path == "/queries.json":
-                    state = server._state  # snapshot; reload swaps atomically
+                    retry_after = server.serving.config.admission.retry_after_s
                     try:
                         query = json.loads(body or b"{}")
-                        with tracing.span("predictionserver predict"), \
-                                PREDICT_SECONDS.time():
-                            result = state.engine.predict(
-                                state.engine_params, state.models, query,
-                                components=state.components,
-                            )
+                        result, degraded = server.serving.handle_query(
+                            query, self.headers)
                         result = server.plugins.on_prediction(
-                            query, result, state.instance.id)
+                            query, result, server._state.instance.id)
+                    except ShedLoad as e:
+                        # saturated and no degraded answer: an explicit,
+                        # immediate 429 beats queueing into collapse
+                        QUERIES_FAILED.inc()
+                        return self._send(
+                            429, {"message": str(e)},
+                            headers={"Retry-After": f"{e.retry_after_s:g}"})
+                    except DeadlineExceeded as e:
+                        QUERIES_FAILED.inc()
+                        return self._send(
+                            503, {"message": str(e)},
+                            headers={"Retry-After": f"{retry_after:g}"})
                     except PluginRejection as e:
                         QUERIES_FAILED.inc()
                         return self._send(403, {"message": str(e)})
@@ -194,7 +234,9 @@ class PredictionServer(HttpService):
                         QUERIES_FAILED.inc()
                         log.warning("Query failed: %s", e)
                         return self._send(400, {"message": str(e)})
-                    return self._send(200, result)
+                    return self._send(
+                        200, result,
+                        headers={"X-PIO-Degraded": "1"} if degraded else None)
                 if self.path == "/reload":
                     if server.supervisor_pid is not None:
                         # pool mode: the kernel routed this request to ONE
@@ -237,6 +279,13 @@ class PredictionServer(HttpService):
         with self._state_lock:
             self._state = load_served_state(self.storage, self.config)
         log.info("Reloaded engine instance %s", self._state.instance.id)
+
+    def shutdown(self) -> None:
+        """Graceful drain: the HTTP server stops accepting and finishes
+        in-flight handlers first (their queued queries still dispatch),
+        then the batcher's dispatcher thread is joined."""
+        super().shutdown()
+        self.serving.close()
 
     @property
     def instance_id(self) -> str:

@@ -27,6 +27,15 @@ Throughput scales with cores because the workers are separate
 processes — each has its own GIL. On a 1-vCPU box the pool is a
 correctness mechanism (drilled in tests/test_worker_pool.py); on a
 multi-core serving host it is the qps ladder's scale-out lever.
+
+Serving plane in pool mode: each worker builds its own ServingPlane
+(predictionio_tpu/serving) from the PIO_SERVING_* environment — the
+environment crosses the fork, so one posture governs the pool. Admission
+budgets and micro-batch queues are per-process: a pool of N workers
+admits up to N × PIO_SERVING_MAX_QUEUE requests, and batches form from
+the concurrency the kernel routes to each listener. SIGTERM drains
+gracefully: the worker's shutdown finishes in-flight handlers (queued
+queries still dispatch) before the batcher thread is joined.
 """
 
 from __future__ import annotations
@@ -93,6 +102,9 @@ def _worker_main(config, supervisor_pid: int, ready_fd: int) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # PredictionServer.shutdown drains: stop accepting, finish
+        # in-flight handlers (their queued queries still dispatch), then
+        # join the serving plane's batcher thread
         server.shutdown()
         Storage.get().close()
         sys.stdout.flush()

@@ -23,6 +23,10 @@ def main():
                    help="run the observability CI gate (no jax, no data): "
                         "fails if any in-package HTTP surface bypasses the "
                         "telemetry middleware")
+    p.add_argument("--serving-gate", action="store_true",
+                   help="run the serving CI gate (no jax, no data): fails "
+                        "if any predict route bypasses admission control / "
+                        "the serving plane")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -41,6 +45,11 @@ def main():
 
     if args.telemetry_gate:
         from predictionio_tpu.telemetry.gate import run_gate
+
+        return run_gate()
+
+    if args.serving_gate:
+        from predictionio_tpu.serving.gate import run_gate
 
         return run_gate()
 

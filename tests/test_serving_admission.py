@@ -1,0 +1,269 @@
+"""Admission control + graceful degradation: deadline header parsing,
+bounded admission budget, PIO_SERVING_* config resolution, and the HTTP
+saturation drill — a saturated server answers 429/503 with Retry-After
+(never hangs, never 5xx-storms) and degrades to the popularity fallback
+when the engine offers one."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.serving import (
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineExceeded,
+    ServingConfig,
+    ShedLoad,
+    deadline_from_headers,
+)
+from predictionio_tpu.serving.admission import DEADLINE_HEADER
+from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+from predictionio_tpu.workflow.create_server import PredictionServer, ServerConfig
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+from tests.test_recommendation_template import (
+    ingest_ratings,
+    multi_algo_variant,
+    variant_dict,
+)
+
+
+def call_raw(port, method, path, body=None, headers=None):
+    """Like test_prediction_server.call but also returns response headers
+    (Retry-After, X-PIO-Degraded are part of the serving contract)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=data, method=method, headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+def deploy(storage, variant_d, engine_id, serving_config):
+    variant = EngineVariant.from_dict(variant_d)
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    ctx = WorkflowContext(storage=storage, seed=1)
+    CoreWorkflow.run_train(engine, ep, variant, ctx)
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id=engine_id,
+                          engine_variant=engine_id)
+    server = PredictionServer(config, storage, serving_config=serving_config)
+    server.start()
+    return server
+
+
+class TestDeadlineHeader:
+    CFG = AdmissionConfig()
+
+    def test_no_headers_no_default_means_no_deadline(self):
+        assert deadline_from_headers(None, self.CFG) is None
+        assert deadline_from_headers({}, self.CFG) is None
+
+    def test_header_becomes_absolute_monotonic_deadline(self):
+        before = time.monotonic()
+        d = deadline_from_headers({DEADLINE_HEADER: "1000"}, self.CFG)
+        after = time.monotonic()
+        assert before + 0.9 < d < after + 1.1
+
+    def test_unparseable_header_is_ignored_not_rejected(self):
+        assert deadline_from_headers({DEADLINE_HEADER: "soon"},
+                                     self.CFG) is None
+
+    def test_nonpositive_means_no_deadline(self):
+        assert deadline_from_headers({DEADLINE_HEADER: "0"}, self.CFG) is None
+        assert deadline_from_headers({DEADLINE_HEADER: "-5"}, self.CFG) is None
+
+    def test_default_applies_when_header_absent(self):
+        cfg = AdmissionConfig(default_deadline_ms=50.0)
+        d = deadline_from_headers({}, cfg)
+        assert d is not None and d - time.monotonic() < 0.06
+
+    def test_clamped_to_max_deadline(self):
+        cfg = AdmissionConfig(max_deadline_ms=100.0)
+        d = deadline_from_headers({DEADLINE_HEADER: "3600000"}, cfg)
+        assert d - time.monotonic() <= 0.11
+
+
+class TestAdmissionController:
+    def test_budget_bounds_concurrent_admissions(self):
+        c = AdmissionController(AdmissionConfig(max_queue=2,
+                                                retry_after_s=0.5))
+        c.admit()
+        c.admit()
+        with pytest.raises(ShedLoad) as ei:
+            c.admit()
+        assert ei.value.retry_after_s == 0.5
+        c.release()
+        c.admit()  # slot freed → admitted again
+        assert c.admitted == 2
+
+    def test_expired_deadline_rejected_at_the_door(self):
+        c = AdmissionController(AdmissionConfig(max_queue=4))
+        with pytest.raises(DeadlineExceeded):
+            c.admit(deadline=time.monotonic() - 0.01)
+        assert c.admitted == 0  # no slot leaked
+
+
+class TestServingConfigFromEnv:
+    def test_defaults_without_env(self, monkeypatch):
+        for k in ("PIO_SERVING_BATCHING", "PIO_SERVING_MAX_BATCH",
+                  "PIO_SERVING_MAX_WAIT_MS", "PIO_SERVING_MAX_QUEUE",
+                  "PIO_SERVING_DEFAULT_DEADLINE_MS",
+                  "PIO_SERVING_RETRY_AFTER_S"):
+            monkeypatch.delenv(k, raising=False)
+        cfg = ServingConfig.from_env()
+        assert cfg.batching is True
+        assert cfg.batcher.max_batch == 32
+        assert cfg.admission.max_queue == 256
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVING_BATCHING", "off")
+        monkeypatch.setenv("PIO_SERVING_MAX_BATCH", "8")
+        monkeypatch.setenv("PIO_SERVING_MAX_WAIT_MS", "2.5")
+        monkeypatch.setenv("PIO_SERVING_MAX_QUEUE", "16")
+        monkeypatch.setenv("PIO_SERVING_DEFAULT_DEADLINE_MS", "250")
+        monkeypatch.setenv("PIO_SERVING_RETRY_AFTER_S", "3")
+        cfg = ServingConfig.from_env()
+        assert cfg.batching is False
+        assert cfg.batcher.max_batch == 8
+        assert cfg.batcher.max_wait_ms == 2.5
+        assert cfg.admission.max_queue == 16
+        assert cfg.admission.default_deadline_ms == 250.0
+        assert cfg.admission.retry_after_s == 3.0
+
+    def test_unparseable_env_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("PIO_SERVING_MAX_QUEUE", "lots")
+        assert ServingConfig.from_env().admission.max_queue == 256
+
+
+class TestSaturationDrill:
+    """ISSUE acceptance: a saturated server returns explicit 429/503 —
+    no hangs, no 5xx storms — and the shed shows up on /metrics."""
+
+    def test_zero_budget_sheds_429_with_retry_after(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(
+            memory_storage, variant_dict(), "rec-test",
+            ServingConfig(admission=AdmissionConfig(max_queue=0,
+                                                    retry_after_s=2.0)))
+        try:
+            status, body, headers = call_raw(
+                server.port, "POST", "/queries.json", {"user": "u0", "num": 3})
+            # the als-only engine has no degraded-capable algorithm, so a
+            # shed is answered as an honest 429
+            assert status == 429
+            assert headers.get("Retry-After") == "2"
+            assert "saturated" in body["message"]
+        finally:
+            server.shutdown()
+
+    def test_expired_deadline_answers_503(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(memory_storage, variant_dict(), "rec-test",
+                        ServingConfig())
+        try:
+            status, _, headers = call_raw(
+                server.port, "POST", "/queries.json", {"user": "u0", "num": 3},
+                headers={DEADLINE_HEADER: "0.0001"})
+            assert status == 503
+            assert float(headers.get("Retry-After")) > 0
+        finally:
+            server.shutdown()
+
+    def test_burst_on_tiny_budget_never_hangs_or_500s(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(
+            memory_storage, variant_dict(), "rec-test",
+            ServingConfig(admission=AdmissionConfig(max_queue=1)))
+        statuses = []
+        lock = threading.Lock()
+
+        def client(i):
+            # a mix of deadline-carrying and plain requests
+            hdrs = ({DEADLINE_HEADER: "5000"} if i % 2 else None)
+            for _ in range(4):
+                s, _, _ = call_raw(server.port, "POST", "/queries.json",
+                                   {"user": f"u{i % 12}", "num": 3},
+                                   headers=hdrs)
+                with lock:
+                    statuses.append(s)
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads), "client hung"
+        finally:
+            server.shutdown()
+        assert len(statuses) == 48
+        assert set(statuses) <= {200, 429, 503}, sorted(set(statuses))
+        assert 200 in statuses  # the admitted fraction was actually served
+
+    def test_shed_and_deadline_metrics_exposed(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(
+            memory_storage, variant_dict(), "rec-test",
+            ServingConfig(admission=AdmissionConfig(max_queue=0)))
+        try:
+            call_raw(server.port, "POST", "/queries.json",
+                     {"user": "u0", "num": 3})
+            status, _, _ = call_raw(server.port, "GET", "/")
+            assert status == 200
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                text = resp.read().decode()
+        finally:
+            server.shutdown()
+        for family in ("serving_shed_total", "serving_deadline_misses_total",
+                       "serving_admitted_in_flight", "serving_batch_size",
+                       "serving_queue_depth", "serving_queue_wait_seconds",
+                       "serving_batches_total", "serving_padded_rows_total",
+                       "serving_degraded_total"):
+            assert f"# TYPE {family} " in text, family
+        assert 'serving_shed_total{reason="queue_full"}' in text
+
+
+class TestDegradedMode:
+    def test_shed_degrades_to_popularity_with_header(self, memory_storage):
+        """With the weighted als+popular engine, a shed request is
+        answered by the popularity model (no per-user work) with 200 +
+        X-PIO-Degraded: 1 instead of a 429."""
+        ingest_ratings(memory_storage)
+        server = deploy(
+            memory_storage, multi_algo_variant(), "rec-multi",
+            ServingConfig(admission=AdmissionConfig(max_queue=0)))
+        try:
+            status, body, headers = call_raw(
+                server.port, "POST", "/queries.json", {"user": "u0", "num": 3})
+            assert status == 200
+            assert headers.get("X-PIO-Degraded") == "1"
+            assert body["itemScores"]  # popularity still ranks items
+        finally:
+            server.shutdown()
+
+    def test_normal_requests_are_not_degraded(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(memory_storage, multi_algo_variant(), "rec-multi",
+                        ServingConfig())
+        try:
+            status, body, headers = call_raw(
+                server.port, "POST", "/queries.json", {"user": "u0", "num": 3})
+            assert status == 200
+            assert headers.get("X-PIO-Degraded") is None
+            assert body["itemScores"]
+        finally:
+            server.shutdown()
