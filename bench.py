@@ -660,6 +660,190 @@ def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
     return record
 
 
+R05_INGEST_SINGLE_EPS = 1743.7  # single-event events/s @8 clients (r05)
+R05_INGEST_P95_32_MS = 96.23    # single-event p95 @32 clients (r05)
+R05_INGEST_BATCH_EPS = 9497.7   # batch-endpoint events/s @8 clients (r05)
+
+
+def bench_ingest_qps(emit: bool = True, clients: int = 8,
+                     duration_s: float = 5.0, batch_size: int = 50):
+    """ingest_qps ladder point (round 7): A/B of the group-commit write
+    plane against per-request commits on the SAME sqlite backend,
+    through the real event server. Four movements:
+
+    1. throughput — N keep-alive clients POSTing one durable event each
+       against grouping=off, then grouping=on; the speedup is the
+       record's vs_baseline (acceptance: on ≥ 1.8× the r05 single-event
+       rate);
+    2. tail — 32 clients with the plane on; p95 must land under the
+       r05 per-request-commit p95 (group commit shortens the fsync
+       convoy, it must not stretch it);
+    3. batch guard — `/batch/events.json` measured in both modes; the
+       plane must not tax the already-batched path;
+    4. saturation drill — a burst against a 2-slot admission budget over
+       an artificially slow storage layer must answer ONLY 201/429 (429s
+       carrying Retry-After) and ingest_shed_total must show the sheds.
+
+    Run with `bench.py --ingest-qps`; also carried in the default
+    north-star metrics block. Each rep gets a fresh sqlite file so WAL
+    growth in one window cannot bias the mode measured after it."""
+    import http.client
+    import tempfile as _tf
+    import threading
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.ingest import IngestConfig
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.telemetry.registry import parse_prometheus
+
+    key = "bench-ingest-key"
+
+    def serve(ingest_config):
+        tmp = _tf.mkdtemp(prefix="pio_ingest_qps_")
+        src = _make_source("sqlite", tmp)
+        storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                        eventdata=src))
+        app_id = storage.meta_apps().insert(App(id=0, name="IngestApp"))
+        storage.meta_access_keys().insert(
+            AccessKey(key=key, app_id=app_id, events=[]))
+        server = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                             storage, ingest_config=ingest_config)
+        server.start()
+        return server, storage
+
+    def one_event(i):
+        return {"event": "rate", "entityType": "user",
+                "entityId": str(i % 997),
+                "targetEntityType": "item", "targetEntityId": str(i % 101),
+                "properties": {"rating": float(i % 5 + 1)}}
+
+    single_payload = lambda i: json.dumps(one_event(i)).encode()  # noqa: E731
+    batch_payload = lambda i: json.dumps(  # noqa: E731
+        [one_event(i * batch_size + j) for j in range(batch_size)]).encode()
+    single_path = f"/events.json?accessKey={key}"
+    batch_path = f"/batch/events.json?accessKey={key}"
+
+    def measure(ingest_config, path, payload_of, n, ok, secs):
+        server, storage = serve(ingest_config)
+        try:
+            qps, p50, p95, nreq = _run_http_load(
+                server.port, path, payload_of, n, secs, ok_status=ok)
+        finally:
+            server.shutdown()
+            storage.close()
+        return qps, p50, p95, nreq
+
+    # interleaved best-of-3 A/B, same rationale as bench_serving_qps:
+    # the bench box is a shared core, so keep each mode's best window
+    modes: dict = {}
+    batch_modes: dict = {}
+    for _rep in range(3):
+        for mode, grouping in (("off", False), ("on", True)):
+            cfg = IngestConfig(grouping=grouping)
+            qps, p50, p95, n = measure(cfg, single_path, single_payload,
+                                       clients, (201,), duration_s)
+            rec = {"events_per_s": round(qps, 1),
+                   "p50_ms": round(p50 * 1e3, 2),
+                   "p95_ms": round(p95 * 1e3, 2), "n_requests": n}
+            if (mode not in modes
+                    or rec["events_per_s"] > modes[mode]["events_per_s"]):
+                modes[mode] = rec
+            bqps, _bp50, bp95, _bn = measure(
+                cfg, batch_path, batch_payload, clients, (200,),
+                duration_s / 2)
+            brec = {"events_per_s": round(bqps * batch_size, 1),
+                    "p95_ms": round(bp95 * 1e3, 2)}
+            if (mode not in batch_modes
+                    or brec["events_per_s"]
+                    > batch_modes[mode]["events_per_s"]):
+                batch_modes[mode] = brec
+    speedup = (modes["on"]["events_per_s"]
+               / max(modes["off"]["events_per_s"], 1e-9))
+
+    # tail: 32 clients, plane on — the grouped fsync must shorten the
+    # commit convoy relative to r05's one-commit-per-request tail
+    _q32, _p50_32, p95_32, _n32 = measure(
+        IngestConfig(), single_path, single_payload, 32, (201,), duration_s)
+
+    # saturation drill: 2 admission slots over a slowed storage layer —
+    # tally what the overloaded server answered
+    server, storage = serve(IngestConfig(max_queue=2, retry_after_s=0.5))
+    real_insert = server.ingest.insert_fn
+    real_grouped = server.ingest.grouped_fn
+    server.ingest.insert_fn = lambda e, a, c=None: (
+        time.sleep(0.02), real_insert(e, a, c))[1]
+    server.ingest.grouped_fn = lambda items: (
+        time.sleep(0.02), real_grouped(items))[1]
+    tally: dict = {}
+    tally_lock = threading.Lock()
+    try:
+        def burst(i):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            for j in range(8):
+                conn.request("POST", single_path,
+                             single_payload(i * 100 + j),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                with tally_lock:
+                    tally[r.status] = tally.get(r.status, 0) + 1
+            conn.close()
+
+        threads = [threading.Thread(target=burst, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        if any(t.is_alive() for t in threads):
+            raise SystemExit("ingest_qps: saturation drill client hung")
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("GET", "/metrics")
+        metrics = parse_prometheus(conn.getresponse().read().decode())
+        conn.close()
+    finally:
+        server.shutdown()
+        storage.close()
+    bad = set(tally) - {201, 429}
+    if bad:
+        raise SystemExit(f"ingest_qps: saturation drill answered "
+                         f"unexpected statuses {sorted(bad)} ({tally})")
+    shed = sum(metrics.get("ingest_shed_total", {}).values())
+    if tally.get(429) and not shed:
+        raise SystemExit("ingest_qps: 429s answered but ingest_shed_total "
+                         "is zero")
+
+    record = {
+        "metric": "ingest_qps",
+        "value": modes["on"]["events_per_s"],
+        "unit": "events/s",
+        "concurrency": clients,
+        "grouping": modes,
+        "p95_ms_at_32": round(p95_32 * 1e3, 2),
+        "batch_endpoint": batch_modes,
+        "saturation": {"statuses": {str(k): v for k, v in
+                                    sorted(tally.items())},
+                       "shed_total": shed},
+        # in-run comparison: the plane's win over per-request commits on
+        # the same backend, same loader, same box window
+        "vs_baseline": round(speedup, 2),
+        # acceptance bars (ISSUE r7) against BENCH_r05.json
+        "r05_single_eps": R05_INGEST_SINGLE_EPS,
+        "vs_r05": round(modes["on"]["events_per_s"]
+                        / R05_INGEST_SINGLE_EPS, 2),
+        "r05_p95_32_ms": R05_INGEST_P95_32_MS,
+        "r05_batch_eps": R05_INGEST_BATCH_EPS,
+    }
+    if emit:
+        print(json.dumps(record))
+    return record
+
+
 def bench_batch_predict(n_queries: int = 8192, emit: bool = True):
     """Bulk scoring throughput at the ML-20M MODEL scale (138k users ×
     26.7k items, rank 64) through the real `pio batchpredict` workflow:
@@ -1013,6 +1197,10 @@ def bench_north_star(scale: str = "20m", full: bool = True):
         guarded("ingest", with_mini_ladder(project(
             lambda: bench_ingest(emit=False),
             ("value", "single", "batch", "concurrency"))))
+        guarded("ingest_qps", project(
+            lambda: bench_ingest_qps(emit=False),
+            ("value", "grouping", "p95_ms_at_32", "batch_endpoint",
+             "saturation", "vs_baseline")))
         record["metrics"] = metrics
     print(json.dumps(record))
 
@@ -1429,6 +1617,11 @@ if __name__ == "__main__":
     ap.add_argument("--ingest", action="store_true",
                     help="concurrent event-server ingest events/s "
                          "(single + batch POSTs)")
+    ap.add_argument("--ingest-qps", action="store_true",
+                    help="group-commit write-plane A/B (grouping on vs "
+                         "off on the same sqlite backend) with 32-client "
+                         "tail, batch-endpoint guard and admission "
+                         "saturation drill")
     ap.add_argument("--batchpredict", action="store_true",
                     help="bulk scoring qps at ML-20M model scale through "
                          "pio batchpredict (device top-k branch)")
@@ -1473,6 +1666,8 @@ if __name__ == "__main__":
         bench_serving_qps(clients=CLIENT_LADDER[-1])
     elif args.ingest:
         bench_ingest(args.storage or "sqlite")
+    elif args.ingest_qps:
+        bench_ingest_qps(clients=CLIENT_LADDER[-1])
     elif args.batchpredict:
         bench_batch_predict()
     elif args.quickstart:

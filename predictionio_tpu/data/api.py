@@ -17,6 +17,14 @@ key's app and optional event-name whitelist, exactly like the reference.
 The reference runs this on Akka + spray-can; a threaded stdlib HTTP server
 is the idiomatic zero-dependency Python equivalent — the TPU is never on
 this path, so throughput is bounded by SQLite writes, not the server.
+
+Single-event writes (`POST /events.json` and the webhook connectors) go
+through the ingest write plane (predictionio_tpu/ingest): concurrent
+inserts coalesce into one shared durable transaction (group commit), the
+201 is sent only after that commit, and past the bounded in-flight
+budget the server answers 429 + Retry-After instead of queueing into
+collapse. `POST /batch/events.json` already commits its chunk as one
+transaction and stays on its direct path.
 """
 
 from __future__ import annotations
@@ -37,6 +45,7 @@ from predictionio_tpu.data.events import (
     validate_event,
 )
 from predictionio_tpu.data.webhooks import get_connector
+from predictionio_tpu.ingest import GroupCommitWriter, IngestConfig, IngestOverload
 from predictionio_tpu.plugins import PluginRejection
 from predictionio_tpu.storage.registry import Storage
 
@@ -92,6 +101,15 @@ class EventServerConfig:
         self.stats = stats
 
 
+# positive access-key lookups are cached this long: the key row is read
+# on EVERY request, and under write load that SELECT costs as much GIL
+# time as the shared group commit itself (round-7 stack sampling). A
+# revoked or narrowed key therefore keeps working for up to this window
+# on a long-lived server — deletions are rare admin actions, ingest auth
+# is per-request hot path.
+_AKEY_CACHE_TTL_S = 5.0
+
+
 class _EventHandler(JsonRequestHandler):
     server_version = "pio-tpu-eventserver/0.1"
 
@@ -99,6 +117,8 @@ class _EventHandler(JsonRequestHandler):
     storage: Storage
     stats: Optional[Stats]
     plugins = None  # Optional[PluginRegistry]
+    ingest: GroupCommitWriter
+    akey_cache: dict
 
     # -- helpers -----------------------------------------------------------
     _send_json = JsonRequestHandler.send_json
@@ -121,7 +141,17 @@ class _EventHandler(JsonRequestHandler):
                     key = None
         if not key:
             return None
-        access_key = self.storage.meta_access_keys().get(key)
+        now = time.monotonic()
+        cached = self.akey_cache.get(key)
+        if cached is not None and cached[1] > now:
+            access_key = cached[0]
+        else:
+            access_key = self.storage.meta_access_keys().get(key)
+            if access_key is not None:
+                # plain dict mutation is atomic under the GIL; misses
+                # (bad keys) are NOT cached, so a flood of junk keys
+                # cannot grow this beyond the real key population
+                self.akey_cache[key] = (access_key, now + _AKEY_CACHE_TTL_S)
         if access_key is None:
             return None
         channel_id = None
@@ -160,7 +190,10 @@ class _EventHandler(JsonRequestHandler):
             event = self._validate_event(d, access_key, app_id, channel_id)
             le = self.storage.l_events()
             try:
-                eid = le.insert(event, app_id, channel_id)
+                # through the write plane: coalesced with concurrent
+                # inserts, durable before this returns, IngestOverload
+                # past the bounded budget (→ 429 at the route)
+                eid = self.ingest.submit(event, app_id, channel_id)
             except le.integrity_errors as e:
                 raise EventValidationError(
                     f"duplicate eventId {event.event_id!r}"
@@ -168,6 +201,15 @@ class _EventHandler(JsonRequestHandler):
         if self.stats:
             self.stats.update(app_id, event.event, 201)
         return eid
+
+    def _shed(self, app_id: int, e: IngestOverload):
+        """429 + Retry-After for a write-plane overload (same HTTP
+        mapping as the serving plane's ShedLoad)."""
+        if self.stats:
+            self.stats.update(app_id, "<shed>", 429)
+        return self._send_json(
+            429, {"message": str(e)},
+            headers={"Retry-After": f"{e.retry_after_s:g}"})
 
     # -- routes ------------------------------------------------------------
     def do_GET(self):
@@ -230,6 +272,8 @@ class _EventHandler(JsonRequestHandler):
             try:
                 d = json.loads(body or b"{}")
                 eid = self._insert_event(d, access_key, app_id, channel_id)
+            except IngestOverload as e:
+                return self._shed(app_id, e)
             except PluginRejection as e:
                 if self.stats:
                     self.stats.update(app_id, "<blocked>", 403)
@@ -320,6 +364,8 @@ class _EventHandler(JsonRequestHandler):
                     raise ValueError("webhook payload must be a JSON object")
                 event_dict = connector.to_event_dict(payload)
                 eid = self._insert_event(event_dict, access_key, app_id, channel_id)
+            except IngestOverload as e:
+                return self._shed(app_id, e)
             except PluginRejection as e:
                 if self.stats:
                     self.stats.update(app_id, "<blocked>", 403)
@@ -352,22 +398,38 @@ class EventServer(HttpService):
     factory spelling."""
 
     def __init__(self, config: EventServerConfig, storage: Optional[Storage] = None,
-                 plugins=None):
+                 plugins=None, ingest_config: Optional[IngestConfig] = None):
         from predictionio_tpu.plugins import load_plugins_from_env
 
         self.config = config
         self.storage = storage or Storage.get()
         self.stats = Stats() if config.stats else None
         self.plugins = plugins if plugins is not None else load_plugins_from_env()
+        # one write plane per server: every handler thread's single-event
+        # insert funnels into it (repos are stateless wrappers over the
+        # backend, so binding the two entry points once is safe)
+        le = self.storage.l_events()
+        self.ingest = GroupCommitWriter(
+            insert_fn=le.insert,
+            grouped_fn=le.insert_grouped,
+            config=ingest_config or IngestConfig.from_env(),
+            name="eventserver")
 
         handler = type(
             "BoundEventHandler",
             (_EventHandler,),
             {"storage": self.storage, "stats": self.stats,
-             "plugins": self.plugins},
+             "plugins": self.plugins, "ingest": self.ingest,
+             "akey_cache": {}},
         )
         super().__init__(config.ip, config.port, handler,
                          server_name="eventserver")
+
+    def shutdown(self) -> None:
+        # stop accepting first, then drain the write plane: in-flight
+        # handlers finish their submits before the committer joins
+        super().shutdown()
+        self.ingest.close()
 
 
 def create_event_server(

@@ -1,0 +1,19 @@
+"""Ingest write plane: group commit for the event server's front door.
+
+The read path got its coalescing in round 6 (predictionio_tpu/serving —
+admission + micro-batching); this package is the symmetric write-side
+subsystem. `GroupCommitWriter` sits between the event server's HTTP
+handlers and the `LEvents` storage backends, coalescing concurrent
+single-event inserts into one shared durable transaction and applying
+bounded-queue backpressure (429 + Retry-After) past a configurable
+budget. See writer.py for the mechanism and docs/performance.md for the
+measured effect.
+"""
+
+from predictionio_tpu.ingest.writer import (  # noqa: F401
+    GroupCommitWriter,
+    IngestConfig,
+    IngestOverload,
+)
+
+__all__ = ["GroupCommitWriter", "IngestConfig", "IngestOverload"]

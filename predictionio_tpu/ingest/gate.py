@@ -1,0 +1,260 @@
+"""Ingest gate — CI check that no event-server write route bypasses the
+write plane.
+
+Run via `python quality.py --ingest-gate`. Mirrors the serving gate's
+two layers:
+
+1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
+   any `do_*` HTTP handler that routes single-event `POST /events.json`
+   (and the `/webhooks/` connectors) must funnel through
+   `_insert_event`, and `_insert_event` itself must call the write
+   plane's `submit` — never a bare storage `insert` — because a direct
+   insert has no coalescing, no durable-before-201 ordering from the
+   shared commit, and no shed path. (`/batch/events.json`'s handler is
+   allowed its direct `insert_batch`/`insert` calls: the chunk already
+   commits as one transaction, and its per-row integrity fallback is the
+   documented exception.)
+
+2. Runtime check: a real EventServer on memory storage with a tiny
+   in-flight budget and an artificially slow storage layer must, under a
+   concurrent burst, answer ONLY 201/429 — 429s carrying a positive
+   Retry-After — and every 201-acknowledged event id must be readable
+   back immediately (no ack without a committed row). The ingest_*
+   telemetry families must render on the registry.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXEMPT = {
+    os.path.join("ingest", "gate.py"),
+}
+
+_EVENTS_ROUTE = "/events.json"
+_BATCH_ROUTE = "/batch/events.json"
+# the write-plane entry points a single-event POST handler must reach
+_PLANE_ENTRIES = {"submit", "_insert_event"}
+
+
+def _routes_single_events(fn: ast.AST) -> bool:
+    """True when fn routes single-event POSTs: contains the /events.json
+    constant (the batch route is a distinct constant and may also be
+    present in the same do_POST — that's fine, we check the single-event
+    funnel, not the batch path)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and node.value == _EVENTS_ROUTE:
+            return True
+    return False
+
+
+def _attr_calls(fn: ast.AST) -> set:
+    calls = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            calls.add(node.func.attr)
+    return calls
+
+
+def _scan_file(path: str, rel: str) -> tuple[list[str], bool, bool]:
+    """Returns (problems, saw_single_event_route, saw_insert_event_fn)."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            return [f"{rel}: unparseable ({e})"], False, False
+    problems = []
+    saw_route = False
+    saw_funnel = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        # write handlers only: GET /events.json is the read/find route
+        # and legitimately never touches the write plane
+        if node.name in ("do_POST", "do_PUT") and _routes_single_events(node):
+            saw_route = True
+            if not (_PLANE_ENTRIES & _attr_calls(node)):
+                problems.append(
+                    f"{rel}:{node.lineno}: {node.name} routes "
+                    f"{_EVENTS_ROUTE} without dispatching through the "
+                    f"ingest write plane (_insert_event/submit) — "
+                    f"single-event writes must get group commit and "
+                    f"backpressure")
+        if node.name == "_insert_event":
+            saw_funnel = True
+            calls = _attr_calls(node)
+            if "submit" not in calls:
+                problems.append(
+                    f"{rel}:{node.lineno}: _insert_event does not call "
+                    f"the write plane's submit() — the 201 would not be "
+                    f"group-committed or admission-bounded")
+            if "insert" in calls:
+                problems.append(
+                    f"{rel}:{node.lineno}: _insert_event calls a bare "
+                    f"storage insert() — durable writes belong behind "
+                    f"GroupCommitWriter.submit (coalescing, shed path)")
+    return problems, saw_route, saw_funnel
+
+
+def _static_scan() -> list[str]:
+    problems = []
+    found_route = False
+    found_funnel = False
+    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _PKG_DIR)
+            if rel in _EXEMPT:
+                continue
+            file_problems, saw_route, saw_funnel = _scan_file(path, rel)
+            problems.extend(file_problems)
+            found_route = found_route or saw_route
+            found_funnel = found_funnel or saw_funnel
+    if not found_route:
+        # the gate must notice if the ingest route itself disappears —
+        # an empty scan proves nothing
+        problems.append(
+            f"static: no in-package handler routes {_EVENTS_ROUTE}; "
+            f"the ingest gate has nothing to hold")
+    if found_route and not found_funnel:
+        problems.append(
+            "static: no in-package _insert_event funnel found; the "
+            "single-event write path is unverifiable")
+    return problems
+
+
+def _runtime_check() -> list[str]:
+    import http.client
+    import json
+    import threading
+    import time
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.ingest import IngestConfig
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.telemetry.registry import REGISTRY
+
+    problems = []
+    src = SourceConfig(name="INGESTGATE", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    app_id = storage.meta_apps().insert(App(id=0, name="IngestGateApp"))
+    key = "ingest-gate-key"
+    storage.meta_access_keys().insert(
+        AccessKey(key=key, app_id=app_id, events=[]))
+    server = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0), storage=storage,
+        ingest_config=IngestConfig(max_queue=2, retry_after_s=0.5))
+    # slow the storage layer down so the 2-slot budget saturates under
+    # the burst (the plane's fns are plain attributes for exactly this)
+    real_insert = server.ingest.insert_fn
+    real_grouped = server.ingest.grouped_fn
+
+    def slow_insert(event, app_id, channel_id=None):
+        time.sleep(0.03)
+        return real_insert(event, app_id, channel_id)
+
+    def slow_grouped(items):
+        time.sleep(0.03)
+        return real_grouped(items)
+
+    server.ingest.insert_fn = slow_insert
+    server.ingest.grouped_fn = slow_grouped
+    server.start()
+
+    tally: dict = {}
+    acked: list[str] = []
+    shed_missing_retry_after = []
+    lock = threading.Lock()
+    payload = json.dumps({"event": "rate", "entityType": "user",
+                          "entityId": "u1", "targetEntityType": "item",
+                          "targetEntityId": "i1"}).encode()
+
+    def burst():
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        for _ in range(4):
+            conn.request("POST", f"/events.json?accessKey={key}", payload,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = r.read()
+            with lock:
+                tally[r.status] = tally.get(r.status, 0) + 1
+                if r.status == 201:
+                    acked.append(json.loads(body)["eventId"])
+                elif r.status == 429 and not r.getheader("Retry-After"):
+                    shed_missing_retry_after.append(True)
+        conn.close()
+
+    try:
+        threads = [threading.Thread(target=burst) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if any(t.is_alive() for t in threads):
+            problems.append("runtime: saturation burst client hung")
+        bad = set(tally) - {200, 201, 429}
+        if bad:
+            problems.append(
+                f"runtime: overloaded event server answered statuses "
+                f"{sorted(bad)} (want only 200/201/429; tally {tally})")
+        if not tally.get(201):
+            problems.append("runtime: burst produced no 201s at all")
+        if not tally.get(429):
+            problems.append(
+                f"runtime: 2-slot budget never shed under a 12-client "
+                f"burst (tally {tally})")
+        if shed_missing_retry_after:
+            problems.append(
+                f"runtime: {len(shed_missing_retry_after)} 429 "
+                f"response(s) carried no Retry-After header")
+        # durability/read-your-writes: every acknowledged id must be a
+        # committed row the moment the 201 arrived
+        le = storage.l_events()
+        missing = [eid for eid in acked
+                   if le.get(eid, app_id) is None]
+        if missing:
+            problems.append(
+                f"runtime: {len(missing)} event id(s) were 201-"
+                f"acknowledged but are not readable back "
+                f"(e.g. {missing[0]!r})")
+    finally:
+        server.shutdown()
+        storage.close()
+    text = REGISTRY.render()
+    for family in ("ingest_group_size", "ingest_fill_wait_seconds",
+                   "ingest_commit_seconds", "ingest_commits_total",
+                   "ingest_shed_total", "ingest_fallbacks_total",
+                   "ingest_in_flight", "ingest_queue_depth"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"runtime: /metrics is missing {family}")
+    return problems
+
+
+def run_gate() -> int:
+    problems = _static_scan()
+    try:
+        problems += _runtime_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"runtime check crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"ingest gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

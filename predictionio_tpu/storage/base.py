@@ -227,6 +227,21 @@ class LEvents(abc.ABC):
         this file/chunk after fixing the cause"."""
         return [self.insert(e, app_id, channel_id) for e in events]
 
+    def insert_grouped(
+        self, items: "list[tuple[Event, int, Optional[int]]]",
+    ) -> list[str]:
+        """Group-commit insert: heterogeneous (event, app_id, channel_id)
+        rows — coalesced from CONCURRENT single-event requests by the
+        ingest write plane (predictionio_tpu/ingest) — made durable
+        together. Backends override with one shared transaction so N
+        front-door inserts pay one fsync; this default loops `insert`
+        (commits per item, no atomicity) so every backend stays correct.
+
+        The write plane acknowledges each caller's 201 only after this
+        returns, so an override MUST NOT return before its transaction
+        is committed."""
+        return [self.insert(e, a, c) for e, a, c in items]
+
     @abc.abstractmethod
     def get(
         self, event_id: str, app_id: int, channel_id: Optional[int] = None

@@ -93,6 +93,17 @@ def translate_sql(sql: str) -> str:
 # INSERTs whose callers read cur.lastrowid (serial-id tables)
 _SERIAL_INSERT = re.compile(r"^\s*INSERT INTO (apps|channels)\b", re.IGNORECASE)
 
+# plain single-tuple INSERTs (translated dialect, so %s placeholders) that
+# executemany can rewrite into one multi-row VALUES statement
+_MULTIROW_INSERT = re.compile(
+    r"^\s*(INSERT INTO \w+\s+(?:\([^)]*\)\s+)?VALUES)\s*"
+    r"(\(\s*%s\s*(?:,\s*%s\s*)*\))\s*;?\s*$",
+    re.IGNORECASE)
+# rows per rewritten statement: 13 event columns × 500 rows = 6 500 bound
+# parameters, comfortably under every driver's ceiling (pg8000 numbers
+# parameters and caps at 65 535; psycopg2 interpolates client-side)
+_MULTIROW_CHUNK = 500
+
 
 class _Row:
     """Name-addressable row (sqlite3.Row equivalent) over a DB-API tuple."""
@@ -136,9 +147,25 @@ class _PGCursor:
         self._pending_id = None
         sql = translate_sql(sql)
         rows = [tuple(p) for p in seq_of_params]
+        m = _MULTIROW_INSERT.match(sql)
+        if m and rows:
+            # one multi-row `INSERT ... VALUES (...),(...),...` per chunk:
+            # a single server round trip for the whole group, which is
+            # what makes the write plane's grouped commits one-trip on
+            # Postgres too. The previous psycopg2 execute_batch pages
+            # were still one statement per row server-side, and pg8000's
+            # plain executemany was a full round trip per row.
+            head, tmpl = m.group(1), m.group(2)
+            for i in range(0, len(rows), _MULTIROW_CHUNK):
+                chunk = rows[i:i + _MULTIROW_CHUNK]
+                stmt = head + " " + ",".join([tmpl] * len(chunk))
+                self._cur.execute(stmt,
+                                  tuple(v for row in chunk for v in row))
+            return self
         if self._driver_name == "psycopg2":
-            # psycopg2's executemany is a per-row round-trip loop;
-            # execute_batch collapses it into multi-statement pages
+            # non-VALUES shapes (none in the tree today): psycopg2's
+            # executemany is a per-row round-trip loop; execute_batch
+            # collapses it into multi-statement pages
             from psycopg2.extras import execute_batch  # type: ignore
 
             execute_batch(self._cur, sql, rows)

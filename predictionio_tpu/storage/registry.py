@@ -39,7 +39,7 @@ class _TimedRepo:
     `integrity_errors`, used in `except` clauses) delegate untouched."""
 
     _TIMED_OPS = frozenset({
-        "insert", "insert_batch", "get", "find", "delete",
+        "insert", "insert_batch", "insert_grouped", "get", "find", "delete",
         "find_columnar", "aggregate_properties_columnar",
         "get_latest_completed", "get_completed", "get_all", "update",
     })

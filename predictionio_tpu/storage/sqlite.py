@@ -136,6 +136,20 @@ class SQLiteBackend(base.StorageBackend):
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA journal_mode=WAL")
         conn.execute("PRAGMA synchronous=NORMAL")
+        # Write-plane PRAGMA audit (round 7, 32-thread single-event
+        # writer drill at ~11k events/s): busy_timeout mirrors the
+        # connect(timeout=30) handler at the database level so ad-hoc
+        # connections (native readers, sqlite3 CLI) inherit the same
+        # patience instead of instant SQLITE_BUSY; throughput delta vs
+        # no busy_timeout was within run noise (the group-commit plane
+        # already serializes writers upstream). wal_autocheckpoint=4000
+        # measured +5-15% on that drill across 3 reps (checkpoint work
+        # leaves the commit path 4× less often) for a worst-case -wal of
+        # 16 MB instead of 4 MB; through the HTTP stack the effect is
+        # smaller because the server is handler-bound, but the drill-
+        # level win and bounded cost make it the default here.
+        conn.execute("PRAGMA busy_timeout=30000")
+        conn.execute("PRAGMA wal_autocheckpoint=4000")
         with self._conns_lock:
             # reap dead threads' connections HERE, where new ones are
             # born: per-thread conns live in threading.local, but
@@ -700,6 +714,22 @@ class SQLiteLEvents(base.LEvents):
         with self._b._cursor() as cur:
             cur.executemany(self._INSERT_SQL, rows)
             faults.inject("events.batch.pre_commit")
+        return [r[0] for r in rows]
+
+    def insert_grouped(
+        self, items: "list[tuple[Event, int, Optional[int]]]",
+    ) -> list[str]:
+        """Group commit for the ingest write plane: heterogeneous
+        (event, app_id, channel_id) rows from concurrent single-event
+        requests land under ONE transaction — one WAL append + fsync for
+        the whole group instead of one per request. Returning implies
+        durability (the `_Cursor` context commits before this returns),
+        which is what lets the write plane acknowledge every caller's
+        201 at once."""
+        rows = [self._row_of(e, a, c) for e, a, c in items]
+        with self._b._cursor() as cur:
+            cur.executemany(self._INSERT_SQL, rows)
+            faults.inject("events.group.pre_commit")
         return [r[0] for r in rows]
 
     @staticmethod

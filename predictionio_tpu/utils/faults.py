@@ -20,6 +20,10 @@ Sites in the tree:
   written, before the atomic `os.replace` publishes it
 - `events.batch.pre_commit` — after a batch insert's `executemany`,
   before the transaction commits
+- `events.group.pre_commit` — after a group-commit insert's
+  `executemany` (the ingest write plane's coalesced single-event
+  requests), before the shared transaction commits: proves no caller is
+  ever 201-acknowledged for a row that did not commit
 - `als.epoch_boundary` — between a training chunk's execution fence and
   its checkpoint save; armed per-rank it kills one member of a
   multi-process world at the worst moment (the elastic-recovery drill,

@@ -36,6 +36,15 @@ admits up to N × PIO_SERVING_MAX_QUEUE requests, and batches form from
 the concurrency the kernel routes to each listener. SIGTERM drains
 gracefully: the worker's shutdown finishes in-flight handlers (queued
 queries still dispatch) before the batcher thread is joined.
+
+Ingest is NOT pooled: the event server stays a single threaded process.
+Its write plane (predictionio_tpu/ingest, PIO_INGEST_* environment)
+coalesces concurrent durable inserts into shared group commits, and on
+the default SQLite backend there is exactly one WAL writer — forking N
+event servers would multiply admission budgets without multiplying
+commit capacity, turning the group-commit win back into N processes
+contending for the same write lock. Scale reads with the pool; scale
+writes with the write plane's group size.
 """
 
 from __future__ import annotations

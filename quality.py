@@ -27,6 +27,11 @@ def main():
                    help="run the serving CI gate (no jax, no data): fails "
                         "if any predict route bypasses admission control / "
                         "the serving plane")
+    p.add_argument("--ingest-gate", action="store_true",
+                   help="run the ingest CI gate (no jax, no data): fails "
+                        "if any event-server write route bypasses the "
+                        "group-commit write plane, or if an overloaded "
+                        "server answers anything but 200/201/429")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -50,6 +55,11 @@ def main():
 
     if args.serving_gate:
         from predictionio_tpu.serving.gate import run_gate
+
+        return run_gate()
+
+    if args.ingest_gate:
+        from predictionio_tpu.ingest.gate import run_gate
 
         return run_gate()
 

@@ -1,0 +1,181 @@
+"""Event server ↔ ingest write plane integration (ISSUE r7): concurrent
+single-event POSTs coalesce through GroupCommitWriter, 201 means the row
+is already committed and readable, saturation answers 429 + Retry-After,
+webhooks ride the same plane, and the ingest_* families render."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.ingest import IngestConfig
+from predictionio_tpu.storage.base import AccessKey, App
+from predictionio_tpu.telemetry.registry import parse_prometheus
+
+
+def _serve(storage, ingest_config=None):
+    app_id = storage.meta_apps().insert(App(id=0, name="IngestApp"))
+    key = AccessKey.generate(app_id)
+    storage.meta_access_keys().insert(key)
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+                      storage, ingest_config=ingest_config)
+    srv.start()
+    return srv, key.key, app_id
+
+
+def call(srv, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), e.headers
+
+
+def _rate(i):
+    return {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+            "targetEntityType": "item", "targetEntityId": f"i{i}"}
+
+
+class TestIngestPlaneOverHttp:
+    def test_concurrent_201s_are_immediately_readable(self, memory_storage):
+        srv, key, _ = _serve(memory_storage)
+        failures = []
+
+        def client(base):
+            try:
+                for i in range(6):
+                    status, body, _ = call(
+                        srv, "POST", f"/events.json?accessKey={key}",
+                        _rate(base * 100 + i))
+                    if status != 201:
+                        failures.append(("status", status, body))
+                        continue
+                    # read-your-writes: the 201 promises a committed row
+                    st, got, _ = call(
+                        srv, "GET",
+                        f"/events/{body['eventId']}.json?accessKey={key}")
+                    if st != 200:
+                        failures.append(("readback", st, body["eventId"]))
+            except BaseException as e:  # noqa: BLE001
+                failures.append(("exc", e))
+
+        try:
+            threads = [threading.Thread(target=client, args=(b,))
+                       for b in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive()
+        finally:
+            srv.shutdown()
+        assert failures == []
+
+    def test_saturation_sheds_429_with_retry_after(self, memory_storage):
+        srv, key, _ = _serve(
+            memory_storage,
+            ingest_config=IngestConfig(max_queue=1, retry_after_s=0.5))
+        # slow the storage down so the 1-slot budget saturates; the
+        # plane's fns are plain attributes for exactly this kind of drill
+        real_insert = srv.ingest.insert_fn
+        real_grouped = srv.ingest.grouped_fn
+        srv.ingest.insert_fn = lambda e, a, c=None: (
+            time.sleep(0.02), real_insert(e, a, c))[1]
+        srv.ingest.grouped_fn = lambda items: (
+            time.sleep(0.02), real_grouped(items))[1]
+        tally = {}
+        retry_afters = []
+        lock = threading.Lock()
+
+        def client(base):
+            for i in range(4):
+                status, _, headers = call(
+                    srv, "POST", f"/events.json?accessKey={key}",
+                    _rate(base * 100 + i))
+                with lock:
+                    tally[status] = tally.get(status, 0) + 1
+                    if status == 429:
+                        retry_afters.append(headers.get("Retry-After"))
+
+        try:
+            threads = [threading.Thread(target=client, args=(b,))
+                       for b in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            srv.shutdown()
+        # graceful degradation: nothing but acks and sheds
+        assert set(tally) <= {201, 429}, tally
+        assert tally.get(201) and tally.get(429), tally
+        assert retry_afters and all(float(h) > 0 for h in retry_afters)
+
+    def test_webhook_rides_the_write_plane(self, memory_storage):
+        from predictionio_tpu.ingest.writer import COMMITS
+
+        srv, key, _ = _serve(memory_storage)
+        before = COMMITS.labels().value
+        try:
+            status, body, _ = call(
+                srv, "POST", f"/webhooks/segmentio.json?accessKey={key}",
+                {"type": "track", "event": "signup", "userId": "u9"})
+            assert status == 201
+            st, got, _ = call(
+                srv, "GET",
+                f"/events/{body['eventId']}.json?accessKey={key}")
+            assert st == 200
+        finally:
+            srv.shutdown()
+        assert COMMITS.labels().value == before + 1
+
+    def test_grouping_off_still_serves(self, memory_storage):
+        srv, key, _ = _serve(memory_storage,
+                             ingest_config=IngestConfig(grouping=False))
+        try:
+            status, body, _ = call(
+                srv, "POST", f"/events.json?accessKey={key}", _rate(1))
+            assert status == 201
+            st, _, _ = call(
+                srv, "GET",
+                f"/events/{body['eventId']}.json?accessKey={key}")
+            assert st == 200
+        finally:
+            srv.shutdown()
+
+    def test_batch_route_bypasses_plane_but_still_works(self, memory_storage):
+        srv, key, _ = _serve(memory_storage)
+        try:
+            status, body, _ = call(
+                srv, "POST", f"/batch/events.json?accessKey={key}",
+                [_rate(i) for i in range(5)])
+            assert status == 200
+            assert all(r["status"] == 201 for r in body)
+        finally:
+            srv.shutdown()
+
+    def test_metrics_expose_ingest_families(self, memory_storage):
+        srv, key, _ = _serve(memory_storage)
+        try:
+            assert call(srv, "POST", f"/events.json?accessKey={key}",
+                        _rate(1))[0] == 201
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics") as resp:
+                assert resp.status == 200
+                text = resp.read().decode()
+        finally:
+            srv.shutdown()
+        for family in ("ingest_group_size", "ingest_commit_seconds",
+                       "ingest_commits_total", "ingest_shed_total",
+                       "ingest_in_flight", "ingest_queue_depth"):
+            assert f"# TYPE {family} " in text, family
+        samples = parse_prometheus(text)
+        assert any(v >= 1 for v in samples["ingest_commits_total"].values())

@@ -1,0 +1,390 @@
+"""Ingest write plane — GroupCommitWriter unit tests plus the
+crash-durability drill (ISSUE r7): a process hard-killed between a
+grouped commit's executemany and its COMMIT must leave zero
+acknowledged-but-missing events, and a failed grouped commit must
+preserve the innocent events via the per-item fallback."""
+
+import os
+import pathlib
+import sqlite3
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.ingest import (
+    GroupCommitWriter,
+    IngestConfig,
+    IngestOverload,
+)
+from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _event(i: int) -> Event:
+    return Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                 target_entity_type="item", target_entity_id=f"i{i}")
+
+
+class _RecordingStore:
+    """In-memory LEvents stand-in recording how commits arrived."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rows: dict = {}
+        self.single_calls: list = []
+        self.grouped_calls: list = []
+
+    def insert(self, event, app_id, channel_id=None):
+        eid = event.event_id or f"id-{event.entity_id}"
+        with self.lock:
+            self.single_calls.append((event, app_id, channel_id))
+            self.rows[eid] = event
+        return eid
+
+    def insert_grouped(self, items):
+        with self.lock:
+            self.grouped_calls.append(list(items))
+            ids = []
+            for event, _app_id, _channel_id in items:
+                eid = event.event_id or f"id-{event.entity_id}"
+                self.rows[eid] = event
+                ids.append(eid)
+        return ids
+
+
+def _writer(store, **cfg):
+    return GroupCommitWriter(insert_fn=store.insert,
+                             grouped_fn=store.insert_grouped,
+                             config=IngestConfig(**cfg), name="test")
+
+
+class TestIngestConfig:
+    def test_defaults(self):
+        cfg = IngestConfig()
+        assert cfg.grouping and cfg.max_group == 64
+        assert cfg.max_wait_ms > 0 and cfg.max_queue > 0
+        assert cfg.retry_after_s > 0
+
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_GROUPING", "0")
+        monkeypatch.setenv("PIO_INGEST_MAX_GROUP", "17")
+        monkeypatch.setenv("PIO_INGEST_MAX_WAIT_MS", "7.5")
+        monkeypatch.setenv("PIO_INGEST_MAX_QUEUE", "99")
+        monkeypatch.setenv("PIO_INGEST_RETRY_AFTER_S", "2.5")
+        cfg = IngestConfig.from_env()
+        assert cfg.grouping is False
+        assert cfg.max_group == 17
+        assert cfg.max_wait_ms == 7.5
+        assert cfg.max_queue == 99
+        assert cfg.retry_after_s == 2.5
+
+    def test_from_env_unparseable_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PIO_INGEST_MAX_GROUP", "lots")
+        cfg = IngestConfig.from_env()
+        assert cfg.max_group == IngestConfig().max_group
+
+
+class TestGroupCommitWriter:
+    def test_lone_submit_commits_inline(self):
+        store = _RecordingStore()
+        w = _writer(store)
+        try:
+            eid = w.submit(_event(1), app_id=1)
+        finally:
+            w.close()
+        assert eid in store.rows
+        # a lone request never pays the queue: single insert, no group
+        assert len(store.single_calls) == 1
+        assert store.grouped_calls == []
+
+    def test_concurrent_submits_coalesce_into_one_commit(self):
+        store = _RecordingStore()
+        started = threading.Event()
+        release = threading.Event()
+        real_insert = store.insert
+
+        def blocking_insert(event, app_id, channel_id=None):
+            started.set()
+            release.wait(10)
+            return real_insert(event, app_id, channel_id)
+
+        w = GroupCommitWriter(insert_fn=blocking_insert,
+                              grouped_fn=store.insert_grouped,
+                              config=IngestConfig(max_wait_ms=50.0),
+                              name="test")
+        results: dict = {}
+
+        def submit(i):
+            results[i] = w.submit(_event(i), app_id=1)
+
+        try:
+            t0 = threading.Thread(target=submit, args=(0,))
+            t0.start()
+            assert started.wait(5)  # occupies the writer inline
+            rest = [threading.Thread(target=submit, args=(i,))
+                    for i in range(1, 5)]
+            for t in rest:
+                t.start()
+            # give the stragglers time to reach the queue, then release
+            time.sleep(0.05)
+            release.set()
+            for t in [t0, *rest]:
+                t.join(timeout=10)
+                assert not t.is_alive()
+        finally:
+            release.set()
+            w.close()
+        assert len(results) == 5
+        assert set(results.values()) <= set(store.rows)
+        # the four queued events left as ONE shared transaction
+        assert len(store.grouped_calls) == 1
+        assert len(store.grouped_calls[0]) == 4
+
+    def test_grouped_failure_redoes_per_item(self):
+        store = _RecordingStore()
+        started = threading.Event()
+        release = threading.Event()
+        real_insert = store.insert
+
+        def insert(event, app_id, channel_id=None):
+            if event.entity_id == "u0":
+                started.set()
+                release.wait(10)
+            if event.entity_id == "u3":
+                raise ValueError("poisoned event")
+            return real_insert(event, app_id, channel_id)
+
+        def grouped_always_fails(items):
+            raise RuntimeError("shared transaction rolled back")
+
+        w = GroupCommitWriter(insert_fn=insert,
+                              grouped_fn=grouped_always_fails,
+                              config=IngestConfig(max_wait_ms=50.0),
+                              name="test")
+        results: dict = {}
+        errors: dict = {}
+
+        def submit(i):
+            try:
+                results[i] = w.submit(_event(i), app_id=1)
+            except BaseException as e:  # noqa: BLE001
+                errors[i] = e
+
+        try:
+            t0 = threading.Thread(target=submit, args=(0,))
+            t0.start()
+            assert started.wait(5)
+            rest = [threading.Thread(target=submit, args=(i,))
+                    for i in range(1, 5)]
+            for t in rest:
+                t.start()
+            time.sleep(0.05)
+            release.set()
+            for t in [t0, *rest]:
+                t.join(timeout=10)
+        finally:
+            release.set()
+            w.close()
+        # one poisoned event answers its own error; the innocent three
+        # from its group (plus the inline occupier) all landed
+        assert set(errors) == {3}
+        assert isinstance(errors[3], ValueError)
+        assert set(results) == {0, 1, 2, 4}
+        for i in (1, 2, 4):
+            assert results[i] in store.rows
+
+    def test_bounded_queue_sheds_with_retry_after(self):
+        store = _RecordingStore()
+        started = threading.Event()
+        release = threading.Event()
+        real_insert = store.insert
+
+        def blocking_insert(event, app_id, channel_id=None):
+            started.set()
+            release.wait(10)
+            return real_insert(event, app_id, channel_id)
+
+        w = GroupCommitWriter(insert_fn=blocking_insert,
+                              grouped_fn=store.insert_grouped,
+                              config=IngestConfig(max_queue=1,
+                                                  retry_after_s=2.0),
+                              name="test")
+        try:
+            t = threading.Thread(target=lambda: w.submit(_event(0), 1))
+            t.start()
+            assert started.wait(5)  # budget now full
+            with pytest.raises(IngestOverload) as exc:
+                w.submit(_event(1), app_id=1)
+            assert exc.value.retry_after_s == 2.0
+            release.set()
+            t.join(timeout=10)
+        finally:
+            release.set()
+            w.close()
+
+    def test_grouping_off_is_direct_but_still_bounded(self):
+        store = _RecordingStore()
+        w = _writer(store, grouping=False, max_queue=1)
+        try:
+            assert w.submit(_event(1), app_id=1) in store.rows
+            assert store.grouped_calls == []
+        finally:
+            w.close()
+
+    def test_close_fails_queued_and_rejects_new(self):
+        store = _RecordingStore()
+        started = threading.Event()
+        release = threading.Event()
+        real_insert = store.insert
+
+        def blocking_insert(event, app_id, channel_id=None):
+            started.set()
+            release.wait(10)
+            return real_insert(event, app_id, channel_id)
+
+        w = GroupCommitWriter(insert_fn=blocking_insert,
+                              grouped_fn=store.insert_grouped,
+                              config=IngestConfig(max_wait_ms=50.0),
+                              name="test")
+        errors: list = []
+
+        def submit_queued():
+            try:
+                w.submit(_event(1), app_id=1)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t0 = threading.Thread(target=lambda: w.submit(_event(0), 1))
+        t0.start()
+        assert started.wait(5)
+        tq = threading.Thread(target=submit_queued)
+        tq.start()
+        time.sleep(0.05)
+        w.close(timeout=1.0)
+        release.set()
+        t0.join(timeout=10)
+        tq.join(timeout=10)
+        assert errors and isinstance(errors[0], RuntimeError)
+        with pytest.raises(RuntimeError):
+            w.submit(_event(2), app_id=1)
+
+    def test_ids_readable_immediately_after_submit(self, tmp_path):
+        """Concurrency + read-your-writes against the REAL sqlite
+        backend: the id `submit()` returns must already be a committed
+        row the moment the call returns."""
+        backend = SQLiteBackend(str(tmp_path / "ingest.db"))
+        le = backend.events()
+        w = GroupCommitWriter(insert_fn=le.insert,
+                              grouped_fn=le.insert_grouped,
+                              config=IngestConfig(max_wait_ms=2.0),
+                              name="test")
+        failures: list = []
+
+        def client(base):
+            try:
+                for i in range(12):
+                    eid = w.submit(_event(base * 1000 + i), app_id=1)
+                    if le.get(eid, 1) is None:
+                        failures.append(eid)
+            except BaseException as e:  # noqa: BLE001
+                failures.append(e)
+
+        try:
+            threads = [threading.Thread(target=client, args=(b,))
+                       for b in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive()
+        finally:
+            w.close()
+            backend.close()
+        assert failures == []
+
+
+GROUP_CRASH_WORKER = textwrap.dedent("""
+    import os, sys, threading, time
+    sys.path.insert(0, os.environ["PIO_TEST_REPO"])
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.ingest import GroupCommitWriter, IngestConfig
+    from predictionio_tpu.storage.sqlite import SQLiteBackend
+
+    backend = SQLiteBackend(os.environ["PIO_TEST_DB"])
+    le = backend.events()
+    ack = open(os.environ["PIO_TEST_ACK"], "a")
+    ack_lock = threading.Lock()
+    occupied = threading.Event()
+    real_insert = le.insert
+
+    def slow_first_insert(event, app_id, channel_id=None):
+        # hold the writer busy so the other submits provably queue and
+        # leave as ONE grouped commit (where the armed fault fires)
+        occupied.set()
+        time.sleep(0.3)
+        return real_insert(event, app_id, channel_id)
+
+    w = GroupCommitWriter(insert_fn=slow_first_insert,
+                          grouped_fn=le.insert_grouped,
+                          config=IngestConfig(max_wait_ms=50.0))
+
+    def submit(i):
+        e = Event(event="rate", entity_type="user", entity_id=str(i))
+        eid = w.submit(e, 1)
+        # the ack IS the 201: record it only after submit returned,
+        # flushed to disk so the parent sees every ack that happened
+        with ack_lock:
+            ack.write(eid + "\\n")
+            ack.flush()
+            os.fsync(ack.fileno())
+
+    t0 = threading.Thread(target=submit, args=(0,))
+    t0.start()
+    occupied.wait(5)
+    rest = [threading.Thread(target=submit, args=(i,)) for i in range(1, 6)]
+    for t in rest:
+        t.start()
+    for t in [t0, *rest]:
+        t.join(timeout=30)
+    print("NOFAULT")  # reaching here means the armed site never fired
+""")
+
+
+@pytest.mark.e2e
+class TestGroupCommitCrashDurability:
+    def test_no_ack_without_committed_row(self, tmp_path):
+        """Kill the process between the grouped executemany and its
+        COMMIT: every acknowledged id must be a committed row (acks ⊆
+        db) and the doomed group must have left nothing behind."""
+        worker = tmp_path / "group_crash_worker.py"
+        worker.write_text(GROUP_CRASH_WORKER)
+        db = tmp_path / "events.db"
+        ack_path = tmp_path / "acks.txt"
+        ack_path.touch()
+        env = dict(os.environ)
+        env.pop("PIO_CONF_DIR", None)
+        env.update(PIO_TEST_REPO=str(REPO), PIO_TEST_DB=str(db),
+                   PIO_TEST_ACK=str(ack_path), JAX_PLATFORMS="cpu",
+                   PIO_FAULTS="events.group.pre_commit")
+        proc = subprocess.run([sys.executable, str(worker)], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 137, proc.stderr
+        assert "dying at events.group.pre_commit" in proc.stderr
+        assert "NOFAULT" not in proc.stdout
+
+        acked = set(ack_path.read_text().split())
+        conn = sqlite3.connect(str(db))
+        committed = {r[0] for r in conn.execute("SELECT id FROM events")}
+        conn.close()
+        # durability invariant: no ack without a committed row
+        assert acked <= committed, (
+            f"acknowledged-but-missing events: {sorted(acked - committed)}")
+        # the grouped transaction (5 queued events) died pre-commit:
+        # at most the inline occupier's row may have landed
+        assert len(committed) <= 1

@@ -361,3 +361,91 @@ class TestAggregatePushdownDialect:
                         creation_time=t0), app_id=1)
         # sqlite chokes on the PG SQL → clean None (no exception leak)
         assert le.aggregate_properties_columnar(app_id=1) is None
+
+
+class TestMultiRowInsert:
+    """executemany on INSERT…VALUES rewrites to ONE multi-row statement
+    (r7): the write plane's grouped commit must be a single server round
+    trip on Postgres, not a per-row loop."""
+
+    def test_regex_matches_the_events_insert(self):
+        from predictionio_tpu.storage.postgres import (
+            _MULTIROW_INSERT, translate_sql,
+        )
+        from predictionio_tpu.storage.sqlite import SQLiteLEvents
+
+        m = _MULTIROW_INSERT.match(translate_sql(SQLiteLEvents._INSERT_SQL))
+        assert m, "the events INSERT must be eligible for the rewrite"
+        assert m.group(2).count("%s") == 13
+
+    def test_grouped_insert_is_one_statement(self, pg_backend, monkeypatch):
+        recorded = []
+        real_execute = _FakeCursor.execute
+        real_executemany = _FakeCursor.executemany
+
+        def spy_execute(self, sql, params=()):
+            recorded.append(("execute", sql, params))
+            return real_execute(self, sql, params)
+
+        def spy_executemany(self, sql, seq):
+            recorded.append(("executemany", sql, list(seq)))
+            return real_executemany(self, sql, seq)
+
+        monkeypatch.setattr(_FakeCursor, "execute", spy_execute)
+        monkeypatch.setattr(_FakeCursor, "executemany", spy_executemany)
+
+        events = pg_backend.events()
+        items = [(Event(event="buy", entity_type="user", entity_id=f"g{i}"),
+                  1, None) for i in range(4)]
+        ids = events.insert_grouped(items)
+        assert len(set(ids)) == 4
+
+        inserts = [(kind, sql, params) for kind, sql, params in recorded
+                   if "INSERT INTO events" in sql]
+        assert len(inserts) == 1, inserts
+        kind, sql, params = inserts[0]
+        # one execute (never a driver executemany), carrying all 4 rows
+        assert kind == "execute"
+        assert sql.count("(") == 4 and len(params) == 4 * 13
+        # and the grouped rows really committed
+        assert len(events.find(app_id=1)) == 4
+
+    def test_insert_batch_uses_the_rewrite_too(self, pg_backend,
+                                               monkeypatch):
+        recorded = []
+        real_executemany = _FakeCursor.executemany
+
+        def spy_executemany(self, sql, seq):
+            recorded.append(sql)
+            return real_executemany(self, sql, seq)
+
+        monkeypatch.setattr(_FakeCursor, "executemany", spy_executemany)
+        events = pg_backend.events()
+        batch = [Event(event="view", entity_type="user", entity_id=f"b{i}")
+                 for i in range(6)]
+        ids = events.insert_batch(batch, app_id=1)
+        assert len(set(ids)) == 6
+        assert recorded == []  # the per-row driver loop is gone
+        assert len(events.find(app_id=1, entity_type="user")) == 6
+
+    def test_chunking_splits_large_groups(self, pg_backend, monkeypatch):
+        from predictionio_tpu.storage import postgres
+
+        monkeypatch.setattr(postgres, "_MULTIROW_CHUNK", 3)
+        statements = []
+        real_execute = _FakeCursor.execute
+
+        def spy_execute(self, sql, params=()):
+            if "INSERT INTO events" in sql:
+                statements.append(sql)
+            return real_execute(self, sql, params)
+
+        monkeypatch.setattr(_FakeCursor, "execute", spy_execute)
+        events = pg_backend.events()
+        items = [(Event(event="buy", entity_type="user", entity_id=f"c{i}"),
+                  1, None) for i in range(7)]
+        ids = events.insert_grouped(items)
+        assert len(set(ids)) == 7
+        # 7 rows at chunk=3 → statements of 3, 3 and 1 rows
+        assert [s.count("(") for s in statements] == [3, 3, 1]
+        assert len(events.find(app_id=1)) == 7
