@@ -101,9 +101,51 @@ def _scrape_metrics(port: int) -> dict:
     parsed = parse_prometheus(text)
     keep = ("http_requests_total", "http_request_duration_seconds",
             "http_in_flight", "http_errors_total", "engine_predict_seconds",
-            "eventserver_events_total", "storage_op_seconds")
+            "eventserver_events_total", "storage_op_seconds",
+            "slo_", "flight_", "jit_compile")
     return {name: series for name, series in parsed.items()
             if name.startswith(keep)}
+
+
+def _span_breakdown(port: int, path: str = None, payloads=None,
+                    n_probe: int = 16) -> dict:
+    """Per-stage latency view from the server's flight recorder: fold the
+    timelines on GET /debug/requests.json into median + p95 per span
+    name. The load's own tail-sampled timelines are the population; when
+    `path` is given, `n_probe` forced-capture requests (X-PIO-Debug) are
+    sent first so short runs can't come back empty."""
+    import http.client
+    import statistics
+
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        if path is not None and payloads is not None:
+            for j in range(n_probe):
+                body = payloads(j)
+                conn.request("POST", path, body,
+                             {"Content-Type": "application/json",
+                              "X-PIO-Debug": "1"})
+                conn.getresponse().read()
+        conn.request("GET", "/debug/requests.json?limit=500")
+        entries = json.loads(conn.getresponse().read()).get("entries", [])
+        conn.close()
+    except (OSError, ValueError) as e:
+        return {"error": str(e)}
+    by_name: dict = {}
+    for e in entries:
+        for s in e.get("spans", ()):
+            if not s.get("nested"):
+                by_name.setdefault(s["name"], []).append(s["duration_ms"])
+    out = {}
+    for name, vals in sorted(by_name.items()):
+        vals.sort()
+        out[name] = {
+            "n": len(vals),
+            "p50_ms": round(statistics.median(vals), 3),
+            "p95_ms": round(vals[min(int(len(vals) * 0.95),
+                                     len(vals) - 1)], 3),
+        }
+    return out
 
 
 def _run_http_load(port: int, path, payloads, n_threads,
@@ -387,8 +429,10 @@ def bench_serving(storage_spec: str = "memory", emit: bool = True,
             }
         # scrape the server's own telemetry while it is still up, so BENCH
         # records carry the real served latency histogram alongside the
-        # client-side ladder numbers
+        # client-side ladder numbers — plus the flight recorder's
+        # per-stage span view of where served time went
         metrics_snapshot = _scrape_metrics(port)
+        span_breakdown = _span_breakdown(port, "/queries.json", payloads)
     finally:
         # the measured record must survive teardown trouble, and a
         # Ctrl-C mid-ladder must not orphan a live SO_REUSEPORT pool
@@ -409,6 +453,7 @@ def bench_serving(storage_spec: str = "memory", emit: bool = True,
         "storage": storage_spec,
         "workers": workers,
         "metrics_snapshot": metrics_snapshot,
+        "span_breakdown": span_breakdown,
         "vs_baseline": None,
     }
     if emit:
@@ -641,6 +686,9 @@ def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
         head_n = n_threads if n_threads in ladder else next(iter(ladder))
         results[mode] = {**ladder[head_n], "ladder": ladder}
     metrics_snapshot = _scrape_metrics(port)
+    span_breakdown = _span_breakdown(
+        port, f"/events.json?accessKey={key}",
+        lambda i: json.dumps(one_event(i)).encode())
     server.shutdown()
     storage.close()
     Storage.reset(None)
@@ -653,6 +701,7 @@ def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
         "concurrency": head_n,
         "storage": storage_spec or "sqlite",
         "metrics_snapshot": metrics_snapshot,
+        "span_breakdown": span_breakdown,
         "vs_baseline": None,
     }
     if emit:

@@ -34,7 +34,7 @@ import time
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
-from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
@@ -186,7 +186,7 @@ class _EventHandler(JsonRequestHandler):
         return event
 
     def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
-        with tracing.span("eventserver insert_event"):
+        with spans.span("eventserver.insert_event"):
             event = self._validate_event(d, access_key, app_id, channel_id)
             le = self.storage.l_events()
             try:

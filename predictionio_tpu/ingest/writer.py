@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -155,7 +156,13 @@ class IngestConfig:
 
 
 class _PendingWrite:
-    __slots__ = ("item", "enqueued_at", "done", "result", "error")
+    # taken_at / commit_s are stage stamps written by the committer thread
+    # (monotonic clock, same axis as enqueued_at) and converted into
+    # timeline spans by the WAITING thread after wake-up — contextvar
+    # timelines don't cross threads (telemetry/spans.py). Stamps are
+    # written strictly before finish() sets the event.
+    __slots__ = ("item", "enqueued_at", "done", "result", "error",
+                 "taken_at", "commit_s")
 
     def __init__(self, item: Tuple):
         self.item = item  # (event, app_id, channel_id)
@@ -163,11 +170,30 @@ class _PendingWrite:
         self.done = threading.Event()
         self.result: Optional[str] = None
         self.error: Optional[BaseException] = None
+        self.taken_at: Optional[float] = None
+        self.commit_s: Optional[float] = None
 
     def finish(self, result=None, error: Optional[BaseException] = None):
         self.result = result
         self.error = error
         self.done.set()
+
+    def record_spans(self) -> None:
+        """Convert the committer's stage stamps into spans on the calling
+        thread's active timeline (no-op without one)."""
+        taken = self.taken_at
+        if taken is None:  # never committed (shutdown)
+            spans.record_between("ingest.group_fill", self.enqueued_at,
+                                 time.monotonic())
+            return
+        spans.record_between("ingest.group_fill", self.enqueued_at, taken)
+        if self.commit_s is not None:
+            end = taken + self.commit_s
+            spans.record_between("ingest.commit", taken, end)
+            # commit end → this thread resuming (scheduler wake-up): named
+            # so stage sums account for the wall under saturation
+            spans.record_between("ingest.resume_wait", end,
+                                 time.monotonic())
 
 
 class GroupCommitWriter:
@@ -236,7 +262,8 @@ class GroupCommitWriter:
         a duplicate caller-set eventId). Blocks until the shared commit
         (or the individual fallback insert) completed; raises
         IngestOverload past the bounded in-flight budget."""
-        self._admit()
+        with spans.span("ingest.admission"):
+            self._admit()
         try:
             return self._submit_admitted(event, app_id, channel_id)
         finally:
@@ -275,6 +302,7 @@ class GroupCommitWriter:
             raise RuntimeError(
                 f"grouped commit produced no result within "
                 f"{_NO_RESULT_TIMEOUT_S:.0f}s")
+        p.record_spans()
         if p.error is not None:
             raise p.error
         return p.result
@@ -282,9 +310,10 @@ class GroupCommitWriter:
     def _commit_inline(self, event, app_id: int, channel_id) -> str:
         _GROUP_SIZE.observe(1)
         _COMMITS.inc()
-        t0 = time.perf_counter()
-        eid = self.insert_fn(event, app_id, channel_id)
-        _COMMIT_SECONDS.observe(time.perf_counter() - t0)
+        with spans.span("ingest.commit"):
+            t0 = time.perf_counter()
+            eid = self.insert_fn(event, app_id, channel_id)
+            _COMMIT_SECONDS.observe(time.perf_counter() - t0)
         return eid
 
     # -- committer side ----------------------------------------------------
@@ -332,6 +361,7 @@ class GroupCommitWriter:
                     f"{len(items)} events")
         except BaseException as e:  # noqa: BLE001 — isolate, then redo per item
             if len(group) == 1:
+                group[0].commit_s = time.perf_counter() - t0
                 group[0].finish(error=e)
                 return
             # per-item fallback: the shared transaction rolled back
@@ -341,13 +371,19 @@ class GroupCommitWriter:
             _FALLBACKS.inc()
             log.debug("grouped commit failed (%s); redoing per event", e)
             for p in group:
+                t_item = time.perf_counter()
                 try:
-                    p.finish(result=self.insert_fn(*p.item))
+                    r = self.insert_fn(*p.item)
+                    p.commit_s = time.perf_counter() - t_item
+                    p.finish(result=r)
                 except BaseException as item_e:  # noqa: BLE001
+                    p.commit_s = time.perf_counter() - t_item
                     p.finish(error=item_e)
             return
-        _COMMIT_SECONDS.observe(time.perf_counter() - t0)
+        commit_s = time.perf_counter() - t0
+        _COMMIT_SECONDS.observe(commit_s)
         for p, eid in zip(group, ids):
+            p.commit_s = commit_s
             p.finish(result=eid)
 
     def _run(self) -> None:
@@ -358,6 +394,7 @@ class GroupCommitWriter:
             try:
                 now = time.monotonic()
                 for p in group:
+                    p.taken_at = now
                     _FILL_WAIT.observe(now - p.enqueued_at)
                 _GROUP_SIZE.observe(len(group))
                 _COMMITS.inc()

@@ -24,7 +24,8 @@ def _topk_fn(k: int, masked: bool):
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
+    from predictionio_tpu.utils.profiling import metered_jit
+
     def score_topk(u_vecs, item_factors, ex_rows=None, ex_cols=None):
         # u_vecs [B, K]; item_factors [N, K]; exclusions as COO indices
         # (ex_rows[e], ex_cols[e]) scattered to -inf ON DEVICE — a dense
@@ -39,7 +40,10 @@ def _topk_fn(k: int, masked: bool):
         top_scores, top_idx = jax.lax.top_k(scores, k)
         return top_scores, top_idx
 
-    return score_topk
+    # compile activity per (k, masked) variant is visible on /metrics —
+    # a recompile storm here (unstable batch shapes defeating the bucket
+    # ladder) used to be diagnosable only as a serving latency cliff
+    return metered_jit(score_topk, label=f"ranking.score_topk_k{k}")
 
 
 def _exclusion_coo(ids, exclude, n_rows: int):

@@ -59,6 +59,7 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from predictionio_tpu.serving.admission import DEADLINE_MISSES, DeadlineExceeded
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -127,7 +128,13 @@ class BatcherConfig:
 
 
 class _Pending:
-    __slots__ = ("query", "deadline", "enqueued_at", "done", "result", "error")
+    # taken_at / pad_s / dispatch_s are stage stamps written by the
+    # dispatcher thread (monotonic clock, same axis as enqueued_at) and
+    # converted into timeline spans by the WAITING thread after wake-up —
+    # contextvar timelines don't cross threads (telemetry/spans.py).
+    # Stamps are written strictly before finish() sets the event.
+    __slots__ = ("query", "deadline", "enqueued_at", "done", "result",
+                 "error", "taken_at", "pad_s", "dispatch_s")
 
     def __init__(self, query, deadline: Optional[float]):
         self.query = query
@@ -136,6 +143,31 @@ class _Pending:
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.taken_at: Optional[float] = None
+        self.pad_s = 0.0
+        self.dispatch_s: Optional[float] = None
+
+    def record_spans(self) -> None:
+        """Convert the dispatcher's stage stamps into spans on the calling
+        thread's active timeline (no-op without one)."""
+        taken = self.taken_at
+        if taken is None:  # never dispatched (expired in queue, shutdown)
+            spans.record_between("serving.batch_fill", self.enqueued_at,
+                                 time.monotonic())
+            return
+        spans.record_between("serving.batch_fill", self.enqueued_at, taken)
+        if self.pad_s:
+            spans.record_between("serving.pad", taken, taken + self.pad_s)
+        if self.dispatch_s is not None:
+            start = taken + self.pad_s
+            end = start + self.dispatch_s
+            spans.record_between("serving.dispatch", start, end)
+            # dispatch end → this thread actually resuming: pure scheduler
+            # wake-up latency, which dominates unattributed wall time on a
+            # saturated box — name it so stage sums still account for the
+            # wall (tests/test_flight_recorder.py's attribution bar)
+            spans.record_between("serving.resume_wait", end,
+                                 time.monotonic())
 
     def finish(self, result=None, error: Optional[BaseException] = None):
         self.result = result
@@ -203,7 +235,8 @@ class MicroBatcher:
                 # and a stream of zeros would only flatten the histogram
                 _BATCH_SIZE.observe(1)
                 _BATCHES.inc()
-                results = self.dispatch_fn([query])
+                with spans.span("serving.dispatch"):
+                    results = self.dispatch_fn([query])
                 if len(results) != 1:
                     raise RuntimeError(
                         f"batched dispatch returned {len(results)} results "
@@ -223,10 +256,13 @@ class MicroBatcher:
             # ever arrives, is discarded with the pending entry
             if deadline is not None:
                 _DEADLINE_MISS.inc()
+                spans.record_between("serving.batch_fill", p.enqueued_at,
+                                     time.monotonic())
                 raise DeadlineExceeded("deadline expired while queued")
             raise RuntimeError(
                 f"batched dispatch produced no result within "
                 f"{_NO_DEADLINE_TIMEOUT_S:.0f}s")
+        p.record_spans()
         if p.error is not None:
             raise p.error
         return p.result
@@ -291,26 +327,39 @@ class MicroBatcher:
 
     def _dispatch(self, live: List[_Pending]) -> None:
         queries = [p.query for p in live]
+        t_pad = time.monotonic()
+        padded = self._pad(queries)
+        t_disp = time.monotonic()
+        pad_s = t_disp - t_pad
+        for p in live:
+            p.pad_s = pad_s
         try:
-            results = self.dispatch_fn(self._pad(queries))[:len(queries)]
+            results = self.dispatch_fn(padded)[:len(queries)]
             if len(results) != len(queries):
                 raise RuntimeError(
                     f"batched dispatch returned {len(results)} results "
                     f"for {len(queries)} queries")
         except BaseException as e:  # noqa: BLE001 — isolate, then re-raise per item
             if len(live) == 1:
+                live[0].dispatch_s = time.monotonic() - t_disp
                 live[0].finish(error=e)
                 return
             # per-item fallback: one poisoned query must not fail the
             # batch it happened to share
             log.debug("batched dispatch failed (%s); retrying per item", e)
             for p in live:
+                t_item = time.monotonic()
                 try:
-                    p.finish(result=self.dispatch_fn([p.query])[0])
+                    r = self.dispatch_fn([p.query])[0]
+                    p.dispatch_s = time.monotonic() - t_item
+                    p.finish(result=r)
                 except BaseException as item_e:  # noqa: BLE001
+                    p.dispatch_s = time.monotonic() - t_item
                     p.finish(error=item_e)
             return
+        dispatch_s = time.monotonic() - t_disp
         for p, r in zip(live, results):
+            p.dispatch_s = dispatch_s
             p.finish(result=r)
 
     def _run(self) -> None:
@@ -324,6 +373,7 @@ class MicroBatcher:
                     continue
                 now = time.monotonic()
                 for p in live:
+                    p.taken_at = now
                     _QUEUE_WAIT.observe(now - p.enqueued_at)
                 _BATCH_SIZE.observe(len(live))
                 _BATCHES.inc()

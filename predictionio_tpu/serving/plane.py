@@ -35,6 +35,7 @@ from predictionio_tpu.serving.admission import (
     deadline_from_headers,
 )
 from predictionio_tpu.serving.batcher import BatcherConfig, MicroBatcher
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -125,7 +126,8 @@ class ServingPlane:
         expired before a result was produced."""
         deadline = deadline_from_headers(headers, self.config.admission)
         try:
-            self.admission.admit(deadline)
+            with spans.span("serving.admission"):
+                self.admission.admit(deadline)
         except ShedLoad:
             degraded = self._try_degraded(query)
             if degraded is not None:
@@ -134,7 +136,8 @@ class ServingPlane:
         try:
             if self.batcher is not None:
                 return self.batcher.submit(query, deadline), False
-            return self.dispatch_fn([query])[0], False
+            with spans.span("serving.dispatch"):
+                return self.dispatch_fn([query])[0], False
         finally:
             self.admission.release()
 

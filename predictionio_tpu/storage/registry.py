@@ -22,6 +22,7 @@ from typing import Optional
 
 from predictionio_tpu.storage import base
 from predictionio_tpu.storage.sqlite import SQLiteBackend
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -55,13 +56,19 @@ class _TimedRepo:
         if name not in self._TIMED_OPS or not callable(attr):
             return attr
         timer = STORAGE_OP_SECONDS.labels(repo=self._label, op=name)
+        span_name = f"storage.{self._label}.{name}"
 
         def timed(*args, **kwargs):
             t0 = time.perf_counter()
             try:
                 return attr(*args, **kwargs)
             finally:
-                timer.observe(time.perf_counter() - t0)
+                elapsed = time.perf_counter() - t0
+                timer.observe(elapsed)
+                # attribute the op to the calling request's timeline
+                # (no-op off the request path — train loops, committer
+                # threads without an open timeline)
+                spans.record(span_name, elapsed)
 
         return timed
 

@@ -1,4 +1,5 @@
-"""Runtime telemetry: metrics registry, tracing, and HTTP middleware.
+"""Runtime telemetry: metrics registry, tracing, spans, flight recorder,
+SLO burn tracking, and HTTP middleware.
 
 Import surface is deliberately light (stdlib only) — the SDK and event
 server import this without pulling in jax. See docs/observability.md.
@@ -21,4 +22,11 @@ from predictionio_tpu.telemetry.tracing import (  # noqa: F401
     install_log_record_factory,
     span,
     trace,
+)
+from predictionio_tpu.telemetry.spans import (  # noqa: F401
+    Timeline,
+)
+from predictionio_tpu.telemetry.recorder import (  # noqa: F401
+    FlightRecorder,
+    RECORDER,
 )

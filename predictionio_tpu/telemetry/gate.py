@@ -15,6 +15,15 @@ Run via `python quality.py --telemetry-gate`. Two layers:
    `http_requests_total` / `http_request_duration_seconds` /
    `http_in_flight` families.
 
+3. Span-coverage drill (runtime, no jax, no data files): drive one
+   admitted `/events.json` request through a real EventServer on memory
+   storage and one admitted `/queries.json` request through a
+   ServingPlane-backed probe service, both with `X-PIO-Debug: 1` forced
+   capture, then retrieve each timeline from
+   `/debug/requests/<trace_id>.json` and assert the admission and
+   dispatch/commit spans are present — the flight recorder's coverage
+   contract, checked end to end rather than by AST.
+
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
 
@@ -133,12 +142,137 @@ def _runtime_check() -> list[str]:
     return problems
 
 
+def _span_coverage_check() -> list[str]:
+    """Drive admitted requests through both request planes and assert
+    their flight-recorder timelines carry the stage spans."""
+    import http.client
+    import json
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.serving import ServingPlane
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+    problems = []
+
+    def fetch_timeline(port: int, trace_id) -> tuple:
+        if not trace_id:
+            return None, "response carried no X-PIO-Trace-Id"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", f"/debug/requests/{trace_id}.json")
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        if r.status != 200:
+            return None, (f"/debug/requests/{trace_id}.json answered "
+                          f"{r.status} (timeline not retrievable)")
+        return json.loads(body), None
+
+    def require_spans(entry: dict, label: str, required: dict) -> None:
+        names = {s["name"] for s in entry.get("spans", ())}
+        for what, accepted in required.items():
+            if not names & accepted:
+                problems.append(
+                    f"spans: admitted {label} timeline is missing its "
+                    f"{what} span (want one of {sorted(accepted)}, "
+                    f"got {sorted(names)})")
+
+    # --- /events.json through the real event server (memory storage) ---
+    src = SourceConfig(name="SPANGATE", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    app_id = storage.meta_apps().insert(App(id=0, name="SpanGateApp"))
+    key = "span-gate-key"
+    storage.meta_access_keys().insert(
+        AccessKey(key=key, app_id=app_id, events=[]))
+    server = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                         storage=storage)
+    server.start()
+    try:
+        payload = json.dumps({"event": "rate", "entityType": "user",
+                              "entityId": "u1", "targetEntityType": "item",
+                              "targetEntityId": "i1"}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("POST", f"/events.json?accessKey={key}", payload,
+                     {"Content-Type": "application/json",
+                      "X-PIO-Debug": "1"})
+        r = conn.getresponse()
+        r.read()
+        trace_id = r.getheader("X-PIO-Trace-Id")
+        conn.close()
+        if r.status != 201:
+            problems.append(
+                f"spans: /events.json probe answered {r.status}, not 201")
+        else:
+            entry, err = fetch_timeline(server.port, trace_id)
+            if err:
+                problems.append(f"spans: /events.json {err}")
+            else:
+                require_spans(entry, "/events.json", {
+                    "admission": {"ingest.admission"},
+                    "commit": {"ingest.commit", "ingest.group_fill"},
+                })
+    finally:
+        server.shutdown()
+        storage.close()
+
+    # --- /queries.json through a ServingPlane-backed probe service ---
+    plane = ServingPlane(lambda queries: [{"scored": True} for _ in queries],
+                         name="spangateserving")
+
+    class _QueryHandler(JsonRequestHandler):
+        def do_POST(self):
+            body = self.read_body()
+            if self.path != "/queries.json":
+                return self.send_json(404, {"message": "Not Found"})
+            result, _degraded = plane.handle_query(
+                json.loads(body or b"{}"), self.headers)
+            self.send_json(200, result)
+
+    svc = HttpService("127.0.0.1", 0, _QueryHandler,
+                      server_name="spangateserving")
+    svc.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=10)
+        conn.request("POST", "/queries.json", b'{"user": "u1"}',
+                     {"Content-Type": "application/json",
+                      "X-PIO-Debug": "1"})
+        r = conn.getresponse()
+        r.read()
+        trace_id = r.getheader("X-PIO-Trace-Id")
+        conn.close()
+        if r.status != 200:
+            problems.append(
+                f"spans: /queries.json probe answered {r.status}, not 200")
+        else:
+            entry, err = fetch_timeline(svc.port, trace_id)
+            if err:
+                problems.append(f"spans: /queries.json {err}")
+            else:
+                require_spans(entry, "/queries.json", {
+                    "admission": {"serving.admission"},
+                    "dispatch": {"serving.dispatch"},
+                })
+    finally:
+        svc.shutdown()
+        plane.close()
+    return problems
+
+
 def run_gate() -> int:
     problems = _static_scan()
     try:
         problems += _runtime_check()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
         problems.append(f"runtime check crashed: {e!r}")
+    try:
+        problems += _span_coverage_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"span-coverage check crashed: {e!r}")
     for p in problems:
         print(p, file=sys.stderr)
     print(f"telemetry gate: {'FAIL' if problems else 'OK'} "

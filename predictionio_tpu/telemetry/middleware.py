@@ -9,7 +9,13 @@ methods are wrapped with:
   - trace propagation      inbound X-PIO-Trace-Id adopted (or a fresh id
                            minted), echoed on the response, active in the
                            contextvar for the handler's whole run
+  - a span timeline        telemetry.spans timeline opened per request and
+                           offered to the flight recorder at completion
+                           (X-PIO-Debug: 1 forces capture)
+  - SLO burn tracking      telemetry.slo window feed per request
   - a shared GET /metrics  Prometheus exposition of the default registry
+  - GET /debug/requests.json and /debug/requests/<trace_id>.json
+                           tail-sampled timelines from the flight recorder
 
 Route labels use templates (`/events/<id>.json`, not the raw path) so an
 attacker spraying 404s can't explode label cardinality.
@@ -17,18 +23,27 @@ attacker spraying 404s can't explode label cardinality.
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 import time
 from typing import Type
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry import slo, spans, tracing
+from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 access_logger = logging.getLogger("predictionio_tpu.http.access")
 
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Clients set this header (any non-empty value) to force the flight
+# recorder to keep the request's timeline regardless of sampling.
+DEBUG_HEADER = "X-PIO-Debug"
+
+_DEBUG_LIST_ROUTE = "/debug/requests.json"
+_DEBUG_ONE_ROUTE = "/debug/requests/<trace_id>.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
@@ -46,7 +61,7 @@ HTTP_ERRORS = REGISTRY.counter(
 # Template routes across all four servers: exact paths first, then prefix
 # templates. Anything else (scanner noise, typos) collapses to "<other>".
 _EXACT_ROUTES = frozenset({
-    "/", "/index.html", "/metrics",
+    "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -54,6 +69,7 @@ _EXACT_ROUTES = frozenset({
 _PREFIX_ROUTES = (
     ("/events/", ".json", "/events/<id>.json"),
     ("/webhooks/", ".json", "/webhooks/<connector>.json"),
+    ("/debug/requests/", ".json", _DEBUG_ONE_ROUTE),
 )
 
 
@@ -78,6 +94,7 @@ def route_template(path: str) -> str:
 # statuses — so the caches can't grow past a few hundred entries.
 _REQ_CHILDREN: dict = {}
 _INFLIGHT_CHILDREN: dict = {}
+_ANN_NAMES: dict = {}
 
 
 def record_request(server: str, method: str, route: str, status: int,
@@ -93,6 +110,7 @@ def record_request(server: str, method: str, route: str, status: int,
             HTTP_DURATION.labels(server=server, route=route))
     pair[0].inc()
     pair[1].observe(duration_s)
+    slo.observe(server, route, status, duration_s)
 
 
 def _in_flight(server: str):
@@ -104,12 +122,57 @@ def _in_flight(server: str):
 
 
 def serve_metrics(handler) -> None:
+    # slo_* gauges are windowed views; recompute at scrape so the rendered
+    # burn rates always reflect the current 5m/1h windows.
+    slo.refresh()
     body = REGISTRY.render().encode()
     handler.send_response(200)
     handler.send_header("Content-Type", METRICS_CONTENT_TYPE)
     handler.send_header("Content-Length", str(len(body)))
     handler.end_headers()
     handler.wfile.write(body)
+
+
+def _serve_json(handler, obj, status: int = 200) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json; charset=utf-8")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def serve_debug_requests(handler, raw_path: str) -> None:
+    """GET /debug/requests.json?limit=&route=&kind= — ring dump."""
+    params = parse_qs(urlparse(raw_path).query)
+
+    def _one(name):
+        vals = params.get(name)
+        return vals[0] if vals else None
+
+    try:
+        limit = min(500, int(_one("limit") or 50))
+    except ValueError:
+        limit = 50
+    kind = _one("kind")
+    if kind not in (None, "pinned", "sampled"):
+        return _serve_json(handler, {"error": "kind must be pinned|sampled"},
+                           status=400)
+    entries = RECORDER.snapshot(limit=limit, route=_one("route"), kind=kind)
+    _serve_json(handler, {"entries": entries, "sizes": RECORDER.sizes()})
+
+
+def serve_debug_request_by_id(handler, path: str) -> None:
+    """GET /debug/requests/<trace_id>.json — one timeline by trace id."""
+    trace_id = path[len("/debug/requests/"):-len(".json")]
+    if not tracing._SAFE_TRACE_ID.match(trace_id):
+        return _serve_json(handler, {"error": "bad trace id"}, status=400)
+    entry = RECORDER.get(trace_id)
+    if entry is None:
+        return _serve_json(
+            handler, {"error": "trace not held by the flight recorder",
+                      "trace_id": trace_id}, status=404)
+    _serve_json(handler, entry)
 
 
 def _run_instrumented(self, http_method: str, orig) -> None:
@@ -120,6 +183,14 @@ def _run_instrumented(self, http_method: str, orig) -> None:
     token = tracing.activate(ctx)
     self._pio_trace_id = ctx.trace_id
     self._pio_status = None
+    # Introspection routes are not themselves flight-recorded: a scrape
+    # loop would otherwise flush the sampled ring with its own traffic.
+    introspect = path == "/metrics" or path.startswith("/debug/requests")
+    tl = tl_token = None
+    if not introspect:
+        tl, tl_token = spans.begin(server, route, http_method, ctx.trace_id)
+        if self.headers.get(DEBUG_HEADER):
+            tl.pinned = True
     in_flight = _in_flight(server)
     in_flight.inc()
     t0 = time.perf_counter()
@@ -127,12 +198,34 @@ def _run_instrumented(self, http_method: str, orig) -> None:
     try:
         if http_method == "GET" and path == "/metrics":
             serve_metrics(self)
+        elif http_method == "GET" and path == _DEBUG_LIST_ROUTE:
+            serve_debug_requests(self, self.path)
+        elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
+            serve_debug_request_by_id(self, path)
         elif "jax" in sys.modules:
-            # The request-level span only exists to line the request up
-            # with XLA timelines; open one when jax is loaded. Elsewhere
-            # the request context (fresh span_id) already is the span.
-            with tracing.span(f"{server} {http_method} {route}"):
+            # The request-level annotation only exists to line the request
+            # up with XLA timelines. A bare TraceAnnotation, not
+            # tracing.span: the request context from context_from_headers
+            # already carries a fresh span_id, and the child-context
+            # push/pop costs ~2.5µs against the ≤5% overhead budget.
+            key = (server, http_method, route)
+            name = _ANN_NAMES.get(key)
+            if name is None:
+                name = _ANN_NAMES[key] = f"{server} {http_method} {route}"
+            ann = tracing._jax_annotation(name)
+            if ann is not None:
+                try:
+                    ann.__enter__()
+                except Exception:
+                    ann = None
+            try:
                 orig(self)
+            finally:
+                if ann is not None:
+                    try:
+                        ann.__exit__(None, None, None)
+                    except Exception:
+                        pass
         else:
             orig(self)
     except BaseException:
@@ -143,6 +236,9 @@ def _run_instrumented(self, http_method: str, orig) -> None:
         duration = time.perf_counter() - t0
         status = self._pio_status if self._pio_status is not None else 500
         record_request(server, http_method, route, status, duration)
+        if tl is not None:
+            spans.finish(tl, tl_token, status, duration, error=failed)
+            RECORDER.offer(tl)
         # Propagated requests (caller sent a trace header) log at INFO so a
         # trace id is findable in server logs; local noise stays at DEBUG.
         access_logger.log(
@@ -188,11 +284,33 @@ def instrument(handler_cls: Type, server_name: str) -> Type:
         ns["do_GET"] = make_wrapper("do_GET", _metrics_only_get)
 
     def send_response(self, code, message=None):
-        self._pio_status = code
+        self._pio_status = int(code)   # may be an http.HTTPStatus enum
         handler_cls.send_response(self, code, message)
         tid = getattr(self, "_pio_trace_id", None)
         if tid:
             self.send_header(tracing.TRACE_HEADER, tid)
 
+    def send_error(self, code, message=None, explain=None):
+        # Responses emitted by BaseHTTPRequestHandler's parse layer (501
+        # for an unknown verb, 400 for a bad request line, 414) happen
+        # before any do_* wrapper runs: no trace id yet and no request
+        # count. Mint the id here (send_error → send_response echoes it)
+        # and count the request once; inside a do_* run the wrapper owns
+        # both, so this stays a pure pass-through.
+        parse_layer = getattr(self, "_pio_trace_id", None) is None
+        if parse_layer:
+            ctx, _ = tracing.context_from_headers(
+                getattr(self, "headers", None))
+            self._pio_trace_id = ctx.trace_id
+        handler_cls.send_error(self, code, message, explain)
+        if parse_layer:
+            method = getattr(self, "command", None)
+            if method not in ("GET", "POST", "PUT", "DELETE", "HEAD",
+                              "OPTIONS", "PATCH"):
+                method = "<other>"   # raw request-line verb: cap cardinality
+            record_request(self.pio_server_name, method, "<other>",
+                           int(code), 0.0)
+
     ns["send_response"] = send_response
+    ns["send_error"] = send_error
     return type(handler_cls.__name__ + "Instrumented", (handler_cls,), ns)

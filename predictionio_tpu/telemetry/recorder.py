@@ -1,0 +1,217 @@
+"""Bounded in-process flight recorder with Dapper-style tail sampling.
+
+Aggregate histograms say *that* p95 regressed; the flight recorder keeps
+the evidence for *why*: completed span timelines (telemetry/spans.py) for
+the requests worth a post-mortem. The sampling decision runs at request
+*end* — tail sampling — so the outcome can steer it:
+
+  - **pinned** ring: always kept — errors (5xx or handler exception),
+    admission sheds (429/503), anything slower than its route's
+    threshold, and requests that asked for capture (`X-PIO-Debug: 1`).
+  - **sampled** ring: a small random fraction of the healthy rest, so
+    there is always a baseline timeline to diff a slow one against.
+
+Both rings are fixed-length deques of plain dicts (timelines are frozen
+to JSON-shaped dicts on entry, so a retained record can't keep handler
+state alive), giving a hard memory bound: ring slots × MAX_SPANS spans.
+Oldest entries fall out first; pinned and sampled evict independently so
+a burst of healthy traffic can never push out an error.
+
+Retrieval is over HTTP on every HttpService (wired by the middleware):
+
+    GET /debug/requests.json                 newest-first ring dump
+    GET /debug/requests.json?route=/queries.json&kind=pinned&limit=20
+    GET /debug/requests/<trace_id>.json      one timeline by trace id
+
+Sizing knobs (environment, read at import):
+
+    PIO_FLIGHT_PINNED    pinned ring slots          (default 256)
+    PIO_FLIGHT_SAMPLED   sampled ring slots         (default 256)
+    PIO_FLIGHT_SAMPLE    healthy-request sample rate (default 0.01)
+    PIO_FLIGHT_SLOW_MS   default slow threshold, ms  (default 250)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.telemetry.spans import Timeline
+
+FLIGHT_RECORDED = REGISTRY.counter(
+    "flight_recorded_total", "Timelines kept by the flight recorder",
+    labelnames=("kind",))
+FLIGHT_DISCARDED = REGISTRY.counter(
+    "flight_discarded_total",
+    "Healthy timelines that fell outside the random sample")
+FLIGHT_EVICTED = REGISTRY.counter(
+    "flight_evicted_total", "Timelines evicted to make room",
+    labelnames=("kind",))
+FLIGHT_BUFFER_SIZE = REGISTRY.gauge(
+    "flight_buffer_size", "Timelines currently held",
+    labelnames=("kind",))
+
+_SHED_STATUSES = frozenset({429, 503})
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Two independent bounded rings plus a trace-id index over both."""
+
+    def __init__(self, pinned_slots: Optional[int] = None,
+                 sampled_slots: Optional[int] = None,
+                 sample_rate: Optional[float] = None,
+                 slow_threshold_s: Optional[float] = None):
+        self.pinned_slots = pinned_slots if pinned_slots is not None \
+            else _env_int("PIO_FLIGHT_PINNED", 256)
+        self.sampled_slots = sampled_slots if sampled_slots is not None \
+            else _env_int("PIO_FLIGHT_SAMPLED", 256)
+        self.sample_rate = sample_rate if sample_rate is not None \
+            else _env_float("PIO_FLIGHT_SAMPLE", 0.01)
+        self.slow_threshold_s = slow_threshold_s if slow_threshold_s is not None \
+            else _env_float("PIO_FLIGHT_SLOW_MS", 250.0) / 1e3
+        # per-route-template overrides of the slow bar; e.g. a checkpoint
+        # restore route is legitimately slower than a serving query
+        self._slow_by_route: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._pinned: deque = deque()
+        self._sampled: deque = deque()
+        # trace_id -> frozen timeline dict; kept in lockstep with the rings
+        self._index: Dict[str, dict] = {}
+        self._rng = random.Random()
+        self._random = self._rng.random
+        # cached children: .inc() via the metric re-resolves the child
+        # under a lock every call — too hot for the healthy-request path
+        self._discarded = FLIGHT_DISCARDED.labels()
+        self._size_pinned = FLIGHT_BUFFER_SIZE.labels(kind="pinned")
+        self._size_sampled = FLIGHT_BUFFER_SIZE.labels(kind="sampled")
+        self._kept_pinned = FLIGHT_RECORDED.labels(kind="pinned")
+        self._kept_sampled = FLIGHT_RECORDED.labels(kind="sampled")
+        self._evicted_pinned = FLIGHT_EVICTED.labels(kind="pinned")
+        self._evicted_sampled = FLIGHT_EVICTED.labels(kind="sampled")
+
+    # -- policy ----------------------------------------------------------
+
+    def set_slow_threshold(self, route: str, threshold_s: float) -> None:
+        with self._lock:
+            self._slow_by_route[route] = threshold_s
+
+    def _slow_bar(self, route: str) -> float:
+        return self._slow_by_route.get(route, self.slow_threshold_s)
+
+    def classify(self, tl: Timeline) -> Optional[str]:
+        """Why a timeline deserves pinning, or None if it is healthy."""
+        if tl.error or (tl.status is not None and tl.status >= 500
+                        and tl.status not in _SHED_STATUSES):
+            return "error"
+        if tl.status in _SHED_STATUSES:
+            return "shed"
+        if tl.duration_s >= self._slow_bar(tl.route):
+            return "slow"
+        if tl.pinned:
+            return "debug"
+        return None
+
+    # -- ingest ----------------------------------------------------------
+
+    def offer(self, tl: Timeline) -> Optional[str]:
+        """Called once per finished request; returns the retention kind
+        ("pinned"/"sampled") or None when the timeline was let go."""
+        # inlined healthy fast path (≡ classify(tl) is None): nearly every
+        # request exits here, inside the ≤5% per-request overhead budget
+        status = tl.status
+        if (not tl.error and not tl.pinned
+                and (status is None or (status < 500 and status != 429))
+                and tl.duration_s < self._slow_by_route.get(
+                    tl.route, self.slow_threshold_s)):
+            if self._random() >= self.sample_rate:
+                self._discarded.inc()
+                return None
+            reason = None
+        else:
+            reason = self.classify(tl)
+        entry = tl.to_dict()
+        if reason is not None:
+            entry["kept"] = reason
+        with self._lock:
+            if reason is not None:
+                self._push(self._pinned, self.pinned_slots, entry,
+                           self._evicted_pinned)
+                self._size_pinned.set(len(self._pinned))
+                kept, counter = "pinned", self._kept_pinned
+            else:
+                entry["kept"] = "sampled"
+                self._push(self._sampled, self.sampled_slots, entry,
+                           self._evicted_sampled)
+                self._size_sampled.set(len(self._sampled))
+                kept, counter = "sampled", self._kept_sampled
+            self._index[entry["trace_id"]] = entry
+        counter.inc()
+        return kept
+
+    def _push(self, ring: deque, slots: int, entry: dict,
+              evicted_counter) -> None:
+        while len(ring) >= slots:
+            old = ring.popleft()
+            # a retried trace id may have overwritten the index slot; only
+            # drop the index entry if it still points at the evictee
+            if self._index.get(old["trace_id"]) is old:
+                del self._index[old["trace_id"]]
+            evicted_counter.inc()
+        ring.append(entry)
+
+    # -- retrieval -------------------------------------------------------
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._index.get(trace_id)
+
+    def snapshot(self, limit: int = 50, route: Optional[str] = None,
+                 kind: Optional[str] = None) -> List[dict]:
+        """Newest-first merged view of both rings (filtered, bounded)."""
+        with self._lock:
+            entries = []
+            if kind in (None, "pinned"):
+                entries.extend(self._pinned)
+            if kind in (None, "sampled"):
+                entries.extend(self._sampled)
+        entries.sort(key=lambda e: e["start_time"], reverse=True)
+        if route is not None:
+            entries = [e for e in entries if e["route"] == route]
+        return entries[:max(0, limit)]
+
+    def sizes(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pinned": len(self._pinned),
+                    "sampled": len(self._sampled),
+                    "index": len(self._index)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pinned.clear()
+            self._sampled.clear()
+            self._index.clear()
+            self._size_pinned.set(0)
+            self._size_sampled.set(0)
+
+
+# Process-wide recorder, mirroring telemetry.registry.REGISTRY: every
+# HttpService in the process feeds and serves the same rings.
+RECORDER = FlightRecorder()

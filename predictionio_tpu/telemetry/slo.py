@@ -1,0 +1,220 @@
+"""Per-route SLO objectives with multi-window error-budget burn rates.
+
+An SLO here is two objectives over a route:
+
+  - **availability**: fraction of requests that are not server-caused
+    failures. 5xx and admission sheds (429/503) spend the budget — a
+    shed is the server refusing work it promised to handle, so from the
+    caller's side it is an error, whichever status code it wears.
+  - **latency**: fraction of *successful* requests answered under the
+    route's threshold. Failed requests don't also count as slow — the
+    availability objective already charged them.
+
+Burn rate is the Prometheus/SRE-workbook number: the error ratio over a
+trailing window divided by the error budget (1 − target). Burn 1.0 means
+spending the budget exactly at the rate that exhausts it at period end;
+14.4 on the 5m window is the classic page-now threshold. Two windows —
+5m (fast, catches incidents) and 1h (slow, catches simmering
+regressions) — are both exposed so dashboards can do multi-window
+alerting without server-side rule evaluation.
+
+Mechanics: each tracked (server, route) keeps a ring of 10-second
+buckets covering the 1h window (360 slots, a few hundred bytes — cost
+is independent of traffic). `observe()` is fed by the HTTP middleware's
+`record_request` and is O(1); the `slo_*` gauge families are recomputed
+by `refresh()`, which the `/metrics` route calls before rendering, so
+scrapes always see current windows without any background thread.
+
+Routes are opt-in via `set_objective()`; the serving and ingest routes
+ship with defaults below. Untracked routes cost one dict miss.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+BUCKET_S = 10
+WINDOWS: Tuple[Tuple[str, int], ...] = (("5m", 300), ("1h", 3600))
+_RING_SLOTS = WINDOWS[-1][1] // BUCKET_S
+
+SLO_OBJECTIVE = REGISTRY.gauge(
+    "slo_objective", "Configured SLO target (fraction of good requests)",
+    labelnames=("server", "route", "slo"))
+SLO_ERROR_RATIO = REGISTRY.gauge(
+    "slo_window_error_ratio",
+    "Bad-request ratio over the trailing window",
+    labelnames=("server", "route", "slo", "window"))
+SLO_BURN_RATE = REGISTRY.gauge(
+    "slo_error_budget_burn_rate",
+    "Window error ratio divided by the error budget (1 = on-track spend)",
+    labelnames=("server", "route", "slo", "window"))
+SLO_WINDOW_REQUESTS = REGISTRY.gauge(
+    "slo_window_requests",
+    "Requests observed in the trailing window",
+    labelnames=("server", "route", "window"))
+
+_SHED_STATUSES = frozenset({429, 503})
+
+
+class Objective:
+    __slots__ = ("availability_target", "latency_target", "latency_threshold_s")
+
+    def __init__(self, availability_target: float, latency_target: float,
+                 latency_threshold_s: float):
+        self.availability_target = availability_target
+        self.latency_target = latency_target
+        self.latency_threshold_s = latency_threshold_s
+
+
+class _Bucket:
+    __slots__ = ("bucket_id", "total", "bad_avail", "good_total", "bad_latency")
+
+    def __init__(self):
+        self.bucket_id = -1
+        self.total = 0
+        self.bad_avail = 0
+        self.good_total = 0   # denominator for the latency objective
+        self.bad_latency = 0
+
+
+class _Tracker:
+    """Ring of 10s buckets for one (server, route)."""
+
+    __slots__ = ("server", "route", "objective", "ring", "lock")
+
+    def __init__(self, server: str, route: str, objective: Objective):
+        self.server = server
+        self.route = route
+        self.objective = objective
+        self.ring: List[_Bucket] = [_Bucket() for _ in range(_RING_SLOTS)]
+        self.lock = threading.Lock()
+
+    def observe(self, status: int, duration_s: float, now: float) -> None:
+        bucket_id = int(now) // BUCKET_S
+        b = self.ring[bucket_id % _RING_SLOTS]
+        bad = status >= 500 or status in _SHED_STATUSES
+        with self.lock:
+            if b.bucket_id != bucket_id:
+                b.bucket_id = bucket_id
+                b.total = b.bad_avail = b.good_total = b.bad_latency = 0
+            b.total += 1
+            if bad:
+                b.bad_avail += 1
+            else:
+                b.good_total += 1
+                if duration_s > self.objective.latency_threshold_s:
+                    b.bad_latency += 1
+
+    def window_sums(self, window_s: int, now: float) -> Tuple[int, int, int, int]:
+        newest = int(now) // BUCKET_S
+        oldest = newest - window_s // BUCKET_S + 1
+        total = bad_avail = good_total = bad_latency = 0
+        with self.lock:
+            for b in self.ring:
+                if oldest <= b.bucket_id <= newest:
+                    total += b.total
+                    bad_avail += b.bad_avail
+                    good_total += b.good_total
+                    bad_latency += b.bad_latency
+        return total, bad_avail, good_total, bad_latency
+
+
+_trackers: Dict[Tuple[str, str], _Tracker] = {}
+_trackers_lock = threading.Lock()
+
+
+def set_objective(server: str, route: str,
+                  availability_target: float = 0.999,
+                  latency_target: float = 0.99,
+                  latency_threshold_s: float = 0.25) -> None:
+    """Register (or replace) the SLO for one route on one server."""
+    obj = Objective(availability_target, latency_target, latency_threshold_s)
+    with _trackers_lock:
+        existing = _trackers.get((server, route))
+        if existing is not None:
+            existing.objective = obj
+        else:
+            _trackers[(server, route)] = _Tracker(server, route, obj)
+    SLO_OBJECTIVE.labels(server=server, route=route,
+                         slo="availability").set(availability_target)
+    SLO_OBJECTIVE.labels(server=server, route=route,
+                         slo="latency").set(latency_target)
+
+
+def observe(server: str, route: str, status: int, duration_s: float) -> None:
+    """O(1) per-request feed; no-op for routes without an objective."""
+    t = _trackers.get((server, route))
+    if t is not None:
+        t.observe(status, duration_s, time.time())
+
+
+def refresh(now: Optional[float] = None) -> None:
+    """Recompute every slo_* gauge from the rings (called at scrape)."""
+    if now is None:
+        now = time.time()
+    with _trackers_lock:
+        trackers = list(_trackers.values())
+    for t in trackers:
+        obj = t.objective
+        for window_name, window_s in WINDOWS:
+            total, bad_avail, good_total, bad_latency = \
+                t.window_sums(window_s, now)
+            SLO_WINDOW_REQUESTS.labels(
+                server=t.server, route=t.route, window=window_name).set(total)
+            avail_ratio = bad_avail / total if total else 0.0
+            lat_ratio = bad_latency / good_total if good_total else 0.0
+            for slo, ratio, target in (
+                    ("availability", avail_ratio, obj.availability_target),
+                    ("latency", lat_ratio, obj.latency_target)):
+                SLO_ERROR_RATIO.labels(server=t.server, route=t.route,
+                                       slo=slo, window=window_name).set(ratio)
+                budget = 1.0 - target
+                burn = ratio / budget if budget > 0 else 0.0
+                SLO_BURN_RATE.labels(server=t.server, route=t.route,
+                                     slo=slo, window=window_name).set(burn)
+
+
+def snapshot(now: Optional[float] = None) -> List[dict]:
+    """Dashboard-shaped view: one row per (server, route, slo, window)."""
+    if now is None:
+        now = time.time()
+    refresh(now)
+    rows: List[dict] = []
+    with _trackers_lock:
+        trackers = list(_trackers.values())
+    for t in trackers:
+        obj = t.objective
+        for window_name, window_s in WINDOWS:
+            total, bad_avail, good_total, bad_latency = \
+                t.window_sums(window_s, now)
+            for slo, bad, denom, target in (
+                    ("availability", bad_avail, total,
+                     obj.availability_target),
+                    ("latency", bad_latency, good_total, obj.latency_target)):
+                ratio = bad / denom if denom else 0.0
+                budget = 1.0 - target
+                rows.append({
+                    "server": t.server, "route": t.route, "slo": slo,
+                    "window": window_name, "target": target,
+                    "requests": denom, "bad": bad,
+                    "error_ratio": round(ratio, 6),
+                    "burn_rate": round(ratio / budget, 3) if budget else 0.0,
+                })
+    return rows
+
+
+def reset() -> None:
+    """Drop all trackers (tests)."""
+    with _trackers_lock:
+        _trackers.clear()
+
+
+# Default objectives for the two hot request routes. 250 ms at p99 with
+# 99.9% availability matches the r05 single-host ladder's healthy range;
+# deployments override via set_objective().
+set_objective("eventserver", "/events.json")
+set_objective("predictionserver", "/queries.json")

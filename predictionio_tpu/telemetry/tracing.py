@@ -60,18 +60,22 @@ _current: contextvars.ContextVar[Optional[TraceContext]] = \
 # Trace ids need uniqueness, not cryptographic strength: a urandom-seeded
 # Mersenne generator is ~4× cheaper per id than secrets.token_hex. Reseed
 # after fork (worker_pool pre-forks N servers) so siblings don't replay
-# one id stream.
-_rng = random.Random()
-_rng_pid = os.getpid()
+# one id stream — via a fork hook, not a per-call getpid() check: ids are
+# minted per request on the serving hot path.
+_randbits = random.Random().getrandbits
+
+
+def _reseed_after_fork() -> None:
+    global _randbits
+    _randbits = random.Random().getrandbits
+
+
+if hasattr(os, "register_at_fork"):  # not on every platform
+    os.register_at_fork(after_in_child=_reseed_after_fork)
 
 
 def _new_id() -> str:
-    global _rng, _rng_pid
-    pid = os.getpid()
-    if pid != _rng_pid:
-        _rng = random.Random()
-        _rng_pid = pid
-    return f"{_rng.getrandbits(64):016x}"
+    return f"{_randbits(64):016x}"
 
 
 def new_context(trace_id: Optional[str] = None) -> TraceContext:

@@ -13,6 +13,8 @@ import json
 from typing import Optional
 
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.telemetry import slo
+from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY, Histogram
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
@@ -34,6 +36,17 @@ pre {{ margin: 0; font-size: 12px; white-space: pre-wrap; max-width: 48em; }}
 {evals}
 <h2>Engine instances</h2>
 {instances}
+<h2>SLO error budgets</h2>
+<p>Multi-window burn rates per tracked route (burn 1.0 = spending the
+budget exactly at the rate that exhausts it; &gt;14 on the 5m window is
+page-now territory). Raw families: <code>slo_*</code> on
+<a href="/metrics">/metrics</a>.</p>
+{slo}
+<h2>Flight recorder</h2>
+<p>Tail-sampled request timelines (errors, sheds, slow requests pinned;
+random sample of the rest) — newest first, full JSON at
+<a href="/debug/requests.json">/debug/requests.json</a>.</p>
+{flight}
 <h2>Telemetry</h2>
 <p>Process-local metrics; the raw Prometheus view is at
 <a href="/metrics">/metrics</a>.</p>
@@ -82,6 +95,63 @@ def _label_str(names, values) -> str:
     return ", ".join(f"{n}={v}" for n, v in zip(names, values)) or "—"
 
 
+def _slo_table() -> str:
+    rows = slo.snapshot()
+    if not rows:
+        return "<p>No routes with SLO objectives.</p>"
+    out = ["<table><tr><th>Server</th><th>Route</th><th>SLO</th>"
+           "<th>Window</th><th>Target</th><th>Requests</th><th>Bad</th>"
+           "<th>Error ratio</th><th>Burn rate</th></tr>"]
+    for r in rows:
+        burn = r["burn_rate"]
+        # the 5m fast-burn page threshold from the SRE workbook; amber at
+        # sustained budget overspend on any window
+        color = ("#ba000d" if burn >= 14.4 else
+                 "#a06f00" if burn > 1.0 else "#087f23")
+        out.append(
+            f"<tr><td>{html.escape(r['server'])}</td>"
+            f"<td>{html.escape(r['route'])}</td>"
+            f"<td>{html.escape(r['slo'])}</td>"
+            f"<td>{html.escape(r['window'])}</td>"
+            f"<td>{r['target']:g}</td>"
+            f"<td>{r['requests']}</td>"
+            f"<td>{r['bad']}</td>"
+            f"<td>{r['error_ratio']:.5f}</td>"
+            f"<td style='color:{color}'>{burn:.2f}</td></tr>"
+        )
+    out.append("</table>")
+    return "".join(out)
+
+
+def _flight_table() -> str:
+    sizes = RECORDER.sizes()
+    entries = RECORDER.snapshot(limit=20)
+    out = [f"<p>Buffered: {sizes['pinned']} pinned, "
+           f"{sizes['sampled']} sampled.</p>"]
+    if not entries:
+        out.append("<p>No recorded request timelines yet.</p>")
+        return "".join(out)
+    out.append("<table><tr><th>Trace</th><th>Server</th><th>Route</th>"
+               "<th>Status</th><th>Kept</th><th>Duration</th>"
+               "<th>Spans</th></tr>")
+    for e in entries:
+        tid = e.get("trace_id", "")
+        names = ", ".join(s["name"] for s in e.get("spans", ())) or "—"
+        status = e.get("status")
+        out.append(
+            f"<tr><td><a href='/debug/requests/{html.escape(tid)}.json'>"
+            f"{html.escape(tid[:16])}…</a></td>"
+            f"<td>{html.escape(str(e.get('server', '')))}</td>"
+            f"<td>{html.escape(str(e.get('route', '')))}</td>"
+            f"<td>{html.escape(str(status if status is not None else '—'))}</td>"
+            f"<td>{html.escape(str(e.get('kept', '')))}</td>"
+            f"<td>{e.get('duration_ms', 0):.1f}ms</td>"
+            f"<td>{html.escape(names)}</td></tr>"
+        )
+    out.append("</table>")
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -125,9 +195,12 @@ class Dashboard(HttpService):
                     return self.send_json(404, {"message": "Not Found"})
                 evals = dashboard.storage.meta_evaluation_instances().get_completed()
                 instances = dashboard.storage.meta_engine_instances().get_all()
+                slo.refresh()
                 return self.send_html(200, _PAGE.format(
                     evals=_eval_table(evals),
                     instances=_instance_table(instances),
+                    slo=_slo_table(),
+                    flight=_flight_table(),
                     telemetry=_telemetry_table(),
                 ))
 

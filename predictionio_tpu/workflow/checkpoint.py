@@ -28,7 +28,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils import faults
 
@@ -129,7 +129,7 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, tree: Any, metadata: Optional[dict] = None) -> str:
-        with tracing.span(f"checkpoint save step_{step}"), \
+        with spans.span(f"checkpoint.save step_{step}"), \
                 CKPT_SAVE_SECONDS.time():
             out = self._save(step, tree, metadata)
         CKPT_SAVES.inc()
@@ -175,7 +175,7 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"No checkpoints under {self.directory}")
-        with tracing.span(f"checkpoint restore step_{step}"), \
+        with spans.span(f"checkpoint.restore step_{step}"), \
                 CKPT_RESTORE_SECONDS.time():
             d = self._step_dir(step)
             with open(os.path.join(d, "meta.json")) as f:

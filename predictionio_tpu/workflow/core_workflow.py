@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import time
 import traceback
 from datetime import datetime, timezone
 from typing import Optional, Sequence
@@ -26,6 +27,8 @@ from predictionio_tpu.controller.evaluation import (
     MetricEvaluator,
 )
 from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance, Model
+from predictionio_tpu.telemetry import spans, tracing
+from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.workflow.workflow_utils import (
     EngineVariant,
     engine_params_to_json,
@@ -121,13 +124,35 @@ class CoreWorkflow:
             env={},
             **engine_params_to_json(engine_params),
         )
-        with tracked_instance(instances, instance,
-                              label="CoreWorkflow.run_train"):
-            models = engine.train(ctx, engine_params, sanity_check=sanity_check)
-            blob = engine.serialize_models(models, instance.id, engine_params)
-            storage.model_data_models().insert(Model(id=instance.id, models=blob))
-            log.info("CoreWorkflow.run_train: instance %s trained %d model(s), "
-                     "%d byte blob", instance.id, len(models), len(blob))
+        # Train runs get a pinned timeline too: phase durations (train /
+        # serialize / persist) retrievable from any in-process server's
+        # /debug/requests.json, keyed by the run's trace id.
+        trace_id = tracing.current_trace_id() or tracing.new_context().trace_id
+        tl, token = spans.begin("workflow", "train", "RUN", trace_id)
+        tl.pinned = True
+        t_wall = time.perf_counter()
+        ok = False
+        try:
+            with tracked_instance(instances, instance,
+                                  label="CoreWorkflow.run_train"):
+                with spans.span("workflow.train"):
+                    models = engine.train(ctx, engine_params,
+                                          sanity_check=sanity_check)
+                with spans.span("workflow.serialize"):
+                    blob = engine.serialize_models(models, instance.id,
+                                                   engine_params)
+                with spans.span("workflow.persist"):
+                    storage.model_data_models().insert(
+                        Model(id=instance.id, models=blob))
+                log.info("CoreWorkflow.run_train: instance %s trained "
+                         "%d model(s), %d byte blob",
+                         instance.id, len(models), len(blob))
+            ok = True
+        finally:
+            spans.finish(tl, token, status=None,
+                         duration_s=time.perf_counter() - t_wall,
+                         error=not ok)
+            RECORDER.offer(tl)
         return instance
 
     @staticmethod

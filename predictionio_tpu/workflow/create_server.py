@@ -31,7 +31,7 @@ from predictionio_tpu.serving import (
     ServingPlane,
     ShedLoad,
 )
-from predictionio_tpu.telemetry import tracing
+from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
@@ -165,7 +165,7 @@ class PredictionServer(HttpService):
         # current — same snapshot semantics the single-query path had.
         def _dispatch(queries):
             state = server._state
-            with tracing.span("predictionserver predict"), \
+            with spans.span("predictionserver.predict"), \
                     PREDICT_SECONDS.time():
                 return state.engine.predict_batch(
                     state.engine_params, state.models, queries,

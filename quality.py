@@ -22,7 +22,10 @@ def main():
     p.add_argument("--telemetry-gate", action="store_true",
                    help="run the observability CI gate (no jax, no data): "
                         "fails if any in-package HTTP surface bypasses the "
-                        "telemetry middleware")
+                        "telemetry middleware, or if an admitted "
+                        "/queries.json or /events.json request produces a "
+                        "flight-recorder timeline without its admission "
+                        "and dispatch/commit spans (runtime drill)")
     p.add_argument("--serving-gate", action="store_true",
                    help="run the serving CI gate (no jax, no data): fails "
                         "if any predict route bypasses admission control / "

@@ -1,0 +1,308 @@
+"""Flight recorder (ISSUE r8): bounded tail-sampling rings under soak,
+eviction order, error pinning, and the HTTP contract around sheds — a
+429/503 carries X-PIO-Trace-Id, counts in http_requests_total with its
+real status, and its timeline is retrievable from /debug/requests."""
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.ingest import IngestConfig
+from predictionio_tpu.serving import AdmissionConfig, ServingConfig
+from predictionio_tpu.serving.admission import DEADLINE_HEADER
+from predictionio_tpu.storage.base import AccessKey, App
+from predictionio_tpu.telemetry.recorder import RECORDER, FlightRecorder
+from predictionio_tpu.telemetry.registry import parse_prometheus
+from predictionio_tpu.telemetry.spans import MAX_SPANS, Timeline
+from tests.test_recommendation_template import ingest_ratings, variant_dict
+from tests.test_serving_admission import call_raw, deploy
+
+
+def _tl(trace_id, status=200, duration_s=0.001, error=False, pinned=False,
+        route="/queries.json"):
+    tl = Timeline("testserver", route, "POST", trace_id)
+    tl.status = status
+    tl.duration_s = duration_s
+    tl.error = error
+    tl.pinned = pinned
+    return tl
+
+
+def _metrics(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        return parse_prometheus(resp.read().decode())
+
+
+# -- ring mechanics (unit, own FlightRecorder instance) ----------------------
+
+class TestRingsBounded:
+    def test_soak_10k_requests_rings_stay_bounded(self):
+        rec = FlightRecorder(pinned_slots=32, sampled_slots=16,
+                             sample_rate=0.5)
+        for i in range(10_000):
+            # every 10th request errors, every 17th is slow — a steady
+            # stream of pin-worthy traffic interleaved with healthy load
+            rec.offer(_tl(f"soak{i}",
+                          status=500 if i % 10 == 0 else 200,
+                          duration_s=1.0 if i % 17 == 0 else 0.001))
+            if i % 1000 == 0:
+                sizes = rec.sizes()
+                assert sizes["pinned"] <= 32
+                assert sizes["sampled"] <= 16
+        sizes = rec.sizes()
+        assert sizes["pinned"] == 32
+        assert sizes["sampled"] == 16
+        # the index tracks ring membership exactly — no leak across 10k
+        assert sizes["index"] <= 32 + 16
+        entries = rec.snapshot(limit=500)
+        assert len(entries) == 48
+
+    def test_sampled_ring_evicts_oldest_first(self):
+        rec = FlightRecorder(pinned_slots=4, sampled_slots=4,
+                             sample_rate=1.0)
+        for i in range(8):
+            assert rec.offer(_tl(f"evict{i}")) == "sampled"
+        for i in range(4):
+            assert rec.get(f"evict{i}") is None, f"evict{i} should be gone"
+        for i in range(4, 8):
+            assert rec.get(f"evict{i}") is not None
+        # newest first in the merged snapshot
+        got = [e["trace_id"] for e in rec.snapshot()]
+        assert got == ["evict7", "evict6", "evict5", "evict4"]
+
+    def test_errors_survive_a_healthy_flood(self):
+        """Tail sampling's whole point: the pinned ring evicts
+        independently, so healthy traffic can never push out an error."""
+        rec = FlightRecorder(pinned_slots=8, sampled_slots=8,
+                             sample_rate=1.0)
+        assert rec.offer(_tl("err1", status=500, error=True)) == "pinned"
+        for i in range(5000):
+            rec.offer(_tl(f"flood{i}"))
+        entry = rec.get("err1")
+        assert entry is not None
+        assert entry["kept"] == "error"
+        assert entry["status"] == 500
+
+    def test_pinned_ring_evicts_oldest_error(self):
+        rec = FlightRecorder(pinned_slots=2, sampled_slots=2)
+        for i in range(3):
+            rec.offer(_tl(f"perr{i}", status=500))
+        assert rec.get("perr0") is None
+        assert rec.get("perr1") is not None
+        assert rec.get("perr2") is not None
+
+
+class TestRetentionPolicy:
+    def test_classify_reasons(self):
+        rec = FlightRecorder(sample_rate=0.0, slow_threshold_s=0.25)
+        assert rec.classify(_tl("a", status=500)) == "error"
+        assert rec.classify(_tl("b", status=200, error=True)) == "error"
+        assert rec.classify(_tl("c", status=429)) == "shed"
+        assert rec.classify(_tl("d", status=503)) == "shed"
+        assert rec.classify(_tl("e", duration_s=0.3)) == "slow"
+        assert rec.classify(_tl("f", pinned=True)) == "debug"
+        assert rec.classify(_tl("g")) is None
+
+    def test_per_route_slow_threshold_override(self):
+        rec = FlightRecorder(sample_rate=0.0, slow_threshold_s=0.25)
+        rec.set_slow_threshold("/queries.json", 0.010)
+        assert rec.classify(_tl("h", duration_s=0.02)) == "slow"
+        # other routes keep the default bar
+        assert rec.classify(_tl("i", duration_s=0.02, route="/")) is None
+
+    def test_zero_sample_rate_discards_healthy(self):
+        rec = FlightRecorder(sample_rate=0.0)
+        assert rec.offer(_tl("healthy")) is None
+        assert rec.get("healthy") is None
+        # pin-worthy traffic is immune to the sample rate
+        assert rec.offer(_tl("sick", status=500)) == "pinned"
+
+
+class TestTimelineBounds:
+    def test_span_cap_counts_overflow_instead_of_growing(self):
+        tl = _tl("capped")
+        for i in range(MAX_SPANS + 5):
+            tl.record(f"stage{i}", i * 0.001, 0.001)
+        assert len(tl.spans) == MAX_SPANS
+        assert tl.dropped_spans == 5
+        assert tl.to_dict()["dropped_spans"] == 5
+
+    def test_span_sum_excludes_nested(self):
+        tl = _tl("nested")
+        tl.record("outer", 0.0, 0.010)
+        tl.record("inner", 0.001, 0.004, nested=True)
+        assert abs(tl.span_sum_s() - 0.010) < 1e-9
+        d = tl.to_dict()
+        by_name = {s["name"]: s for s in d["spans"]}
+        assert by_name["inner"]["nested"] is True
+        assert "nested" not in by_name["outer"]
+
+
+# -- shed / error HTTP contract (regression for the send path) ---------------
+
+class TestShedTraceContract:
+    def test_serving_shed_429_traced_counted_and_recorded(self, memory_storage):
+        """A 429 is a real response: it echoes the caller's trace id,
+        lands in http_requests_total with status=429 (not as a 500 or
+        not at all), and its timeline is pinned as a shed."""
+        ingest_ratings(memory_storage)
+        server = deploy(
+            memory_storage, variant_dict(), "rec-test",
+            ServingConfig(admission=AdmissionConfig(max_queue=0)))
+        tid = "shedregression429"
+        try:
+            status, _, headers = call_raw(
+                server.port, "POST", "/queries.json",
+                {"user": "u0", "num": 3},
+                headers={"X-PIO-Trace-Id": tid})
+            assert status == 429
+            assert headers.get("X-PIO-Trace-Id") == tid
+            fams = _metrics(server.port)
+            key = ('{server="predictionserver",method="POST",'
+                   'route="/queries.json",status="429"}')
+            assert fams["http_requests_total"].get(key, 0) >= 1
+            # retrievable post-mortem evidence
+            url = (f"http://127.0.0.1:{server.port}"
+                   f"/debug/requests/{tid}.json")
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                entry = json.loads(resp.read())
+        finally:
+            server.shutdown()
+        assert entry["trace_id"] == tid
+        assert entry["status"] == 429
+        assert entry["kept"] == "shed"
+
+    def test_serving_deadline_503_traced_and_recorded(self, memory_storage):
+        ingest_ratings(memory_storage)
+        server = deploy(memory_storage, variant_dict(), "rec-test",
+                        ServingConfig())
+        tid = "shedregression503"
+        try:
+            status, _, headers = call_raw(
+                server.port, "POST", "/queries.json",
+                {"user": "u0", "num": 3},
+                headers={DEADLINE_HEADER: "0.0001", "X-PIO-Trace-Id": tid})
+            assert status == 503
+            assert headers.get("X-PIO-Trace-Id") == tid
+        finally:
+            server.shutdown()
+        entry = RECORDER.get(tid)
+        assert entry is not None and entry["kept"] == "shed"
+
+    def test_ingest_shed_429_carries_trace_id(self, memory_storage):
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="FlightApp"))
+        key = AccessKey.generate(app_id)
+        memory_storage.meta_access_keys().insert(key)
+        srv = EventServer(
+            EventServerConfig(ip="127.0.0.1", port=0),
+            memory_storage,
+            ingest_config=IngestConfig(max_queue=1, retry_after_s=0.5))
+        srv.start()
+        real_insert = srv.ingest.insert_fn
+        real_grouped = srv.ingest.grouped_fn
+        srv.ingest.insert_fn = lambda e, a, c=None: (
+            time.sleep(0.02), real_insert(e, a, c))[1]
+        srv.ingest.grouped_fn = lambda items: (
+            time.sleep(0.02), real_grouped(items))[1]
+        shed = []
+        lock = threading.Lock()
+
+        def client(base):
+            for i in range(4):
+                tid = f"ingestshed{base}x{i}"
+                status, _, headers = call_raw(
+                    srv.port, "POST",
+                    f"/events.json?accessKey={key.key}",
+                    {"event": "rate", "entityType": "user",
+                     "entityId": f"u{base}", "targetEntityType": "item",
+                     "targetEntityId": f"i{i}"},
+                    headers={"X-PIO-Trace-Id": tid})
+                if status == 429:
+                    with lock:
+                        shed.append((tid, headers.get("X-PIO-Trace-Id")))
+
+        try:
+            threads = [threading.Thread(target=client, args=(b,))
+                       for b in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            fams = _metrics(srv.port)
+        finally:
+            srv.shutdown()
+        assert shed, "drill never saturated the 1-slot budget"
+        # every shed echoed the trace id it was sent
+        assert all(echoed == sent for sent, echoed in shed), shed[:5]
+        key429 = ('{server="eventserver",method="POST",'
+                  'route="/events.json",status="429"}')
+        assert fams["http_requests_total"].get(key429, 0) >= len(shed)
+        # the flight recorder pinned the sheds
+        entry = RECORDER.get(shed[0][0])
+        assert entry is not None and entry["kept"] == "shed"
+
+    def test_parse_layer_501_traced_and_counted(self, memory_storage):
+        """An unknown verb is rejected by BaseHTTPRequestHandler before
+        any do_* wrapper runs; the send_error override must still mint a
+        trace id and count the request under capped labels."""
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="VerbApp"))
+        akey = AccessKey.generate(app_id)
+        memory_storage.meta_access_keys().insert(akey)
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          memory_storage)
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("BREW", "/")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 501
+            assert resp.headers.get("X-PIO-Trace-Id")
+            conn.close()
+            fams = _metrics(srv.port)
+        finally:
+            srv.shutdown()
+        key501 = ('{server="eventserver",method="<other>",'
+                  'route="<other>",status="501"}')
+        assert fams["http_requests_total"].get(key501, 0) >= 1
+
+
+class TestDebugCapture:
+    def test_debug_header_forces_capture_with_stage_spans(self, memory_storage):
+        """X-PIO-Debug pins a healthy request; the retrieved timeline
+        carries named serving stages whose top-level sum stays within the
+        measured wall latency."""
+        ingest_ratings(memory_storage)
+        server = deploy(memory_storage, variant_dict(), "rec-test",
+                        ServingConfig())
+        tid = "debugcapture1"
+        try:
+            status, body, _ = call_raw(
+                server.port, "POST", "/queries.json",
+                {"user": "u0", "num": 3},
+                headers={"X-PIO-Debug": "1", "X-PIO-Trace-Id": tid})
+            assert status == 200 and body["itemScores"]
+            url = (f"http://127.0.0.1:{server.port}"
+                   f"/debug/requests/{tid}.json")
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                entry = json.loads(resp.read())
+            # the ring dump lists it too
+            list_url = (f"http://127.0.0.1:{server.port}"
+                        f"/debug/requests.json?kind=pinned&limit=500")
+            with urllib.request.urlopen(list_url, timeout=10) as resp:
+                dump = json.loads(resp.read())
+        finally:
+            server.shutdown()
+        assert entry["kept"] == "debug"
+        names = [s["name"] for s in entry["spans"]]
+        assert "serving.admission" in names
+        assert "serving.dispatch" in names
+        top_sum = sum(s["duration_ms"] for s in entry["spans"]
+                      if not s.get("nested"))
+        assert top_sum <= entry["duration_ms"] * 1.10 + 0.5
+        assert any(e["trace_id"] == tid for e in dump["entries"])
