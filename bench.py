@@ -629,6 +629,222 @@ def bench_serving_qps(emit: bool = True, clients: int = 8,
     return record
 
 
+def bench_rolling_deploy(workers: int = 4, clients: int = 8,
+                         duration_s: float = 14.0, emit: bool = True):
+    """Zero-downtime rolling-deploy drill (round 6): a real
+    `pio deploy --workers N` supervised pool under N sustained keep-alive
+    clients, with a POST /reload fired mid-load. The supervisor drains
+    and hot-swaps one worker at a time, so the pool never answers from
+    zero workers — and `_run_http_load` raises on ANY non-200 (or a
+    closed connection), so a completed run IS the zero-failed-requests
+    assertion. The record carries the supervisor's own receipts scraped
+    from its control endpoint: rolling_reloads_total, per-worker
+    drain_seconds, and restarts_total (must stay empty — a deploy that
+    needed a respawn was not zero-downtime). Run with
+    `bench.py --rolling-deploy`."""
+    import http.client
+    import re
+    import subprocess as _sp
+    import tempfile as _tf
+    import threading
+
+    from predictionio_tpu.telemetry.registry import parse_prometheus
+
+    if workers < 4:
+        raise SystemExit("--rolling-deploy needs a >=4-worker pool "
+                         "(the acceptance bar drills a real rolling "
+                         "window, not a pair)")
+
+    bench_tmp = _tf.mkdtemp(prefix="pio_bench_")
+    storage, src = _train_serving_model("sqlite", bench_tmp)
+    rng = np.random.default_rng(7)
+    pl = [json.dumps({"user": str(u), "num": 10}).encode()
+          for u in rng.integers(0, 943, 512)]
+    payloads = lambda j: pl[j % len(pl)]  # noqa: E731
+
+    env = dict(os.environ,
+               PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="BENCH",
+               PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="BENCH",
+               PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="BENCH",
+               PIO_STORAGE_SOURCES_BENCH_TYPE="sqlite",
+               PIO_STORAGE_SOURCES_BENCH_PATH=src.path,
+               # the drill sustains load THROUGH the drain, so in-flight
+               # never quiesces and each worker waits the full deadline;
+               # 2s/worker keeps the whole rolling window inside the load
+               PIO_SUPERVISOR_DRAIN_DEADLINE_S="2")
+    env.pop("PIO_CONF_DIR", None)
+    env.pop("PIO_FAULTS", None)
+    proc = _sp.Popen(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bin", "pio"),
+         "deploy", "--ip", "127.0.0.1", "--port", "0",
+         "--workers", str(workers),
+         "--engine-id", "bench", "--engine-variant", "bench"],
+        env=env, stdout=_sp.PIPE, stderr=_sp.STDOUT, text=True)
+
+    # one stdout pump shared by readiness waits and the mid-load wait for
+    # the supervisor's "rolling reload complete" receipt (a second
+    # _wait_service_ready would race the pump for the same pipe)
+    lines: list = []
+    cond = threading.Condition()
+
+    def _pump():
+        for line in proc.stdout:
+            with cond:
+                lines.append(line)
+                cond.notify_all()
+        with cond:
+            lines.append(None)  # EOF sentinel
+            cond.notify_all()
+
+    threading.Thread(target=_pump, daemon=True).start()
+
+    def _wait_line(pattern: str, timeout_s: float):
+        rx = re.compile(pattern)
+        deadline = time.monotonic() + timeout_s
+        i = 0
+        with cond:
+            while True:
+                while i < len(lines):
+                    if lines[i] is None:
+                        raise SystemExit(
+                            f"pool exited rc={proc.poll()} before "
+                            f"{pattern!r}:\n"
+                            + "".join(x for x in lines[-20:] if x))
+                    if rx.search(lines[i]):
+                        return rx.search(lines[i])
+                    i += 1
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise SystemExit(
+                        f"pool never printed {pattern!r} within "
+                        f"{timeout_s:.0f}s:\n"
+                        + "".join(x for x in lines[-20:] if x))
+                cond.wait(min(left, 1.0))
+
+    def _control_get(path):
+        conn = http.client.HTTPConnection("127.0.0.1", control_port,
+                                          timeout=5)
+        conn.request("GET", path)
+        body = conn.getresponse().read().decode()
+        conn.close()
+        return body
+
+    reload_rec: dict = {}
+
+    def _trigger_reload():
+        # fire after the ladder has a steady request stream going, then
+        # hold for the supervisor's own completion receipt
+        time.sleep(3.0)
+        t0 = time.monotonic()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/reload", b"",
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = json.loads(r.read() or b"{}")
+            conn.close()
+            if r.status != 200 or "Rolling reload" not in body.get(
+                    "message", ""):
+                reload_rec["error"] = f"/reload answered {r.status}: {body}"
+                return
+            _wait_line(r"supervisor: rolling reload complete",
+                       duration_s + 60.0)
+            reload_rec["reload_wall_s"] = round(time.monotonic() - t0, 3)
+        except BaseException as e:
+            reload_rec["error"] = str(e) or repr(e)
+
+    try:
+        control_port = int(_wait_line(
+            r"Supervisor control endpoint on [0-9.]+:(\d+)", 60).group(1))
+        port = int(_wait_line(
+            r"deployed on 127\.0\.0\.1:(\d+)", 300).group(1))
+        # "deployed" announces the FIRST ready worker; the drill needs
+        # the whole pool serving before the reload window opens
+        deadline = time.monotonic() + 300
+        while True:
+            status = json.loads(_control_get("/status.json"))
+            if status["ready"] >= workers:
+                break
+            if time.monotonic() > deadline:
+                raise SystemExit(f"pool never reached {workers} ready "
+                                 f"workers: {status}")
+            time.sleep(0.25)
+
+        # warm-up primes every worker's caches through fresh connections
+        t_end = time.time() + 1.0
+        while time.time() < t_end:
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            for _ in range(8):
+                conn.request("POST", "/queries.json", pl[0],
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            conn.close()
+
+        reloader = threading.Thread(target=_trigger_reload, daemon=True)
+        reloader.start()
+        qps, p50, p95, n = _run_http_load(
+            port, "/queries.json", payloads, clients, duration_s=duration_s)
+        reloader.join(timeout=duration_s + 90)
+        if reloader.is_alive():
+            raise SystemExit("rolling-deploy: reload never completed")
+        if "error" in reload_rec:
+            raise SystemExit(f"rolling-deploy: {reload_rec['error']}")
+
+        metrics = parse_prometheus(_control_get("/metrics"))
+        status = json.loads(_control_get("/status.json"))
+    finally:
+        _kill_proc(proc)
+
+    rolling = sum(metrics.get("supervisor_rolling_reloads_total",
+                              {}).values())
+    restarts = {k: v for k, v in
+                metrics.get("supervisor_restarts_total", {}).items() if v}
+    drain_count = sum(metrics.get("supervisor_drain_seconds_count",
+                                  {}).values())
+    drain_sum = sum(metrics.get("supervisor_drain_seconds_sum",
+                                {}).values())
+    if not rolling:
+        raise SystemExit("rolling-deploy: supervisor_rolling_reloads_total "
+                         "never incremented — the /reload verb did not "
+                         "reach the supervisor")
+    if restarts:
+        raise SystemExit(f"rolling-deploy: workers were restarted during "
+                         f"the deploy ({restarts}) — not zero-downtime")
+    if drain_count < workers:
+        raise SystemExit(f"rolling-deploy: only {drain_count} drain "
+                         f"receipts for {workers} workers — some worker "
+                         f"never drained through the reload")
+    if status["ready"] < workers:
+        raise SystemExit(f"rolling-deploy: pool ended below strength: "
+                         f"{status['ready']}/{workers} ready")
+
+    record = {
+        "metric": "rolling_deploy_failed_requests",
+        "value": 0,          # _run_http_load raised otherwise
+        "unit": "requests",
+        "workers": workers,
+        "concurrency": clients,
+        "duration_s": duration_s,
+        "n_requests": n,
+        "qps_through_deploy": round(qps, 1),
+        "p50_ms": round(p50 * 1e3, 2),
+        "p95_ms": round(p95 * 1e3, 2),
+        "reload_wall_s": reload_rec.get("reload_wall_s"),
+        "rolling_reloads_total": rolling,
+        "drain_observations": drain_count,
+        "drain_mean_s": (round(drain_sum / drain_count, 3)
+                         if drain_count else None),
+        "restarts_total": restarts,
+        "pool_status": {k: status[k] for k in
+                        ("target", "live", "ready", "rolling")},
+        "vs_baseline": None,
+    }
+    if emit:
+        print(json.dumps(record))
+    return record
+
+
 def bench_ingest(storage_spec: str = "", duration_s: float = 5.0,
                  n_threads: int = 8, batch_size: int = 50,
                  emit: bool = True):
@@ -1663,6 +1879,11 @@ if __name__ == "__main__":
                     help="backing store: memory | sqlite | sqlite:///path"
                          " | postgres://... (default: memory for "
                          "--serving, sqlite for --ingest)")
+    ap.add_argument("--rolling-deploy", action="store_true",
+                    help="zero-downtime drill: a supervised >=4-worker "
+                         "pool under sustained load through a mid-load "
+                         "POST /reload; fails on ANY non-200 answer and "
+                         "records the supervisor's drain receipts")
     ap.add_argument("--ingest", action="store_true",
                     help="concurrent event-server ingest events/s "
                          "(single + batch POSTs)")
@@ -1713,6 +1934,9 @@ if __name__ == "__main__":
         bench_serving(args.storage or "memory", workers=args.workers)
     elif args.serving_qps:
         bench_serving_qps(clients=CLIENT_LADDER[-1])
+    elif args.rolling_deploy:
+        bench_rolling_deploy(workers=args.workers if args.workers > 1 else 4,
+                             clients=CLIENT_LADDER[-1])
     elif args.ingest:
         bench_ingest(args.storage or "sqlite")
     elif args.ingest_qps:

@@ -1,0 +1,9 @@
+"""Runtime control plane: the supervisor that owns the worker-pool
+lifecycle (autoscaling, rolling deploys, self-healing) and the chaos
+gate that drills it."""
+
+from predictionio_tpu.runtime.supervisor import (  # noqa: F401
+    Supervisor,
+    SupervisorConfig,
+    run_worker_pool,
+)

@@ -1,0 +1,520 @@
+"""Chaos gate — CI drill that the supervisor control plane self-heals.
+
+Run via `python quality.py --chaos-gate`. Unlike the telemetry/serving/
+ingest gates (static scan + in-process runtime check) this gate is all
+runtime: it boots a real supervised SO_REUSEPORT pool in a subprocess —
+with a jax-free stub engine behind the REAL serving plane — and injures
+it the three ways the supervisor claims to survive:
+
+1. **Hard kill.** SIGKILL a ready worker; the pool must respawn it.
+2. **Slow worker.** The respawn comes up armed with
+   `serving.pre_dispatch=delay:500` (PIO_SUPERVISOR_WORKER_FAULTS keyed
+   by spawn index): every answer is a 200 that takes 500 ms, so only
+   the latency-SLO burn rule can see it. The supervisor must drain and
+   restart it.
+3. **Erroring worker.** The next respawn is armed with
+   `serving.pre_dispatch=error` (every query → 500); the error-ratio
+   rule must drain and restart it.
+
+The drill passes when the chain completes — a clean worker holds the
+slot, the pool is back to full ready capacity, `supervisor_restarts_total`
+shows all three causes, a post-recovery probe is all-200, and every
+worker's self-reported 5m burn is under the 14.4 page threshold.
+
+A second, separate pool is started with `PIO_FAULTS=worker.startup=error`
+(every spawn fails before ready): the per-slot circuit breakers must
+stop the crash loop after exactly `breaker_threshold` attempts per slot,
+with jittered-backoff gaps between attempts (asserted from the
+`supervisor: spawn ... t=` receipt timestamps), and the pool must exit 1.
+
+Exit code 0 when clean; 1 with one line per violation otherwise. The
+whole gate is budgeted well under 60 s; the long fault matrix lives in
+tests/test_supervisor.py behind `@pytest.mark.slow`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.client import HTTPConnection, HTTPException
+from typing import Dict, List, Optional
+
+from predictionio_tpu.serving import (
+    DeadlineExceeded,
+    ServingConfig,
+    ServingPlane,
+    ShedLoad,
+)
+from predictionio_tpu.utils.faults import FaultInjected
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+_SPAWN_RE = re.compile(
+    r"supervisor: spawn slot=(\d+) attempt=(\d+) spawn_index=(\d+) "
+    r"t=([0-9.]+)")
+_RESTART_RE = re.compile(
+    r'supervisor_restarts_total\{reason="([^"]+)"\} ([0-9.]+)')
+
+
+# ---------------------------------------------------------------------------
+# Stub worker factory (runs inside the pool's forked children)
+
+class StubPredictionServer(HttpService):
+    """A PredictionServer body-double: /queries.json served through the
+    REAL ServingPlane (admission control, micro-batching, and the
+    `serving.pre_dispatch` fault site) with a trivial dispatch, under
+    the production `server_name` so the default SLO objectives and the
+    supervisor's progress accounting apply unchanged — no jax, no
+    trained model, sub-second startup."""
+
+    def __init__(self, config, supervisor_pid: Optional[int] = None):
+        self.supervisor_pid = supervisor_pid
+        server = self
+
+        def _dispatch(queries: List) -> List:
+            return [{"stub": True} for _ in queries]
+
+        self.serving = ServingPlane(
+            _dispatch, config=ServingConfig.from_env(),
+            name="predictionserver")
+
+        class Handler(JsonRequestHandler):
+            server_version = "pio-tpu-chaos-stub/0.1"
+
+            def do_GET(self):
+                if self.path == "/":
+                    return self.send_json(200, {
+                        "status": "alive", "stub": True,
+                        "workerPid": os.getpid()})
+                return self.send_json(404, {"message": "Not Found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if self.path == "/queries.json":
+                    try:
+                        result, _degraded = server.serving.handle_query(
+                            json.loads(body or b"{}"), self.headers)
+                    except ShedLoad as e:
+                        return self.send_json(
+                            429, {"message": str(e)},
+                            headers={"Retry-After": f"{e.retry_after_s:g}"})
+                    except DeadlineExceeded as e:
+                        return self.send_json(503, {"message": str(e)})
+                    except FaultInjected as e:
+                        return self.send_json(500, {"message": str(e)})
+                    return self.send_json(200, result)
+                return self.send_json(404, {"message": "Not Found"})
+
+        super().__init__(config.ip, config.port, Handler, reuse_port=True,
+                         server_name="predictionserver")
+
+    def reload(self) -> None:
+        pass  # nothing versioned to swap; the drain mechanics still run
+
+    def health_check(self) -> bool:
+        return True
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        self.serving.close()
+
+
+def stub_factory(config, supervisor_pid):
+    return StubPredictionServer(config, supervisor_pid)
+
+
+def _pool_main(n_workers: int) -> int:
+    """`python -m predictionio_tpu.runtime.gate --pool N` — the drill
+    pool's entry point. A subprocess (not a thread) because the
+    supervisor installs signal handlers, which is main-thread-only."""
+    import types
+
+    from predictionio_tpu.runtime.supervisor import run_worker_pool
+
+    cfg = types.SimpleNamespace(ip="127.0.0.1", port=0)
+    return run_worker_pool(cfg, n_workers)
+
+
+# ---------------------------------------------------------------------------
+# Drill harness (runs in the gate process)
+
+class _Pool:
+    """Drill pool subprocess + captured output."""
+
+    def __init__(self, n_workers: int, env_extra: Dict[str, str]):
+        env = dict(os.environ)
+        env.pop("PIO_FAULTS", None)  # never inherit the gate's own faults
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(env_extra)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.runtime.gate",
+             "--pool", str(n_workers)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        self.lines: List[str] = []
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip("\n"))
+
+    def wait_line(self, needle: str, timeout_s: float) -> Optional[str]:
+        deadline = time.monotonic() + timeout_s
+        seen = 0
+        while time.monotonic() < deadline:
+            lines = self.lines
+            for i in range(seen, len(lines)):
+                if needle in lines[i]:
+                    return lines[i]
+            seen = len(lines)
+            if self.proc.poll() is not None and seen == len(self.lines):
+                return None
+            time.sleep(0.05)
+        return None
+
+    def spawn_receipts(self) -> List[Dict[str, float]]:
+        out = []
+        for line in list(self.lines):
+            m = _SPAWN_RE.search(line)
+            if m:
+                out.append({"slot": int(m.group(1)),
+                            "attempt": int(m.group(2)),
+                            "spawn_index": int(m.group(3)),
+                            "t": float(m.group(4))})
+        return out
+
+    def stop(self, timeout_s: float = 10.0) -> Optional[int]:
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+            return None
+
+
+def _get_json(port: int, path: str, timeout_s: float = 2.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout_s) as r:
+        return json.loads(r.read())
+
+
+def _restart_counts(control_port: int) -> Dict[str, int]:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{control_port}/metrics", timeout=2) as r:
+        text = r.read().decode()
+    return {m.group(1): int(float(m.group(2)))
+            for m in _RESTART_RE.finditer(text)}
+
+
+def _parse_port(line: str) -> int:
+    # "... on 127.0.0.1:12345 ..." → 12345
+    m = re.search(r"on [0-9.]+:(\d+)", line)
+    if m is None:
+        raise ValueError(f"no port in {line!r}")
+    return int(m.group(1))
+
+
+class _Load:
+    """Sustained POST /queries.json pressure from a few keep-alive
+    connections; records every response status in arrival order."""
+
+    def __init__(self, port: int, n_threads: int = 6):
+        self.port = port
+        self.stop_evt = threading.Event()
+        self.lock = threading.Lock()
+        self.statuses: List[int] = []
+        self.conn_errors = 0
+        self.threads = [threading.Thread(target=self._run, daemon=True)
+                        for _ in range(n_threads)]
+        for t in self.threads:
+            t.start()
+
+    def _run(self) -> None:
+        conn: Optional[HTTPConnection] = None
+        sent_on_conn = 0
+        body = b'{"drill": 1}'
+        while not self.stop_evt.is_set():
+            if conn is None:
+                conn = HTTPConnection("127.0.0.1", self.port, timeout=5)
+                sent_on_conn = 0
+            try:
+                conn.request("POST", "/queries.json", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                with self.lock:
+                    self.statuses.append(resp.status)
+                sent_on_conn += 1
+                if sent_on_conn >= 25:
+                    # recycle: SO_REUSEPORT balances CONNECTIONS, and a
+                    # respawned worker gets none of the parked keep-alive
+                    # ones — fresh connections keep every worker (the
+                    # fault-armed respawns included) under load
+                    conn.close()
+                    conn = None
+            except (OSError, HTTPException):
+                # a SIGKILL'd worker resets its parked connections — the
+                # drill expects (and counts) these
+                with self.lock:
+                    self.conn_errors += 1
+                try:
+                    conn.close()
+                finally:
+                    conn = None
+            self.stop_evt.wait(0.02)
+
+    def mark(self) -> int:
+        with self.lock:
+            return len(self.statuses)
+
+    def since(self, mark: int) -> List[int]:
+        with self.lock:
+            return self.statuses[mark:]
+
+    def stop(self) -> None:
+        self.stop_evt.set()
+        for t in self.threads:
+            t.join(timeout=5)
+
+
+_CHAOS_ENV = {
+    "PIO_SUPERVISOR_FACTORY": "predictionio_tpu.runtime.gate:stub_factory",
+    # spawn indices 0-3 are the initial clean pool; the respawn chain
+    # after the SIGKILL walks 4 (slow) → 5 (erroring) → 6 (clean)
+    "PIO_SUPERVISOR_WORKER_FAULTS":
+        "4:serving.pre_dispatch=delay:500;5:serving.pre_dispatch=error",
+    "PIO_SUPERVISOR_POLL_INTERVAL_S": "0.2",
+    "PIO_SUPERVISOR_HEARTBEAT_INTERVAL_S": "0.2",
+    "PIO_SUPERVISOR_HEARTBEAT_TIMEOUT_S": "3",
+    "PIO_SUPERVISOR_HANG_TIMEOUT_S": "2",
+    "PIO_SUPERVISOR_DRAIN_DEADLINE_S": "2",
+    "PIO_SUPERVISOR_BACKOFF_BASE_S": "0.2",
+    "PIO_SUPERVISOR_BACKOFF_CAP_S": "0.5",
+    # injected-fault restarts hit one slot back to back; a tiny rapid
+    # window keeps them from opening that slot's breaker (the breaker
+    # drill below covers the breaker on genuinely rapid failures)
+    "PIO_SUPERVISOR_RAPID_FAIL_S": "0.05",
+    "PIO_SUPERVISOR_ERROR_MIN_REQUESTS": "5",
+    "PIO_SUPERVISOR_ERROR_WINDOW_S": "2",
+    "PIO_SUPERVISOR_BURN_RESTART": "20",
+    "PIO_SUPERVISOR_BURN_GRACE_S": "0.5",
+}
+
+_BURN_PAGE = 14.4  # 5m fast-burn page threshold (docs/operations.md)
+
+
+def _chaos_drill() -> List[str]:
+    problems: List[str] = []
+    pool = _Pool(4, _CHAOS_ENV)
+    load: Optional[_Load] = None
+    try:
+        ready_line = pool.wait_line("Engine instance deployed on", 20)
+        ctl_line = pool.wait_line("Supervisor control endpoint on", 10)
+        if ready_line is None or ctl_line is None:
+            return [f"chaos: pool never became ready "
+                    f"(tail: {pool.lines[-5:]})"]
+        port = _parse_port(ready_line)
+        ctl_port = _parse_port(ctl_line)
+
+        load = _Load(port)
+        # warm-up: every initial worker serving, no surprise respawns
+        deadline = time.monotonic() + 10
+        warmed = False
+        while time.monotonic() < deadline:
+            st = _get_json(ctl_port, "/status.json")
+            workers = [w for w in st["workers"] if w["ready"]]
+            if (len(workers) == 4
+                    and all(w["completed"] > 0 for w in workers)):
+                warmed = True
+                break
+            time.sleep(0.2)
+        if not warmed:
+            problems.append("chaos: initial pool never served on all 4 "
+                            "workers under load")
+            return problems
+        if len(pool.spawn_receipts()) != 4:
+            problems.append(
+                f"chaos: unexpected respawn before the drill started "
+                f"({len(pool.spawn_receipts())} spawns)")
+            return problems
+
+        victim = next(w["pid"] for w in _get_json(
+            ctl_port, "/status.json")["workers"] if w["ready"])
+        t_kill = time.monotonic()
+        os.kill(victim, signal.SIGKILL)
+
+        # the respawn chain: killed → slow (burn restart) → erroring
+        # (error-rate restart) → clean; done when the index-6 worker is
+        # ready and the pool is back to 4/4
+        recovered_at = None
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            receipts = pool.spawn_receipts()
+            st = _get_json(ctl_port, "/status.json")
+            if (any(r["spawn_index"] >= 6 for r in receipts)
+                    and st["ready"] == 4 and st["live"] == 4):
+                recovered_at = time.monotonic()
+                break
+            time.sleep(0.25)
+        if recovered_at is None:
+            st = _get_json(ctl_port, "/status.json")
+            problems.append(
+                f"chaos: pool did not recover through the kill→slow→error "
+                f"chain within 45s (status: ready={st['ready']} "
+                f"spawns={len(pool.spawn_receipts())})")
+            return problems
+
+        restarts = _restart_counts(ctl_port)
+        for reason in ("crash", "slo_burn", "error_rate"):
+            if restarts.get(reason, 0) < 1:
+                problems.append(
+                    f"chaos: supervisor_restarts_total missing "
+                    f"reason={reason} (got {restarts})")
+
+        # post-recovery: the pool must answer clean again
+        tail_mark = load.mark()
+        time.sleep(1.5)
+        tail = load.since(tail_mark)
+        bad_tail = [s for s in tail if s != 200]
+        if not tail:
+            problems.append("chaos: no post-recovery traffic observed")
+        elif bad_tail:
+            problems.append(
+                f"chaos: {len(bad_tail)}/{len(tail)} non-200 answers "
+                f"AFTER capacity was restored: {sorted(set(bad_tail))}")
+
+        st = _get_json(ctl_port, "/status.json")
+        burns = {w["slot"]: w["burn5m"] for w in st["workers"]}
+        over = {s: b for s, b in burns.items() if b >= _BURN_PAGE}
+        if over:
+            problems.append(
+                f"chaos: worker 5m burn still at page level after "
+                f"recovery: {over} (threshold {_BURN_PAGE})")
+
+        load.stop()
+        load = None
+        rc = pool.stop()
+        if rc != 0:
+            problems.append(f"chaos: pool exit code {rc} after SIGTERM "
+                            f"(want 0)")
+        print(f"chaos drill: kill→slow→error chain recovered in "
+              f"{recovered_at - t_kill:.1f}s; restarts={restarts}; "
+              f"max burn5m={max(burns.values()):.2f}")
+    finally:
+        if load is not None:
+            load.stop()
+        pool.stop(timeout_s=5)
+    return problems
+
+
+_CRASH_ENV = {
+    "PIO_SUPERVISOR_FACTORY": "predictionio_tpu.runtime.gate:stub_factory",
+    "PIO_FAULTS": "worker.startup=error",
+    "PIO_SUPERVISOR_POLL_INTERVAL_S": "0.1",
+    "PIO_SUPERVISOR_BACKOFF_BASE_S": "0.2",
+    "PIO_SUPERVISOR_BACKOFF_CAP_S": "0.4",
+    "PIO_SUPERVISOR_BREAKER_THRESHOLD": "3",
+    "PIO_SUPERVISOR_BREAKER_RESET_S": "10",
+    "PIO_SUPERVISOR_PORT": "off",
+}
+
+
+def _crash_loop_drill() -> List[str]:
+    problems: List[str] = []
+    pool = _Pool(2, _CRASH_ENV)
+    try:
+        try:
+            rc = pool.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pool.stop(timeout_s=5)
+            return ["breaker: crash-looping pool still running after 30s "
+                    "(circuit breakers never failed it)"]
+        time.sleep(0.2)  # let the output pump drain
+        if rc != 1:
+            problems.append(f"breaker: crash-looping pool exited {rc} "
+                            f"(want 1)")
+        if pool.wait_line("pool startup failed (all circuit breakers open)",
+                          0.1) is None:
+            problems.append("breaker: missing the all-breakers-open "
+                            "fail-fast message")
+        if pool.wait_line("Deploy failed in worker", 0.1) is None:
+            problems.append("breaker: workers did not report the injected "
+                            "startup failure")
+
+        by_slot: Dict[int, List[Dict[str, float]]] = {}
+        for r in pool.spawn_receipts():
+            by_slot.setdefault(r["slot"], []).append(r)
+        if len(by_slot) != 2:
+            problems.append(f"breaker: expected 2 slots in spawn receipts, "
+                            f"got {sorted(by_slot)}")
+        for slot, rs in sorted(by_slot.items()):
+            attempts = [r["attempt"] for r in rs]
+            if attempts != [1, 2, 3]:
+                problems.append(
+                    f"breaker: slot {slot} made attempts {attempts} "
+                    f"(want exactly [1, 2, 3] then breaker open)")
+                continue
+            # jittered exponential backoff between attempts: the gap
+            # after failure k is at least half of base·2^(k−1) (the
+            # jitter's lower bound); receipts time the spawns, which
+            # only adds child lifetime on top
+            gap1 = rs[1]["t"] - rs[0]["t"]
+            gap2 = rs[2]["t"] - rs[1]["t"]
+            if gap1 < 0.08 or gap2 < 0.15:
+                problems.append(
+                    f"breaker: slot {slot} respawn gaps {gap1:.3f}s/"
+                    f"{gap2:.3f}s too short for backoff base 0.2s "
+                    f"(want ≥0.08/≥0.15)")
+            if f"supervisor: breaker open slot={slot}" not in "\n".join(
+                    pool.lines):
+                problems.append(f"breaker: slot {slot} never reported its "
+                                f"breaker opening")
+        n_spawns = len(pool.spawn_receipts())
+        if n_spawns > 6:
+            problems.append(f"breaker: {n_spawns} spawns for 2 slots × "
+                            f"threshold 3 — breaker did not bound the loop")
+    finally:
+        pool.stop(timeout_s=5)
+    return problems
+
+
+def run_gate() -> int:
+    t0 = time.monotonic()
+    problems: List[str] = []
+    try:
+        problems += _chaos_drill()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"chaos drill crashed: {e!r}")
+    try:
+        problems += _crash_loop_drill()
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"breaker drill crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"chaos gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s), {time.monotonic() - t0:.1f}s)")
+    return 1 if problems else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv[:1] == ["--pool"]:
+        return _pool_main(int(argv[1]))
+    return run_gate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

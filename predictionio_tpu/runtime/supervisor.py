@@ -1,0 +1,1091 @@
+"""Supervisor control plane for the SO_REUSEPORT serving pool.
+
+This module merges the lifecycle halves that used to be split between
+`workflow/worker_pool.py` (fork + reap) and `workflow/create_server.py`
+(serve + reload) into one control loop that owns the pool end to end —
+the ROADMAP item-5 refactor. Three responsibilities:
+
+**Autoscaling.** Workers heartbeat their admission in-flight count and
+their worst 5m `slo_*` burn rate over the supervisor pipe; the control
+tick resizes the pool within `[min_workers, max_workers]` — sustained
+queue pressure or elevated burn spawns a worker, sustained idleness
+drains one (SIGUSR2: stop accepting, finish in-flight, exit). While the
+pool is resizing the admission planes keep shedding with 429/503 +
+Retry-After, so resize never queues into collapse.
+
+**Rolling deploys.** `/reload` (or SIGHUP to the supervisor) swaps
+engine versions worker-by-worker with drain-then-reload semantics:
+SIGUSR1 makes one worker stop accepting (closing its listener removes
+it from the kernel's SO_REUSEPORT hash — new connections go to its
+peers; established keep-alive connections keep being served), wait for
+in-flight to hit zero or the drain deadline, hot-swap the served state,
+health-check `/metrics`, and re-open the listener (the supervisor's
+never-listening reservation socket guarantees the rebind). One worker
+at a time ⇒ a version swap under load completes with zero non-2xx
+responses — drilled by `tests/test_worker_pool.py` and
+`bench.py --rolling-deploy`.
+
+**Self-healing.** The ready-fd channel is now a persistent heartbeat
+pipe (40-byte atomic messages). The tick detects death (reaped), hang
+(heartbeat silence, or in-flight > 0 with zero completions past the
+hang timeout → SIGKILL), and sick workers (error-ratio or burn-rate
+over threshold → drain + restart). Restarts use jittered exponential
+backoff, and a per-slot circuit breaker opens after N rapid failures
+instead of crash-looping; a pool whose every slot trips its breaker
+before any worker was ever ready fails fast with exit code 1 (the old
+fail-fast contract, now with N retries of grace).
+
+Chaos drills for all of the above live in `runtime/gate.py`
+(`quality.py --chaos-gate`), armed through `utils/faults.py` runtime
+modes (`delay:<ms>`, `error`) and `PIO_SUPERVISOR_WORKER_FAULTS`.
+
+Everything is configured by `PIO_SUPERVISOR_*` env vars (table in
+docs/operations.md § Supervisor) so posture crosses the fork the same
+way the serving/ingest planes' env posture does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import logging
+import os
+import random
+import signal
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from predictionio_tpu.telemetry import middleware as telemetry_middleware
+from predictionio_tpu.telemetry import slo
+from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+log = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# Control-pipe protocol: worker → supervisor, fixed 40-byte messages.
+# Pipe writes ≤ PIPE_BUF (4096) are atomic, so concurrent writers (the
+# heartbeat thread and a drain thread) never interleave, and the reader
+# always gets whole messages.
+
+MSG_FMT = "!iiqqqq"  # (kind, pid, a, b, c, d)
+MSG_SIZE = struct.calcsize(MSG_FMT)
+
+MSG_READY = 1      # a = server port
+MSG_HEARTBEAT = 2  # a = in-flight, b = completed, c = bad, d = burn×1000
+MSG_RELOADED = 3   # a = drain ms, b = 1 healthy / 0 failed
+MSG_DRAINED = 4    # a = drain ms (scale-down drain finished, exiting)
+
+# legacy alias kept for the old ready-mark name used around the tree
+_READY_FMT = MSG_FMT
+
+
+def pack_msg(kind: int, pid: int, a: int = 0, b: int = 0, c: int = 0,
+             d: int = 0) -> bytes:
+    return struct.pack(MSG_FMT, kind, pid, a, b, c, d)
+
+
+def unpack_msg(buf: bytes) -> Tuple[int, int, int, int, int, int]:
+    return struct.unpack(MSG_FMT, buf)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry. The worker_pool_* family keeps its historical names (dashboards
+# and tests read them); the supervisor_* family is the new control-plane
+# view required by the runbook.
+
+POOL_WORKERS = REGISTRY.gauge(
+    "worker_pool_workers", "Live workers in the SO_REUSEPORT pool")
+POOL_SPAWNED = REGISTRY.counter(
+    "worker_pool_spawned_total", "Workers forked over the pool's lifetime")
+POOL_RESPAWNS = REGISTRY.counter(
+    "worker_pool_respawns_total", "Workers respawned after dying ready")
+POOL_STARTUP_FAILURES = REGISTRY.counter(
+    "worker_pool_startup_failures_total",
+    "Workers that died before ever becoming ready")
+
+SUP_WORKERS = REGISTRY.gauge(
+    "supervisor_workers",
+    "Pool size by state (target = slots, live = forked, ready = serving)",
+    labelnames=("state",))
+SUP_RESTARTS = REGISTRY.counter(
+    "supervisor_restarts_total",
+    "Worker restarts initiated by the supervisor, by detected cause",
+    labelnames=("reason",))
+SUP_SCALE_EVENTS = REGISTRY.counter(
+    "supervisor_scale_events_total",
+    "Autoscaler resize decisions", labelnames=("direction",))
+SUP_DRAIN_SECONDS = REGISTRY.histogram(
+    "supervisor_drain_seconds",
+    "Time a worker spent draining (accept paused → reloaded/exited)")
+SUP_BREAKER_STATE = REGISTRY.gauge(
+    "supervisor_breaker_state",
+    "Per-slot circuit breaker (0 closed, 1 open, 2 half-open)",
+    labelnames=("slot",))
+SUP_ROLLING = REGISTRY.counter(
+    "supervisor_rolling_reloads_total",
+    "Rolling (worker-by-worker drain-then-reload) deploys started")
+
+
+# ---------------------------------------------------------------------------
+# Config
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env(name: str) -> Optional[str]:
+    return os.environ.get(f"PIO_SUPERVISOR_{name}")
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    """Pool posture; every field resolves from `PIO_SUPERVISOR_<FIELD>`
+    (upper-cased) so it crosses the fork like the serving/ingest env
+    posture does. min/max_workers of 0 mean "the --workers count"."""
+
+    min_workers: int = 0
+    max_workers: int = 0
+    poll_interval_s: float = 1.0       # control tick
+    heartbeat_interval_s: float = 0.5  # worker → supervisor
+    heartbeat_timeout_s: float = 5.0   # silence ⇒ process wedged ⇒ SIGKILL
+    hang_timeout_s: float = 4.0        # in-flight>0, no completions ⇒ hung
+    drain_deadline_s: float = 5.0      # max wait for in-flight to reach 0
+    breaker_threshold: int = 3         # rapid failures before breaker opens
+    breaker_reset_s: float = 30.0      # open → half-open retry window
+    backoff_base_s: float = 0.5        # jittered exponential respawn backoff
+    backoff_cap_s: float = 8.0
+    rapid_fail_s: float = 30.0         # died sooner than this after ready ⇒ rapid
+    scale_up_util: float = 0.5         # avg in-flight / queue budget
+    scale_down_util: float = 0.05
+    scale_up_burn: float = 6.0         # avg 5m burn that triggers scale-up
+    scale_stable_ticks: int = 2        # consecutive ticks before scaling up
+    scale_down_stable_s: float = 30.0  # sustained idleness before scale-down
+    error_restart_ratio: float = 0.5   # bad/total over the error window
+    error_min_requests: int = 8        # min window traffic for ratio/burn rules
+    error_window_s: float = 5.0
+    burn_restart: float = 30.0         # worker 5m burn that forces a restart
+    burn_grace_s: float = 2.0          # ignore burn this soon after ready
+    control_ip: str = "127.0.0.1"
+    control_port: Optional[int] = 0    # None disables the control endpoint
+    worker_faults: str = ""            # "spawn_idx:PIO_FAULTS-spec;..." (drills)
+
+    @classmethod
+    def from_env(cls) -> "SupervisorConfig":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            if f.name == "control_port":
+                continue
+            raw = _env(f.name.upper())
+            if raw is None:
+                continue
+            try:
+                if f.type in ("int", int):
+                    setattr(cfg, f.name, int(raw))
+                elif f.type in ("float", float):
+                    setattr(cfg, f.name, float(raw))
+                else:
+                    setattr(cfg, f.name, raw)
+            except ValueError:
+                log.warning("ignoring unparseable PIO_SUPERVISOR_%s=%r",
+                            f.name.upper(), raw)
+        raw = _env("PORT")
+        if raw is not None:
+            raw = raw.strip().lower()
+            if raw in ("off", "none", "disabled"):
+                cfg.control_port = None
+            else:
+                try:
+                    port = int(raw)
+                    cfg.control_port = None if port < 0 else port
+                except ValueError:
+                    log.warning("ignoring unparseable PIO_SUPERVISOR_PORT=%r",
+                                raw)
+        return cfg
+
+
+def parse_worker_faults(spec: str) -> Dict[int, str]:
+    """`"4:serving.pre_dispatch=delay:500;5:worker.startup"` →
+    {4: "serving.pre_dispatch=delay:500", 5: "worker.startup"} — a
+    PIO_FAULTS value keyed by global spawn index, set in that child's
+    environment only. The chaos gate uses this to arm the Nth respawn."""
+    out: Dict[int, str] = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        idx, _, fault = part.partition(":")
+        out[int(idx)] = fault
+    return out
+
+
+def backoff_s(failures: int, base_s: float, cap_s: float,
+              rng: Optional[random.Random] = None) -> float:
+    """Jittered (±50%) exponential backoff: base·2^(failures−1), capped.
+    Full jitter on the high half so simultaneous crashers decorrelate."""
+    r = rng or random
+    raw = min(cap_s, base_s * (2 ** max(0, failures - 1)))
+    return raw * (0.5 + r.random())
+
+
+class CircuitBreaker:
+    """Per-slot crash-loop protection. `record_failure` counts rapid
+    failures; after `threshold` the breaker opens for `reset_s` (no
+    spawns). The first spawn after the window is the half-open probe;
+    a READY mark closes the breaker."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+    def __init__(self, threshold: int, reset_s: float):
+        self.threshold = threshold
+        self.reset_s = reset_s
+        self.failures = 0
+        self.open_until = 0.0
+        self.half_open = False
+
+    def record_failure(self, now: float, rapid: bool) -> None:
+        self.failures = self.failures + 1 if rapid else 1
+        self.half_open = False
+        if self.failures >= self.threshold:
+            self.open_until = now + self.reset_s
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.open_until = 0.0
+        self.half_open = False
+
+    def allows_spawn(self, now: float) -> bool:
+        if now < self.open_until:
+            return False
+        if self.open_until:
+            self.half_open = True  # probing after the open window
+        return True
+
+    def state(self, now: float) -> int:
+        if now < self.open_until:
+            return self.OPEN
+        if self.half_open:
+            return self.HALF_OPEN
+        return self.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Worker side
+
+def _resolve_factory():
+    """`PIO_SUPERVISOR_FACTORY=module:callable` overrides the server the
+    workers build — the chaos gate injects a stub that serves through the
+    real ServingPlane without loading jax or a trained model. Returns
+    (factory, is_default)."""
+    spec = os.environ.get("PIO_SUPERVISOR_FACTORY", "").strip()
+    if spec:
+        mod, _, attr = spec.partition(":")
+        return getattr(importlib.import_module(mod), attr), False
+
+    def _default(config, supervisor_pid):
+        from predictionio_tpu.workflow.create_server import PredictionServer
+        return PredictionServer(config, reuse_port=True,
+                                supervisor_pid=supervisor_pid)
+
+    return _default, True
+
+
+def _query_totals(server_name: str) -> Tuple[int, int]:
+    """(completed, bad) request totals for this worker's /queries.json,
+    summed from the registry. Only the query route counts as progress:
+    `/metrics` scrapes and `GET /` probes are served by independent
+    handler threads and would mask a hung dispatch."""
+    fam = REGISTRY.get("http_requests_total")
+    total = bad = 0
+    if fam is None:
+        return 0, 0
+    for key, value in fam.collect():
+        srv, _method, route, status = key
+        if srv != server_name or route != "/queries.json":
+            continue
+        n = int(value)
+        total += n
+        try:
+            code = int(status)
+        except ValueError:
+            continue
+        if code >= 500 or code in (429, 503):
+            bad += n
+    return total, bad
+
+
+class _CtlChannel:
+    """Serialized writes on the worker's end of the control pipe."""
+
+    def __init__(self, fd: int):
+        self._fd = fd
+        self._lock = threading.Lock()
+
+    def send(self, kind: int, a: int = 0, b: int = 0, c: int = 0,
+             d: int = 0) -> None:
+        msg = pack_msg(kind, os.getpid(), a, b, c, d)
+        try:
+            with self._lock:
+                os.write(self._fd, msg)
+        except OSError:
+            pass  # supervisor gone; SIGTERM will follow
+
+
+def _worker_main(config, supervisor_pid: int, ctl_fd: int,
+                 cfg: SupervisorConfig) -> int:
+    """Runs inside a forked child: build the server, report readiness,
+    heartbeat, serve until told to stop.
+
+    Signals: SIGTERM → graceful stop; SIGHUP → plain hot reload;
+    SIGUSR1 → drain-then-reload in place (rolling deploy leg);
+    SIGUSR2 → drain-then-exit (scale-down)."""
+    ctl = _CtlChannel(ctl_fd)
+    try:
+        faults.inject("worker.startup")  # crash-loop / breaker drills
+        factory, is_default = _resolve_factory()
+        server = factory(config, supervisor_pid)
+    except Exception as e:
+        print(f"Deploy failed in worker {os.getpid()}: {e}", file=sys.stderr)
+        sys.stderr.flush()
+        os.close(ctl_fd)
+        return 1
+
+    stop = threading.Event()
+    name = server.server_name
+    in_flight_child = telemetry_middleware.HTTP_IN_FLIGHT.labels(server=name)
+
+    def _serving_in_flight() -> int:
+        plane = getattr(server, "serving", None)
+        return plane.admission.admitted if plane is not None else 0
+
+    def _quiesce(deadline_s: float) -> None:
+        # Request quiescence, not connection count: established keep-alive
+        # connections stay parked on this worker — what must reach zero is
+        # work in progress (HTTP handlers + admitted queries).
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if in_flight_child.value <= 0 and _serving_in_flight() <= 0:
+                return
+            time.sleep(0.02)
+
+    def _healthy() -> bool:
+        # the /metrics health-check: the exact text a scrape would see
+        # must render, and the server-specific check (served state
+        # present) must pass, before the worker re-enters the pool
+        try:
+            slo.refresh()
+            if not REGISTRY.render():
+                return False
+            check = getattr(server, "health_check", None)
+            return bool(check()) if check is not None else True
+        except Exception:
+            log.exception("health check failed")
+            return False
+
+    def _do_drain_reload() -> None:
+        t0 = time.monotonic()
+        ok = 1
+        try:
+            server.pause_accept()
+            _quiesce(cfg.drain_deadline_s)
+            try:
+                server.reload()
+            except Exception:
+                log.exception("drain-reload: reload failed; serving the "
+                              "previous instance")
+                ok = 0
+            if not _healthy():
+                ok = 0
+            server.resume_accept()
+        except Exception:
+            # a worker that cannot re-open its listener is dead weight;
+            # exit nonzero and let the supervisor respawn a fresh one
+            log.exception("drain-reload failed fatally; exiting for respawn")
+            os._exit(1)
+        ctl.send(MSG_RELOADED, int((time.monotonic() - t0) * 1000), ok)
+
+    def _do_drain_exit() -> None:
+        t0 = time.monotonic()
+        try:
+            server.pause_accept()
+            _quiesce(cfg.drain_deadline_s)
+        except Exception:
+            log.exception("drain-exit: pause failed; exiting anyway")
+        ctl.send(MSG_DRAINED, int((time.monotonic() - t0) * 1000))
+        stop.set()
+
+    def _sig_thread(fn):
+        # signal handlers run between bytecodes on the main thread; the
+        # actual work happens off-thread so serving never blocks
+        def handler(signum, frame):
+            threading.Thread(target=fn, daemon=True).start()
+        return handler
+
+    signal.signal(signal.SIGHUP, _sig_thread(server.reload))
+    signal.signal(signal.SIGUSR1, _sig_thread(_do_drain_reload))
+    signal.signal(signal.SIGUSR2, _sig_thread(_do_drain_exit))
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    signal.signal(signal.SIGINT, lambda s, f: stop.set())
+
+    def _heartbeat_loop() -> None:
+        while not stop.is_set():
+            completed, bad = _query_totals(name)
+            burn, _ = slo.current_burn(name, "/queries.json")
+            ctl.send(MSG_HEARTBEAT, _serving_in_flight(), completed, bad,
+                     int(burn * 1000))
+            stop.wait(cfg.heartbeat_interval_s)
+
+    ctl.send(MSG_READY, server.port)
+    server.start()
+    threading.Thread(target=_heartbeat_loop, daemon=True,
+                     name="supervisor-heartbeat").start()
+    stop.wait()
+    server.shutdown()
+    if is_default:
+        from predictionio_tpu.storage.registry import Storage
+        Storage.get().close()
+    sys.stdout.flush()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor side
+
+class _Slot:
+    """One worker seat: current process, heartbeat view, breaker."""
+
+    def __init__(self, idx: int, cfg: SupervisorConfig):
+        self.idx = idx
+        self.breaker = CircuitBreaker(cfg.breaker_threshold,
+                                      cfg.breaker_reset_s)
+        self.pid: Optional[int] = None
+        self.spawn_index = -1
+        self.ready = False
+        self.port = 0
+        self.spawned_at = 0.0
+        self.ready_at = 0.0
+        self.next_spawn_at: Optional[float] = 0.0  # None = no spawn pending
+        self.draining_out = False   # scale-down in progress
+        self.rolling = False        # drain-reload in progress
+        self.reload_evt: Optional[threading.Event] = None
+        self.kill_at: Optional[float] = None  # SIGTERM → SIGKILL escalation
+        self.kill_reason: Optional[str] = None
+        # heartbeat view
+        self.last_hb = 0.0
+        self.in_flight = 0
+        self.completed = 0
+        self.bad = 0
+        self.burn = 0.0
+        self.progress_at = 0.0
+        # (completed, bad) snapshots for the error-ratio window
+        self.window: List[Tuple[int, int]] = []
+
+    def reset_process_view(self) -> None:
+        self.pid = None
+        self.ready = False
+        self.port = 0
+        self.rolling = False
+        self.kill_at = None
+        self.in_flight = 0
+        self.completed = 0
+        self.bad = 0
+        self.burn = 0.0
+        self.window = []
+        if self.reload_evt is not None:
+            self.reload_evt.set()  # don't stall a roll on a dead worker
+
+
+class Supervisor:
+    """Owns the pool: reservation socket, fork/reap, heartbeats, the
+    control tick (self-heal, autoscale, rolling deploys), and the
+    control endpoint. `run()` blocks until shutdown and returns the
+    `pio deploy` exit code."""
+
+    def __init__(self, config, n_workers: int,
+                 cfg: Optional[SupervisorConfig] = None):
+        self.config = config
+        self.cfg = cfg or SupervisorConfig.from_env()
+        if self.cfg.min_workers <= 0:
+            self.cfg.min_workers = n_workers
+        if self.cfg.max_workers <= 0:
+            self.cfg.max_workers = max(n_workers, self.cfg.min_workers)
+        self.n_workers = max(n_workers, 1)
+        self._lock = threading.Lock()
+        self._slots: List[_Slot] = []
+        self._by_pid: Dict[int, _Slot] = {}
+        self._slot_seq = 0
+        self._spawn_counter = 0
+        self._worker_faults = parse_worker_faults(self.cfg.worker_faults)
+        self._rng = random.Random()
+        self._shutting_down = False
+        self._ever_ready = False
+        self._roll_requested = False
+        self._rolling = False
+        self._done = threading.Event()
+        self._ready_evt = threading.Event()
+        self._exit_code = 0
+        self._up_ticks = 0
+        self._down_since: Optional[float] = None
+        self._reservation: Optional[socket.socket] = None
+        self._read_fd = -1
+        self._write_fd = -1
+        self._control: Optional[HttpService] = None
+        # per-worker serving queue budget, for the utilization signal
+        try:
+            self._queue_budget = max(
+                1, int(float(os.environ.get("PIO_SERVING_MAX_QUEUE", 256))))
+        except ValueError:
+            self._queue_budget = 256
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> int:
+        if not hasattr(socket, "SO_REUSEPORT"):
+            print("--workers needs SO_REUSEPORT (Linux); this platform "
+                  "lacks it", file=sys.stderr)
+            return 1
+
+        # port reservation: bound with SO_REUSEPORT but NEVER listening, so
+        # the kernel excludes it from load balancing while guaranteeing the
+        # port stays ours across worker respawns and paused accepts
+        self._reservation = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._reservation.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        try:
+            self._reservation.bind((self.config.ip, self.config.port))
+        except OSError as e:
+            print(f"Cannot bind {self.config.ip}:{self.config.port}: "
+                  f"{e.strerror or e}", file=sys.stderr)
+            return 1
+        self.config.port = self._reservation.getsockname()[1]
+
+        self._read_fd, self._write_fd = os.pipe()
+
+        for _ in range(self.n_workers):
+            self._add_slot()
+
+        reader = threading.Thread(target=self._reader_loop, daemon=True,
+                                  name="supervisor-reader")
+        reader.start()
+
+        signal.signal(signal.SIGTERM, self._on_term)
+        signal.signal(signal.SIGINT, self._on_term)
+        signal.signal(signal.SIGHUP, self._on_hup)
+
+        if self.cfg.control_port is not None:
+            try:
+                self._control = HttpService(
+                    self.cfg.control_ip, self.cfg.control_port,
+                    self._control_handler(), server_name="supervisor")
+                self._control.start()
+                print(f"Supervisor control endpoint on "
+                      f"{self.cfg.control_ip}:{self._control.port}",
+                      flush=True)
+            except OSError as e:
+                log.warning("control endpoint disabled: %s", e)
+                self._control = None
+
+        tick = threading.Thread(target=self._tick_loop, daemon=True,
+                                name="supervisor-tick")
+        tick.start()
+
+        try:
+            while True:
+                try:
+                    pid, status = os.wait()
+                except ChildProcessError:
+                    if self._done.wait(0.05):
+                        break
+                    continue
+                except InterruptedError:
+                    continue
+                self._on_death(pid, status)
+                if self._done.is_set() and not self._by_pid:
+                    break
+        finally:
+            self._done.set()
+            for fd in (self._write_fd, self._read_fd):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            self._reservation.close()
+            if self._control is not None:
+                try:
+                    self._control.shutdown()
+                except Exception:
+                    pass
+        return self._exit_code
+
+    def _add_slot(self) -> _Slot:
+        slot = _Slot(self._slot_seq, self.cfg)
+        self._slot_seq += 1
+        with self._lock:
+            self._slots.append(slot)
+        return slot
+
+    # -- signals -----------------------------------------------------------
+
+    def _on_term(self, signum, frame):
+        self._shutting_down = True
+        self._broadcast(signal.SIGTERM)
+        if not self._by_pid:
+            self._done.set()
+
+    def _on_hup(self, signum, frame):
+        self._roll_requested = True
+
+    def _broadcast(self, signum: int) -> None:
+        for pid in list(self._by_pid):
+            try:
+                os.kill(pid, signum)
+            except ProcessLookupError:
+                pass
+
+    # -- fork / reap -------------------------------------------------------
+
+    def _spawn(self, slot: _Slot) -> None:
+        spawn_index = self._spawn_counter
+        self._spawn_counter += 1
+        fault_spec = self._worker_faults.get(spawn_index)
+        attempt = slot.breaker.failures + 1
+        # parseable spawn receipt: the chaos gate asserts backoff gaps and
+        # bounded attempt counts from these timestamps
+        print(f"supervisor: spawn slot={slot.idx} attempt={attempt} "
+              f"spawn_index={spawn_index} t={time.monotonic():.3f}",
+              flush=True)
+        pid = os.fork()
+        if pid == 0:
+            # child: the fork inherits the supervisor's handlers — reset
+            # them FIRST, or a SIGTERM landing during the slow model load
+            # would re-broadcast instead of dying. SIGHUP/SIGUSR1/SIGUSR2
+            # are IGNORED (not SIG_DFL) until the server is up: a routine
+            # roll racing this worker's multi-second model load must not
+            # kill it — it loads the newest instance anyway; _worker_main
+            # installs the real handlers once the server is built.
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, signal.SIG_DFL)
+            for sig in (signal.SIGHUP, signal.SIGUSR1, signal.SIGUSR2):
+                signal.signal(sig, signal.SIG_IGN)
+            if fault_spec is not None:
+                os.environ["PIO_FAULTS"] = fault_spec
+            os.close(self._read_fd)
+            self._reservation.close()
+            if self._control is not None:
+                # don't hold the control listener open in workers
+                try:
+                    self._control.httpd.socket.close()
+                except OSError:
+                    pass
+            code = 1
+            try:
+                code = _worker_main(self.config, os.getppid(),
+                                    self._write_fd, self.cfg)
+            finally:
+                os._exit(code)
+        now = time.monotonic()
+        slot.pid = pid
+        slot.spawn_index = spawn_index
+        slot.ready = False
+        slot.spawned_at = now
+        slot.last_hb = now
+        slot.progress_at = now
+        slot.next_spawn_at = None
+        with self._lock:
+            self._by_pid[pid] = slot
+        POOL_SPAWNED.inc()
+        self._update_gauges()
+
+    def _on_death(self, pid: int, status: int) -> None:
+        with self._lock:
+            slot = self._by_pid.get(pid)
+        if slot is None:
+            return
+        if not slot.ready:
+            # readiness arrives via the pipe's reader THREAD while deaths
+            # are reaped synchronously here: a worker that wrote its ready
+            # mark and died moments later must not be misread as a startup
+            # failure — give the reader a beat to drain the mark
+            time.sleep(0.2)
+        with self._lock:
+            self._by_pid.pop(pid, None)
+        rc = (os.waitstatus_to_exitcode(status)
+              if hasattr(os, "waitstatus_to_exitcode") else status)
+        was_ready = slot.ready
+        now = time.monotonic()
+
+        if self._shutting_down:
+            slot.reset_process_view()
+            self._update_gauges()
+            if not self._by_pid:
+                self._done.set()
+            return
+
+        if slot.draining_out:
+            # intentional scale-down exit — not a failure
+            log.info("worker %d drained out (scale-down, rc=%s)", pid, rc)
+            slot.reset_process_view()
+            with self._lock:
+                if slot in self._slots:
+                    self._slots.remove(slot)
+            SUP_BREAKER_STATE.labels(slot=str(slot.idx)).set(0)
+            self._update_gauges()
+            return
+
+        reason = slot.kill_reason or ("crash" if was_ready else "startup")
+        slot.kill_reason = None
+        if was_ready:
+            log.warning("worker %d died (%s) — respawning [%s]",
+                        pid, rc, reason)
+            POOL_RESPAWNS.inc()
+        else:
+            log.error("worker %d failed at startup (%s)", pid, rc)
+            POOL_STARTUP_FAILURES.inc()
+        SUP_RESTARTS.labels(reason=reason).inc()
+
+        rapid = (not was_ready) or (now - slot.ready_at < self.cfg.rapid_fail_s)
+        slot.breaker.record_failure(now, rapid)
+        slot.reset_process_view()
+        if slot.breaker.failures >= self.cfg.breaker_threshold:
+            slot.next_spawn_at = slot.breaker.open_until
+            print(f"supervisor: breaker open slot={slot.idx} "
+                  f"failures={slot.breaker.failures} "
+                  f"retry_in={slot.breaker.open_until - now:.1f}s "
+                  f"t={now:.3f}", flush=True)
+        else:
+            delay = backoff_s(slot.breaker.failures, self.cfg.backoff_base_s,
+                              self.cfg.backoff_cap_s, self._rng)
+            slot.next_spawn_at = now + delay
+            print(f"supervisor: respawn slot={slot.idx} "
+                  f"failures={slot.breaker.failures} in={delay:.2f}s "
+                  f"t={now:.3f}", flush=True)
+        self._update_gauges()
+
+    # -- pipe reader -------------------------------------------------------
+
+    def _reader_loop(self) -> None:
+        while True:
+            try:
+                buf = os.read(self._read_fd, MSG_SIZE)
+            except OSError:
+                return
+            if len(buf) != MSG_SIZE:
+                return  # EOF / teardown
+            kind, pid, a, b, c, d = unpack_msg(buf)
+            with self._lock:
+                slot = self._by_pid.get(pid)
+            if slot is None:
+                continue
+            now = time.monotonic()
+            if kind == MSG_READY:
+                slot.ready = True
+                slot.port = a
+                slot.ready_at = now
+                slot.last_hb = now
+                slot.progress_at = now
+                slot.breaker.record_success()
+                SUP_BREAKER_STATE.labels(slot=str(slot.idx)).set(0)
+                self._ever_ready = True
+                self._update_gauges()
+                if not self._ready_evt.is_set():
+                    self._ready_evt.set()
+                    # announced from here (not the reap loop, which must
+                    # keep reaping — a pool whose workers all fail at
+                    # startup would otherwise block on a readiness that
+                    # never comes)
+                    print(f"Engine instance deployed on "
+                          f"{self.config.ip}:{self.config.port} "
+                          f"(workers: {self.n_workers})", flush=True)
+            elif kind == MSG_HEARTBEAT:
+                slot.last_hb = now
+                if b != slot.completed or a == 0:
+                    slot.progress_at = now
+                slot.in_flight, slot.completed, slot.bad = a, b, c
+                slot.burn = d / 1000.0
+            elif kind == MSG_RELOADED:
+                SUP_DRAIN_SECONDS.observe(a / 1000.0)
+                if not b:
+                    log.warning("worker %d finished drain-reload unhealthy",
+                                pid)
+                if slot.reload_evt is not None:
+                    slot.reload_evt.set()
+            elif kind == MSG_DRAINED:
+                SUP_DRAIN_SECONDS.observe(a / 1000.0)
+
+    # -- control tick ------------------------------------------------------
+
+    def _tick_loop(self) -> None:
+        while not self._done.is_set():
+            try:
+                if not self._shutting_down:
+                    self._spawn_due()
+                    self._check_health()
+                    self._maybe_roll()
+                    self._autoscale()
+                self._decide_exit()
+            except Exception:
+                log.exception("supervisor tick failed")
+            self._done.wait(self.cfg.poll_interval_s)
+
+    def _spawn_due(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            slots = list(self._slots)
+        for slot in slots:
+            if (slot.pid is None and slot.next_spawn_at is not None
+                    and now >= slot.next_spawn_at
+                    and slot.breaker.allows_spawn(now)):
+                if slot.breaker.half_open:
+                    SUP_BREAKER_STATE.labels(slot=str(slot.idx)).set(2)
+                self._spawn(slot)
+
+    def _check_health(self) -> None:
+        cfg = self.cfg
+        now = time.monotonic()
+        with self._lock:
+            slots = list(self._slots)
+        for slot in slots:
+            pid = slot.pid
+            if pid is None:
+                continue
+            if slot.kill_at is not None:
+                if now >= slot.kill_at:
+                    # graceful drain overstayed its deadline
+                    log.warning("worker %d ignored its drain deadline — "
+                                "SIGKILL", pid)
+                    self._kill(pid, signal.SIGKILL)
+                    slot.kill_at = None
+                continue
+            if not slot.ready or slot.draining_out or slot.rolling:
+                continue
+            hb_age = now - slot.last_hb
+            stalled = (slot.in_flight > 0
+                       and now - slot.progress_at > cfg.hang_timeout_s)
+            if hb_age > cfg.heartbeat_timeout_s or stalled:
+                why = ("heartbeat silent %.1fs" % hb_age
+                       if hb_age > cfg.heartbeat_timeout_s else
+                       "in-flight %d stalled %.1fs"
+                       % (slot.in_flight, now - slot.progress_at))
+                log.warning("worker %d hung (%s) — SIGKILL", pid, why)
+                slot.kill_reason = "hang"
+                self._kill(pid, signal.SIGKILL)
+                continue
+            # error-ratio over a short trailing window (erroring worker)
+            slot.window.append((slot.completed, slot.bad))
+            max_len = max(2, int(cfg.error_window_s / cfg.poll_interval_s))
+            if len(slot.window) > max_len:
+                slot.window = slot.window[-max_len:]
+            d_total = slot.completed - slot.window[0][0]
+            d_bad = slot.bad - slot.window[0][1]
+            if (d_total >= cfg.error_min_requests
+                    and d_bad / d_total >= cfg.error_restart_ratio):
+                log.warning("worker %d erroring (%d/%d bad in window) — "
+                            "restarting", pid, d_bad, d_total)
+                slot.kill_reason = "error_rate"
+                self._restart_gracefully(slot, now)
+                continue
+            # burn-rate rule (slow worker: latency burn pages long before
+            # availability does — a delay:500 worker answers only 200s)
+            if (slot.burn >= cfg.burn_restart
+                    and slot.completed >= cfg.error_min_requests
+                    and now - slot.ready_at > cfg.burn_grace_s):
+                log.warning("worker %d burning SLO budget (5m burn %.1f) — "
+                            "restarting", pid, slot.burn)
+                slot.kill_reason = "slo_burn"
+                self._restart_gracefully(slot, now)
+
+    def _restart_gracefully(self, slot: _Slot, now: float) -> None:
+        self._kill(slot.pid, signal.SIGTERM)
+        slot.kill_at = now + self.cfg.drain_deadline_s + 2.0
+
+    def _kill(self, pid: Optional[int], signum: int) -> None:
+        if pid is None:
+            return
+        try:
+            os.kill(pid, signum)
+        except ProcessLookupError:
+            pass
+
+    # -- rolling deploy ----------------------------------------------------
+
+    def _maybe_roll(self) -> None:
+        if self._roll_requested:
+            self._roll_requested = False
+            if not self._rolling:
+                self._rolling = True
+                threading.Thread(target=self._roll, daemon=True,
+                                 name="supervisor-roll").start()
+
+    def _roll(self) -> None:
+        try:
+            SUP_ROLLING.inc()
+            print("supervisor: rolling reload started", flush=True)
+            with self._lock:
+                slots = list(self._slots)
+            for slot in slots:
+                if self._shutting_down or self._done.is_set():
+                    break
+                pid = slot.pid
+                if pid is None or not slot.ready or slot.draining_out:
+                    continue  # a fresh spawn loads the newest instance anyway
+                slot.rolling = True
+                slot.reload_evt = threading.Event()
+                try:
+                    os.kill(pid, signal.SIGUSR1)
+                except ProcessLookupError:
+                    slot.rolling = False
+                    continue
+                ok = slot.reload_evt.wait(self.cfg.drain_deadline_s + 10.0)
+                slot.rolling = False
+                slot.reload_evt = None
+                if not ok:
+                    log.warning("worker %d never acked drain-reload", pid)
+            print("supervisor: rolling reload complete", flush=True)
+        finally:
+            self._rolling = False
+
+    # -- autoscaling -------------------------------------------------------
+
+    def _autoscale(self) -> None:
+        if self._rolling:
+            return
+        cfg = self.cfg
+        now = time.monotonic()
+        with self._lock:
+            slots = list(self._slots)
+        ready = [s for s in slots if s.ready and s.pid is not None
+                 and not s.draining_out]
+        if not ready:
+            return
+        util = (sum(s.in_flight for s in ready) / len(ready)
+                / self._queue_budget)
+        avg_burn = sum(s.burn for s in ready) / len(ready)
+
+        if (len(slots) < cfg.max_workers
+                and (util >= cfg.scale_up_util
+                     or avg_burn >= cfg.scale_up_burn)):
+            self._up_ticks += 1
+            if self._up_ticks >= cfg.scale_stable_ticks:
+                self._up_ticks = 0
+                slot = self._add_slot()
+                slot.next_spawn_at = now
+                SUP_SCALE_EVENTS.labels(direction="up").inc()
+                print(f"supervisor: scale up → {len(slots) + 1} slots "
+                      f"(util={util:.2f} burn={avg_burn:.1f})", flush=True)
+        else:
+            self._up_ticks = 0
+
+        can_shrink = (len([s for s in slots if not s.draining_out])
+                      > cfg.min_workers)
+        if (can_shrink and util <= cfg.scale_down_util and avg_burn < 1.0):
+            if self._down_since is None:
+                self._down_since = now
+            elif now - self._down_since >= cfg.scale_down_stable_s:
+                self._down_since = None
+                victim = ready[-1]
+                victim.draining_out = True
+                victim.kill_at = now + cfg.drain_deadline_s + 5.0
+                SUP_SCALE_EVENTS.labels(direction="down").inc()
+                print(f"supervisor: scale down → draining worker "
+                      f"{victim.pid} (slot {victim.idx})", flush=True)
+                self._kill(victim.pid, signal.SIGUSR2)
+        else:
+            self._down_since = None
+
+    # -- exit policy -------------------------------------------------------
+
+    def _decide_exit(self) -> None:
+        now = time.monotonic()
+        if self._shutting_down:
+            if not self._by_pid:
+                self._done.set()
+            return
+        if self._ever_ready:
+            return
+        with self._lock:
+            slots = list(self._slots)
+        if slots and all(s.pid is None and s.breaker.state(now) ==
+                         CircuitBreaker.OPEN for s in slots):
+            # nothing ever served and every slot crash-looped into its
+            # breaker: config/model error — fail the pool fast rather
+            # than sit dark behind a reserved port
+            log.error("no worker ever became ready and every slot's "
+                      "circuit breaker is open — failing the pool")
+            print("supervisor: pool startup failed (all circuit breakers "
+                  "open)", flush=True)
+            self._exit_code = 1
+            self._shutting_down = True
+            self._done.set()
+
+    # -- introspection -----------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            slots = list(self._slots)
+        live = sum(1 for s in slots if s.pid is not None)
+        ready = sum(1 for s in slots if s.ready and s.pid is not None)
+        POOL_WORKERS.set(live)
+        SUP_WORKERS.labels(state="target").set(len(slots))
+        SUP_WORKERS.labels(state="live").set(live)
+        SUP_WORKERS.labels(state="ready").set(ready)
+        now = time.monotonic()
+        for s in slots:
+            SUP_BREAKER_STATE.labels(slot=str(s.idx)).set(
+                s.breaker.state(now))
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            slots = list(self._slots)
+        return {
+            "target": len(slots),
+            "min": self.cfg.min_workers,
+            "max": self.cfg.max_workers,
+            "live": sum(1 for s in slots if s.pid is not None),
+            "ready": sum(1 for s in slots if s.ready and s.pid is not None),
+            "rolling": self._rolling,
+            "shuttingDown": self._shutting_down,
+            "port": self.config.port,
+            "workers": [{
+                "slot": s.idx,
+                "pid": s.pid,
+                "ready": s.ready,
+                "port": s.port,
+                "inFlight": s.in_flight,
+                "completed": s.completed,
+                "bad": s.bad,
+                "burn5m": round(s.burn, 3),
+                "drainingOut": s.draining_out,
+                "rolling": s.rolling,
+                "failures": s.breaker.failures,
+                "breaker": ("open" if s.breaker.state(now) == 1 else
+                            "half-open" if s.breaker.state(now) == 2 else
+                            "closed"),
+                "heartbeatAgeS": (round(now - s.last_hb, 2)
+                                  if s.pid is not None else None),
+            } for s in slots],
+        }
+
+    def _control_handler(self):
+        sup = self
+
+        class ControlHandler(JsonRequestHandler):
+            server_version = "pio-tpu-supervisor/0.1"
+
+            def do_GET(self):
+                if self.path in ("/", "/status.json"):
+                    return self.send_json(200, sup.status())
+                return self.send_json(404, {"message": "Not Found"})
+
+        return ControlHandler
+
+
+def run_worker_pool(config, n_workers: int) -> int:
+    """Supervise an N-worker SO_REUSEPORT pool (`pio deploy --workers N`).
+    Returns the process exit code. Mutates `config.port` to the resolved
+    concrete port when called with port 0."""
+    return Supervisor(config, n_workers).run()

@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
+import time
 import urllib.parse
 import uuid
 from datetime import datetime, timezone
@@ -58,9 +60,20 @@ class _BaseClient:
     (a fresh TCP handshake per event caps SDK ingest at ~1k events/s;
     keep-alive measures ~5× that). Broken connections reconnect once."""
 
-    def __init__(self, url: str, timeout: float = 10.0):
+    def __init__(self, url: str, timeout: float = 10.0,
+                 busy_retries: int = 2,
+                 busy_backoff_base_s: float = 0.2,
+                 busy_backoff_cap_s: float = 5.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # 429/503 (shed / deadline pressure) retry posture: how many
+        # replays after the first answer, and the jittered-exponential
+        # backoff bounds between them. Server Retry-After can stretch a
+        # wait up to the cap but never past it. busy_retries=0 restores
+        # the old fail-fast behavior.
+        self.busy_retries = busy_retries
+        self.busy_backoff_base_s = busy_backoff_base_s
+        self.busy_backoff_cap_s = busy_backoff_cap_s
         # Trace id echoed by the server on the most recent response —
         # the client-side half of end-to-end X-PIO-Trace-Id propagation.
         self.last_trace_id: Optional[str] = None
@@ -97,10 +110,27 @@ class _BaseClient:
         connections are reaped by the server side too)."""
         self._drop_conn()
 
+    def _busy_delay_s(self, busy_attempt: int, retry_after: Optional[str]
+                      ) -> float:
+        """Jittered exponential backoff for a 429/503 replay, stretched
+        (never shrunk) by the server's Retry-After and capped either
+        way — a malicious or confused header can't park the client."""
+        delay = min(self.busy_backoff_cap_s,
+                    self.busy_backoff_base_s * (2 ** busy_attempt))
+        delay *= 0.5 + random.random()
+        if retry_after:
+            try:
+                delay = max(delay, min(float(retry_after),
+                                       self.busy_backoff_cap_s))
+            except ValueError:
+                pass
+        return delay
+
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None,
                  body: Optional[Any] = None,
-                 idempotent: bool = False) -> Any:
+                 idempotent: bool = False,
+                 retry_busy: Optional[bool] = None) -> Any:
         q = {k: v for k, v in (query or {}).items() if v is not None}
         target = self._prefix + path
         if q:
@@ -112,38 +142,58 @@ class _BaseClient:
         # The retry loop reuses the same id — a replay is the same request.
         sent_trace_id = tracing.inject_headers(headers)
         idempotent = idempotent or method in ("GET", "DELETE")
-        for attempt in (0, 1):
-            conn, fresh = self._conn()
-            sent = False
-            try:
-                conn.request(method, target, data, headers)
-                sent = True
-                resp = conn.getresponse()
-                payload = resp.read()
-                status = resp.status
-                self.last_trace_id = (resp.getheader(tracing.TRACE_HEADER)
-                                      or sent_trace_id)
-                break
-            except (http.client.HTTPException, ConnectionError, OSError) as e:
-                self._drop_conn()
-                # Retry exactly once, and ONLY on a reused keep-alive where
-                # retrying is safe: failure at send time (request bytes
-                # never completed), or — for idempotent requests only —
-                # RemoteDisconnected from getresponse (the stale keep-alive
-                # race). A close without a response does NOT prove the
-                # server skipped the request (it may have died after
-                # processing but before replying), so non-idempotent POSTs
-                # are never replayed on it; event POSTs are made idempotent
-                # by the client-set eventId (see create_event), which turns
-                # a replay into a duplicate-rejection by the store's
-                # uniqueness constraint. Timeouts and mid-response failures
-                # are never retried.
-                can_retry = (not attempt and not fresh
-                             and (not sent
-                                  or (idempotent and isinstance(
-                                      e, http.client.RemoteDisconnected))))
-                if not can_retry:
-                    raise
+        # 429/503 replays follow idempotency unless the caller overrides:
+        # the server answered, so the request may re-run later — only
+        # safe when re-running is provably the same request (see
+        # create_event for the /events.json carve-out).
+        if retry_busy is None:
+            retry_busy = idempotent
+        busy_attempt = 0
+        while True:
+            for attempt in (0, 1):
+                conn, fresh = self._conn()
+                sent = False
+                try:
+                    conn.request(method, target, data, headers)
+                    sent = True
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    status = resp.status
+                    self.last_trace_id = (resp.getheader(tracing.TRACE_HEADER)
+                                          or sent_trace_id)
+                    break
+                except (http.client.HTTPException, ConnectionError, OSError) as e:
+                    self._drop_conn()
+                    # Retry exactly once, and ONLY on a reused keep-alive where
+                    # retrying is safe: failure at send time (request bytes
+                    # never completed), or — for idempotent requests only —
+                    # RemoteDisconnected from getresponse (the stale keep-alive
+                    # race). A close without a response does NOT prove the
+                    # server skipped the request (it may have died after
+                    # processing but before replying), so non-idempotent POSTs
+                    # are never replayed on it; event POSTs are made idempotent
+                    # by the client-set eventId (see create_event), which turns
+                    # a replay into a duplicate-rejection by the store's
+                    # uniqueness constraint. Timeouts and mid-response failures
+                    # are never retried.
+                    can_retry = (not attempt and not fresh
+                                 and (not sent
+                                      or (idempotent and isinstance(
+                                          e, http.client.RemoteDisconnected))))
+                    if not can_retry:
+                        raise
+            # Admission shed (429) and deadline pressure (503) are the
+            # server saying "later, not never": back off and replay, up
+            # to busy_retries times. Both arrive BEFORE the request took
+            # effect on the serving plane, but a replay is still a
+            # re-send, so the retry_busy gate above applies.
+            if (status in (429, 503) and retry_busy
+                    and busy_attempt < self.busy_retries):
+                time.sleep(self._busy_delay_s(
+                    busy_attempt, resp.getheader("Retry-After")))
+                busy_attempt += 1
+                continue
+            break
         if 300 <= status < 400:
             # the reference stack never redirects; auto-following would
             # silently re-send bodies across hosts — surface it instead
@@ -166,8 +216,9 @@ class EventClient(_BaseClient):
     """Client for the event server (:7070)."""
 
     def __init__(self, access_key: str, url: str = "http://localhost:7070",
-                 channel: Optional[str] = None, timeout: float = 10.0):
-        super().__init__(url, timeout)
+                 channel: Optional[str] = None, timeout: float = 10.0,
+                 **transport):
+        super().__init__(url, timeout, **transport)
         self.access_key = access_key
         self.channel = channel
 
@@ -215,8 +266,16 @@ class EventClient(_BaseClient):
             # rejection provably means our own earlier attempt committed.
             # A caller-supplied id gets no retry — a replay's 400 would be
             # indistinguishable from the caller's own real duplicate.
+            #
+            # Busy (429/503) replays are the inverse: OFF for generated
+            # ids — a generated id proves OUR replay is harmless, but the
+            # analytics semantics of single-event appends mean a delayed
+            # replay can land out of order behind the caller's NEXT event,
+            # so only a caller who brought an explicit idempotency key
+            # (event_id) has declared the event safe to re-send late.
             out = self._request("POST", "/events.json", self._auth(), body,
-                                idempotent=generated)
+                                idempotent=generated,
+                                retry_busy=event_id is not None)
         except PredictionIOError as e:
             if generated and e.status == 400 and "duplicate eventId" in e.message:
                 return eid
@@ -333,9 +392,12 @@ class EngineClient(_BaseClient):
     """Client for a deployed engine's prediction server (:8000)."""
 
     def __init__(self, url: str = "http://localhost:8000",
-                 timeout: float = 10.0):
-        super().__init__(url, timeout)
+                 timeout: float = 10.0, **transport):
+        super().__init__(url, timeout, **transport)
 
     def send_query(self, data: dict) -> dict:
-        """POST /queries.json → PredictedResult."""
-        return self._request("POST", "/queries.json", body=data)
+        """POST /queries.json → PredictedResult. Queries are side-effect
+        free, so the request is idempotent: stale-keep-alive replays and
+        busy (429/503) backoff-retries both apply."""
+        return self._request("POST", "/queries.json", body=data,
+                             idempotent=True)

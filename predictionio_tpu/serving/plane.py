@@ -37,6 +37,7 @@ from predictionio_tpu.serving.admission import (
 from predictionio_tpu.serving.batcher import BatcherConfig, MicroBatcher
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils import faults
 
 log = logging.getLogger(__name__)
 
@@ -106,7 +107,16 @@ class ServingPlane:
                  config: Optional[ServingConfig] = None,
                  name: str = "predictionserver"):
         self.config = config or ServingConfig()
-        self.dispatch_fn = dispatch_fn
+
+        # `serving.pre_dispatch` fault site: after admission, before the
+        # model runs — the chaos gate arms delay:/error modes here to turn
+        # a live worker slow or erroring without killing it. One site in
+        # the plane covers every serving surface (batched and direct).
+        def _faultable_dispatch(queries: List) -> List:
+            faults.inject("serving.pre_dispatch")
+            return dispatch_fn(queries)
+
+        self.dispatch_fn = _faultable_dispatch
         self.degraded_fn = degraded_fn
         self.admission = AdmissionController(self.config.admission)
         self.batcher: Optional[MicroBatcher] = None
@@ -115,7 +125,7 @@ class ServingPlane:
             # batch stops waiting the moment it holds every admitted
             # request (see batcher module docstring)
             self.batcher = MicroBatcher(
-                dispatch_fn, config=self.config.batcher, name=name,
+                self.dispatch_fn, config=self.config.batcher, name=name,
                 pending_fn=lambda: self.admission.admitted)
 
     def handle_query(self, query, headers=None) -> Tuple[object, bool]:

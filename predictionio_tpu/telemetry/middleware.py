@@ -65,6 +65,7 @@ _EXACT_ROUTES = frozenset({
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
+    "/status.json",                                        # supervisor
 })
 _PREFIX_ROUTES = (
     ("/events/", ".json", "/events/<id>.json"),

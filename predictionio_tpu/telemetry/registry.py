@@ -14,6 +14,7 @@ the same locks family-by-family so a scrape never sees a torn histogram
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Iterable, Optional, Sequence, Tuple
@@ -317,3 +318,20 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
 # The process-wide default registry: every server in one process shares it,
 # so a combined deploy (worker pool forks) still exposes one coherent view.
 REGISTRY = MetricsRegistry()
+
+
+def _reinit_locks_after_fork() -> None:
+    # The supervisor forks pool workers from a control thread while
+    # handler/scraper threads in the parent may hold family locks; a child
+    # inheriting a held lock would deadlock on its first metric touch.
+    # Locks only guard intra-process consistency, so fresh ones are safe.
+    REGISTRY._lock = threading.Lock()
+    for family in REGISTRY._metrics.values():
+        new_lock = threading.Lock()
+        family._lock = new_lock
+        for child in family._children.values():
+            child._lock = new_lock
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_locks_after_fork)

@@ -31,6 +31,7 @@ ship with defaults below. Untracked routes cost one dict miss.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -207,10 +208,49 @@ def snapshot(now: Optional[float] = None) -> List[dict]:
     return rows
 
 
+def current_burn(server: str, route: str, window_s: int = 300,
+                 now: Optional[float] = None) -> Tuple[float, int]:
+    """Worst burn rate across this route's objectives over one window,
+    plus the window's request count.
+
+    The supervisor's worker heartbeat reports this so slow workers are
+    caught by the *latency* objective (a `delay:500` worker answers 200s
+    — availability alone never pages) and erroring workers by the
+    availability one. Returns (0.0, 0) for untracked routes."""
+    if now is None:
+        now = time.time()
+    t = _trackers.get((server, route))
+    if t is None:
+        return 0.0, 0
+    obj = t.objective
+    total, bad_avail, good_total, bad_latency = t.window_sums(window_s, now)
+    worst = 0.0
+    for bad, denom, target in (
+            (bad_avail, total, obj.availability_target),
+            (bad_latency, good_total, obj.latency_target)):
+        budget = 1.0 - target
+        if denom and budget > 0:
+            worst = max(worst, (bad / denom) / budget)
+    return worst, total
+
+
 def reset() -> None:
     """Drop all trackers (tests)."""
     with _trackers_lock:
         _trackers.clear()
+
+
+def _reinit_locks_after_fork() -> None:
+    # Pool workers are forked from a supervisor control thread; tracker
+    # locks held by a parent scrape at fork time would deadlock the child.
+    global _trackers_lock
+    _trackers_lock = threading.Lock()
+    for t in _trackers.values():
+        t.lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_locks_after_fork)
 
 
 # Default objectives for the two hot request routes. 250 ms at p99 with

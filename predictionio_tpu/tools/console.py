@@ -230,12 +230,33 @@ def cmd_deploy(args) -> int:
         engine_version=args.engine_version,
         engine_variant=engine_variant,
     )
-    if getattr(args, "workers", 1) > 1:
+    min_workers = getattr(args, "min_workers", 0) or 0
+    max_workers = getattr(args, "max_workers", 0) or 0
+    if getattr(args, "workers", 1) > 1 or min_workers or max_workers:
         # pre-fork BEFORE any storage/jax/model state exists in this
-        # process — each worker loads its own (workflow/worker_pool.py)
-        from predictionio_tpu.workflow.worker_pool import run_worker_pool
+        # process — each worker loads its own (runtime/supervisor.py)
+        from predictionio_tpu.runtime.supervisor import (
+            Supervisor, SupervisorConfig,
+        )
 
-        return run_worker_pool(config, args.workers)
+        sup_cfg = SupervisorConfig.from_env()
+        # CLI bounds override the env posture (the flags are the
+        # operator's on-call lever; env is the deploy manifest's)
+        if min_workers:
+            sup_cfg.min_workers = min_workers
+        if max_workers:
+            sup_cfg.max_workers = max_workers
+        if (sup_cfg.min_workers > 0 and sup_cfg.max_workers > 0
+                and sup_cfg.min_workers > sup_cfg.max_workers):
+            print(f"--min-workers {sup_cfg.min_workers} exceeds "
+                  f"--max-workers {sup_cfg.max_workers}", file=sys.stderr)
+            return 1
+        n = max(args.workers, 1)
+        if sup_cfg.min_workers > 0:
+            n = max(n, sup_cfg.min_workers)
+        if sup_cfg.max_workers > 0:
+            n = min(n, sup_cfg.max_workers)
+        return Supervisor(config, n, cfg=sup_cfg).run()
     try:
         server = PredictionServer(config)
     except (RuntimeError, ImportError, AttributeError, ValueError, TypeError,
@@ -514,6 +535,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "/reload and /stop fan out to all); each "
                              "worker is a full process with its own GIL, "
                              "so qps scales with cores")
+    deploy.add_argument("--min-workers", type=int, default=0,
+                        help="autoscaler floor: the supervisor never "
+                             "shrinks the pool below this (implies pool "
+                             "mode; default: the --workers count)")
+    deploy.add_argument("--max-workers", type=int, default=0,
+                        help="autoscaler ceiling: the supervisor grows "
+                             "the pool up to this under sustained queue "
+                             "pressure or SLO burn (implies pool mode; "
+                             "default: the --workers count)")
     deploy.add_argument("--engine-id", default=None)
     deploy.add_argument("--engine-version", default="1")
     deploy.add_argument("--engine-variant", default=None)

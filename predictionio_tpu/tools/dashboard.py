@@ -42,6 +42,13 @@ budget exactly at the rate that exhausts it; &gt;14 on the 5m window is
 page-now territory). Raw families: <code>slo_*</code> on
 <a href="/metrics">/metrics</a>.</p>
 {slo}
+<h2>Supervisor</h2>
+<p>Worker-pool control plane: restarts by cause, autoscaler decisions,
+rolling-deploy drains and per-slot circuit breakers. The live per-worker
+view (pids, in-flight, 5m burn, breaker state) is the supervisor's own
+control endpoint — <code>/status.json</code> on the port announced as
+&quot;Supervisor control endpoint&quot; at deploy time.</p>
+{supervisor}
 <h2>Flight recorder</h2>
 <p>Tail-sampled request timelines (errors, sheds, slow requests pinned;
 random sample of the rest) — newest first, full JSON at
@@ -123,6 +130,46 @@ def _slo_table() -> str:
     return "".join(out)
 
 
+def _supervisor_table(registry=REGISTRY) -> str:
+    """Supervisor panel: the supervisor_* families in one table. Gauges
+    show current state (pool size by state, breaker per slot); counters
+    are lifetime totals; the drain histogram collapses to count + mean
+    like the telemetry panel does."""
+    rows = []
+    for name in ("supervisor_workers", "supervisor_restarts_total",
+                 "supervisor_scale_events_total",
+                 "supervisor_rolling_reloads_total",
+                 "supervisor_breaker_state", "supervisor_drain_seconds"):
+        m = registry.get(name)
+        if m is None:
+            continue
+        if isinstance(m, Histogram):
+            for key, (_, total, count) in sorted(m.collect()):
+                mean_s = (total / count) if count else 0.0
+                rows.append((name, _label_str(m.labelnames, key),
+                             f"n={count} mean={mean_s:.2f}s"))
+        else:
+            for key, value in sorted(m.collect()):
+                if name == "supervisor_breaker_state":
+                    state = {0: "closed", 1: "open",
+                             2: "half-open"}.get(int(value), str(value))
+                    rows.append((name, _label_str(m.labelnames, key), state))
+                else:
+                    rows.append((name, _label_str(m.labelnames, key),
+                                 f"{value:g}"))
+    if not rows:
+        return ("<p>No supervised pool in this process (the families "
+                "appear on the supervisor's own <code>/metrics</code> in "
+                "<code>pio deploy --workers N</code> mode).</p>")
+    out = ["<table><tr><th>Metric</th><th>Labels</th><th>Value</th></tr>"]
+    for name, labels, value in rows:
+        out.append(f"<tr><td>{html.escape(name)}</td>"
+                   f"<td>{html.escape(labels)}</td>"
+                   f"<td>{html.escape(value)}</td></tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
 def _flight_table() -> str:
     sizes = RECORDER.sizes()
     entries = RECORDER.snapshot(limit=20)
@@ -200,6 +247,7 @@ class Dashboard(HttpService):
                     evals=_eval_table(evals),
                     instances=_instance_table(instances),
                     slo=_slo_table(),
+                    supervisor=_supervisor_table(),
                     flight=_flight_table(),
                     telemetry=_telemetry_table(),
                 ))

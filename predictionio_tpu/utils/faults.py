@@ -3,14 +3,29 @@ fault injection').
 
 Crash-consistency claims (atomic checkpoints, all-or-nothing batch
 ingest) are only evidence when a process actually dies at the worst
-moment. Production code marks those moments with `faults.inject("site")`;
-a test arms a site via the `PIO_FAULTS` env var and the process hard-dies
-(`os._exit(137)` — no atexit handlers, no flushing, like SIGKILL) when
-execution reaches it:
+moment — and runtime-resilience claims (the supervisor's hang/error
+detection, the sqlite locked-retry) are only evidence when a live
+process misbehaves without dying. Production code marks those moments
+with `faults.inject("site")`; a test arms a site via the `PIO_FAULTS`
+env var:
 
-    PIO_FAULTS=checkpoint.pre_replace        # die at first hit
-    PIO_FAULTS=events.batch.pre_commit:3     # die at the 3rd hit
+    PIO_FAULTS=checkpoint.pre_replace        # hard-die at first hit
+    PIO_FAULTS=events.batch.pre_commit:3     # hard-die at the 3rd hit
     PIO_FAULTS=a.site,b.site:2               # multiple sites
+    PIO_FAULTS=serving.pre_dispatch=delay:500    # sleep 500ms per hit
+    PIO_FAULTS=serving.pre_dispatch=error        # raise FaultInjected
+    PIO_FAULTS=sqlite.pre_commit:2=delay:300     # delay from 2nd hit on
+
+Modes:
+- (default) `die` — `os._exit(137)`: no atexit handlers, no flushing,
+  like SIGKILL. Fires once the hit count is reached (and then the
+  process is gone).
+- `delay:<ms>` — sleep that many milliseconds at the site, every hit
+  from the armed count onward. Simulates a slow/hung dependency while
+  the process stays alive.
+- `error` — raise `FaultInjected` at the site, every hit from the armed
+  count onward. Simulates a persistent runtime failure (serving surfaces
+  map it to HTTP 500).
 
 Unarmed sites cost one dict lookup on a module-level map that is empty in
 production (PIO_FAULTS unset ⇒ `inject` returns immediately).
@@ -31,13 +46,29 @@ Sites in the tree:
 - `w2v.step_boundary` / `logreg.step_boundary` — the same
   chunk-computed-but-not-saved moment for the segmented W2V SGNS and
   LogReg Adam trainers (workflow/segmented.py)
+- `serving.pre_dispatch` — inside the serving plane, after admission,
+  before the model dispatch runs; `delay:`/`error` here make a worker
+  slow or erroring under live load (the chaos gate's hang/error drills)
+- `worker.startup` — in a pool worker before it reports ready; armed
+  with the default die mode it crash-loops the worker (the supervisor's
+  circuit-breaker drill)
+- `sqlite.pre_commit` — in the sqlite backend between a transaction's
+  statements and its COMMIT; `delay:` here widens the write-lock window
+  to reproduce `database is locked` contention
 """
 
 from __future__ import annotations
 
 import os
+import time
 
-_armed: dict[str, int] = {}
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed `error`-mode fault site."""
+
+
+# site -> (hit threshold, mode, delay_ms)
+_armed: dict[str, tuple[int, str, int]] = {}
 _hits: dict[str, int] = {}
 _parsed_from: str = ""
 
@@ -54,24 +85,46 @@ def _parse() -> None:
         part = part.strip()
         if not part:
             continue
+        mode, delay_ms = "die", 0
+        if "=" in part:
+            part, mode_spec = part.split("=", 1)
+            if mode_spec.startswith("delay:"):
+                mode, delay_ms = "delay", int(mode_spec[len("delay:"):])
+            elif mode_spec == "error":
+                mode = "error"
+            elif mode_spec == "die":
+                mode = "die"
+            else:
+                raise ValueError(f"unknown PIO_FAULTS mode {mode_spec!r}")
         if ":" in part:
             site, n = part.rsplit(":", 1)
-            _armed[site] = int(n)
+            _armed[site] = (int(n), mode, delay_ms)
         else:
-            _armed[part] = 1
+            _armed[part] = (1, mode, delay_ms)
 
 
 def inject(site: str) -> None:
-    """Hard-kill the process if `site` is armed and its hit count is
-    reached. A no-op (one env read + dict lookup) otherwise."""
+    """Fire `site`'s armed fault if its hit count is reached. A no-op
+    (one env read + dict lookup) otherwise.
+
+    `die` fires once (the process exits). `delay`/`error` fire on every
+    hit from the armed count onward — a misbehaving dependency stays
+    misbehaving until the supervisor (or the test) intervenes."""
     _parse()
     if not _armed:
         return
-    n = _armed.get(site)
-    if n is None:
+    entry = _armed.get(site)
+    if entry is None:
         return
+    n, mode, delay_ms = entry
     _hits[site] = _hits.get(site, 0) + 1
-    if _hits[site] >= n:
+    if _hits[site] < n:
+        return
+    if mode == "die":
         # stderr survives even though buffers don't get flushed on _exit
         os.write(2, f"PIO_FAULTS: dying at {site}\n".encode())
         os._exit(137)
+    elif mode == "delay":
+        time.sleep(delay_ms / 1000.0)
+    else:  # error
+        raise FaultInjected(f"PIO_FAULTS: injected error at {site}")

@@ -126,6 +126,9 @@ class HttpService:
         cls = _ReusePortServer if reuse_port else _Server
         self.httpd = cls((ip, port), handler_cls)
         self.httpd.pio_server_name = name
+        self._bind_ip = ip
+        self._reuse_port = reuse_port
+        self._accepting = True
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -138,6 +141,77 @@ class HttpService:
 
     def serve_forever(self) -> None:
         self.httpd.serve_forever()
+
+    def pause_accept(self) -> None:
+        """Stop accepting new connections while continuing to serve the
+        established ones.
+
+        The listening socket is closed, which on SO_REUSEPORT pools makes
+        the kernel stop hashing new connections to this process entirely
+        (the other pool members absorb them) — the first leg of a
+        drain-then-reload. Connections already accepted keep being served:
+        ThreadingHTTPServer hands each one to its own handler thread,
+        which lives independently of the accept loop. The already-queued
+        listen backlog is drained (accepted) first so clients whose
+        handshake the kernel completed are served rather than reset.
+
+        Only meaningful for services started with `start()` (the worker
+        pool path). Idempotent."""
+        if not self._accepting:
+            return
+        self._accepting = False
+        self.httpd.shutdown()  # stop the accept loop
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        import selectors
+
+        with selectors.DefaultSelector() as sel:
+            sel.register(self.httpd, selectors.EVENT_READ)
+            while sel.select(timeout=0):
+                try:
+                    self.httpd._handle_request_noblock()
+                except Exception:
+                    break
+        try:
+            self.httpd.socket.close()
+        except OSError:
+            pass
+
+    def resume_accept(self) -> None:
+        """Re-open the listening socket after `pause_accept()` and restart
+        the accept loop. On SO_REUSEPORT pools the rebind always succeeds
+        because the supervisor holds a never-listening reservation socket
+        on the port; standalone services rebind the same port best-effort."""
+        if self._accepting:
+            return
+        import socket
+
+        addr = self.httpd.server_address
+        sock = socket.socket(self.httpd.address_family,
+                             self.httpd.socket_type)
+        try:
+            # SO_REUSEADDR matches HTTPServer.server_bind (allow_reuse_address)
+            # — without it the rebind fails while drained-but-parked
+            # keep-alive connections still hold the old socket's port
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self._reuse_port:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind((self._bind_ip, addr[1]))
+            sock.listen(self.httpd.request_queue_size)
+        except OSError:
+            sock.close()
+            raise
+        self.httpd.socket = sock
+        self.httpd.server_address = sock.getsockname()
+        # serve_forever exits its internal "shutdown requested" state on
+        # entry, so a fresh serving thread picks the new socket right up
+        self._accepting = True
+        self.start()
+
+    @property
+    def accepting(self) -> bool:
+        return self._accepting
 
     def shutdown(self) -> None:
         self.httpd.shutdown()

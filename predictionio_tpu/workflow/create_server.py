@@ -33,6 +33,7 @@ from predictionio_tpu.serving import (
 )
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils.faults import FaultInjected
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
 from predictionio_tpu.storage.base import EngineInstance
@@ -230,6 +231,13 @@ class PredictionServer(HttpService):
                     except PluginRejection as e:
                         QUERIES_FAILED.inc()
                         return self._send(403, {"message": str(e)})
+                    except FaultInjected as e:
+                        # chaos-drill errors are server faults, not client
+                        # ones: a 500 spends SLO budget (a 400 would not),
+                        # which is what the supervisor's error-rate rule
+                        # and the chaos gate watch for
+                        QUERIES_FAILED.inc()
+                        return self._send(500, {"message": str(e)})
                     except Exception as e:
                         QUERIES_FAILED.inc()
                         log.warning("Query failed: %s", e)
@@ -240,13 +248,16 @@ class PredictionServer(HttpService):
                 if self.path == "/reload":
                     if server.supervisor_pid is not None:
                         # pool mode: the kernel routed this request to ONE
-                        # worker; the supervisor's SIGHUP reaches them all
-                        # (this one included)
+                        # worker; SIGHUP asks the supervisor for a ROLLING
+                        # reload — each worker (this one included) drains
+                        # and swaps in turn, so the pool never answers
+                        # from zero workers mid-deploy
                         import signal
 
                         os.kill(server.supervisor_pid, signal.SIGHUP)
                         return self._send(200, {
-                            "message": "Reload signaled to all workers"})
+                            "message": "Rolling reload signaled to "
+                                       "all workers"})
                     try:
                         server.reload()
                     except Exception as e:
@@ -286,6 +297,18 @@ class PredictionServer(HttpService):
         then the batcher's dispatcher thread is joined."""
         super().shutdown()
         self.serving.close()
+
+    def health_check(self) -> bool:
+        """The drain-then-reload re-admission check: a worker re-enters
+        the SO_REUSEPORT group only if it is actually able to serve —
+        a served state is loaded and the `/metrics` exposition renders
+        (the supervisor runbook's probe)."""
+        if self._state is None:
+            return False
+        from predictionio_tpu.telemetry import slo as _slo
+
+        _slo.refresh()
+        return bool(REGISTRY.render())
 
     @property
     def instance_id(self) -> str:

@@ -35,6 +35,13 @@ def main():
                         "if any event-server write route bypasses the "
                         "group-commit write plane, or if an overloaded "
                         "server answers anything but 200/201/429")
+    p.add_argument("--chaos-gate", action="store_true",
+                   help="run the supervisor chaos CI gate (no jax, no "
+                        "data): boots a supervised stub worker pool and "
+                        "drills hard-kill, slow-worker (delay:500) and "
+                        "erroring-worker recovery plus crash-loop circuit "
+                        "breaking; fails unless capacity self-heals with "
+                        "bounded restarts")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -63,6 +70,11 @@ def main():
 
     if args.ingest_gate:
         from predictionio_tpu.ingest.gate import run_gate
+
+        return run_gate()
+
+    if args.chaos_gate:
+        from predictionio_tpu.runtime.gate import run_gate
 
         return run_gate()
 

@@ -197,3 +197,108 @@ class TestKeepAliveTransport:
             assert len(client.find_events(limit=-1)) == 2
         finally:
             srv2.shutdown()
+
+
+class TestBusyRetry:
+    """429/503 backoff-replay posture (round 6): idempotent routes retry
+    with capped jittered backoff honoring Retry-After; single-event
+    POSTs replay ONLY when the caller brought an explicit event_id (a
+    generated id proves OUR replay is safe, but a late replay of an
+    append can land behind the caller's next event)."""
+
+    @pytest.fixture()
+    def scripted(self):
+        """Stub server answering from a script of (status, headers),
+        then 200; records every request path."""
+        import time as _time
+
+        from predictionio_tpu.utils.http import (
+            HttpService, JsonRequestHandler,
+        )
+
+        script = {"responses": [], "hits": []}
+
+        class Handler(JsonRequestHandler):
+            def do_POST(self):
+                self.read_body()
+                script["hits"].append((self.path.split("?")[0],
+                                       _time.monotonic()))
+                if script["responses"]:
+                    status, headers = script["responses"].pop(0)
+                else:
+                    status, headers = 200, None
+                body = ({"message": "busy"} if status >= 400
+                        else {"eventId": "e-1", "itemScores": []})
+                self.send_json(status, body, headers=headers)
+
+        svc = HttpService("127.0.0.1", 0, Handler, server_name="t-busy")
+        svc.start()
+        yield svc, script
+        svc.shutdown()
+
+    def _fast(self, **kw):
+        out = dict(busy_retries=2, busy_backoff_base_s=0.01,
+                   busy_backoff_cap_s=0.3)
+        out.update(kw)
+        return out
+
+    def test_send_query_replays_through_429_and_503(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(429, {"Retry-After": "0.01"}), (503, None)]
+        eng = EngineClient(url=f"http://127.0.0.1:{svc.port}",
+                           **self._fast())
+        out = eng.send_query({"user": "u1", "num": 1})
+        assert out == {"eventId": "e-1", "itemScores": []}
+        assert len(script["hits"]) == 3  # 429, 503, then the 200
+
+    def test_retry_after_stretches_the_backoff(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(429, {"Retry-After": "0.2"})]
+        eng = EngineClient(url=f"http://127.0.0.1:{svc.port}",
+                           **self._fast())
+        eng.send_query({"user": "u1"})
+        (_, t0), (_, t1) = script["hits"]
+        assert t1 - t0 >= 0.2  # waited at least the server's ask
+
+    def test_retries_exhausted_surfaces_the_status(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(429, None)] * 3
+        eng = EngineClient(url=f"http://127.0.0.1:{svc.port}",
+                           **self._fast(busy_retries=1))
+        with pytest.raises(PredictionIOError) as ei:
+            eng.send_query({"user": "u1"})
+        assert ei.value.status == 429
+        assert len(script["hits"]) == 2  # first answer + one replay
+
+    def test_create_event_generated_id_never_busy_replays(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(429, None)]
+        ec = EventClient(access_key="k",
+                         url=f"http://127.0.0.1:{svc.port}",
+                         **self._fast())
+        with pytest.raises(PredictionIOError) as ei:
+            ec.create_event(event="rate", entity_type="user",
+                            entity_id="u1")
+        assert ei.value.status == 429
+        assert len(script["hits"]) == 1  # fail-fast, no replay
+
+    def test_create_event_with_event_id_busy_replays(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(503, {"Retry-After": "0.01"})]
+        ec = EventClient(access_key="k",
+                         url=f"http://127.0.0.1:{svc.port}",
+                         **self._fast())
+        eid = ec.create_event(event="rate", entity_type="user",
+                              entity_id="u1", event_id="caller-key-1")
+        assert eid == "e-1"  # the stub's answer after the replay
+        assert len(script["hits"]) == 2
+
+    def test_busy_retries_zero_restores_fail_fast(self, scripted):
+        svc, script = scripted
+        script["responses"] = [(503, None)]
+        eng = EngineClient(url=f"http://127.0.0.1:{svc.port}",
+                           busy_retries=0)
+        with pytest.raises(PredictionIOError) as ei:
+            eng.send_query({"user": "u1"})
+        assert ei.value.status == 503
+        assert len(script["hits"]) == 1

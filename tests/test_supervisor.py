@@ -1,0 +1,245 @@
+"""Supervisor control plane (runtime/supervisor.py) — the fast units:
+fault-spec parsing, config resolution, backoff/breaker math, the control
+pipe codec, and the pause/resume accept primitive the drain leg is built
+on. The live drills (kill → slow → error self-healing, crash-loop
+breaker) run as `python quality.py --chaos-gate` in CI and here under
+`-m slow`; the rolling-deploy zero-downtime drill lives in
+test_worker_pool.py over a real trained pool."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from predictionio_tpu.runtime.supervisor import (
+    MSG_DRAINED,
+    MSG_HEARTBEAT,
+    MSG_READY,
+    MSG_RELOADED,
+    MSG_SIZE,
+    CircuitBreaker,
+    SupervisorConfig,
+    backoff_s,
+    pack_msg,
+    parse_worker_faults,
+    unpack_msg,
+)
+from predictionio_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PIO_FAULTS", raising=False)
+    faults._parse()
+    yield
+    faults._parse()
+
+
+class TestFaultModes:
+    def _arm(self, monkeypatch, spec):
+        monkeypatch.setenv("PIO_FAULTS", spec)
+        faults._parse()
+
+    def test_unarmed_site_is_noop(self):
+        faults.inject("serving.pre_dispatch")  # must not raise/sleep/die
+
+    def test_error_mode_raises_every_hit(self, monkeypatch):
+        self._arm(monkeypatch, "serving.pre_dispatch=error")
+        for _ in range(3):
+            with pytest.raises(faults.FaultInjected):
+                faults.inject("serving.pre_dispatch")
+
+    def test_delay_mode_sleeps(self, monkeypatch):
+        self._arm(monkeypatch, "serving.pre_dispatch=delay:60")
+        t0 = time.monotonic()
+        faults.inject("serving.pre_dispatch")
+        assert time.monotonic() - t0 >= 0.055
+
+    def test_hit_threshold_defers_firing(self, monkeypatch):
+        self._arm(monkeypatch, "sqlite.pre_commit:3=error")
+        faults.inject("sqlite.pre_commit")
+        faults.inject("sqlite.pre_commit")
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("sqlite.pre_commit")
+        # error mode keeps firing from the armed count onward
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("sqlite.pre_commit")
+
+    def test_threshold_with_mode_parses_either_order(self, monkeypatch):
+        # "site:2=delay:300" — the = split happens first, then the :n
+        self._arm(monkeypatch, "sqlite.pre_commit:2=delay:30")
+        t0 = time.monotonic()
+        faults.inject("sqlite.pre_commit")  # hit 1: below threshold
+        assert time.monotonic() - t0 < 0.025
+        faults.inject("sqlite.pre_commit")  # hit 2: fires
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_unknown_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv("PIO_FAULTS", "x.y=explode")
+        with pytest.raises(ValueError):
+            faults._parse()
+
+    def test_multiple_sites(self, monkeypatch):
+        self._arm(monkeypatch, "a.site=error,b.site=delay:10")
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("a.site")
+        faults.inject("b.site")  # delay, no raise
+
+
+class TestConfigAndParsing:
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("PIO_SUPERVISOR_MIN_WORKERS", "2")
+        monkeypatch.setenv("PIO_SUPERVISOR_MAX_WORKERS", "6")
+        monkeypatch.setenv("PIO_SUPERVISOR_DRAIN_DEADLINE_S", "1.5")
+        monkeypatch.setenv("PIO_SUPERVISOR_BREAKER_THRESHOLD", "5")
+        monkeypatch.setenv("PIO_SUPERVISOR_WORKER_FAULTS",
+                           "4:serving.pre_dispatch=delay:500")
+        cfg = SupervisorConfig.from_env()
+        assert cfg.min_workers == 2 and cfg.max_workers == 6
+        assert cfg.drain_deadline_s == 1.5
+        assert cfg.breaker_threshold == 5
+        assert cfg.worker_faults == "4:serving.pre_dispatch=delay:500"
+
+    def test_from_env_ignores_garbage(self, monkeypatch):
+        monkeypatch.setenv("PIO_SUPERVISOR_POLL_INTERVAL_S", "fast")
+        cfg = SupervisorConfig.from_env()
+        assert cfg.poll_interval_s == 1.0  # default survives
+
+    def test_control_port_off(self, monkeypatch):
+        for raw in ("off", "none", "-1"):
+            monkeypatch.setenv("PIO_SUPERVISOR_PORT", raw)
+            assert SupervisorConfig.from_env().control_port is None
+        monkeypatch.setenv("PIO_SUPERVISOR_PORT", "9123")
+        assert SupervisorConfig.from_env().control_port == 9123
+
+    def test_parse_worker_faults(self):
+        spec = "4:serving.pre_dispatch=delay:500;5:worker.startup; "
+        assert parse_worker_faults(spec) == {
+            4: "serving.pre_dispatch=delay:500",
+            5: "worker.startup",
+        }
+        assert parse_worker_faults("") == {}
+
+
+class TestBackoffAndBreaker:
+    def test_backoff_exponential_with_jitter_bounds(self):
+        import random
+
+        rng = random.Random(7)
+        for failures, raw in ((1, 0.5), (2, 1.0), (3, 2.0), (10, 8.0)):
+            for _ in range(20):
+                d = backoff_s(failures, 0.5, 8.0, rng=rng)
+                assert raw * 0.5 <= d <= raw * 1.5
+
+    def test_breaker_opens_after_threshold_and_half_opens(self):
+        br = CircuitBreaker(threshold=3, reset_s=5.0)
+        now = 100.0
+        for _ in range(2):
+            br.record_failure(now, rapid=True)
+            assert br.allows_spawn(now)
+        br.record_failure(now, rapid=True)
+        assert br.state(now) == CircuitBreaker.OPEN
+        assert not br.allows_spawn(now)
+        # window expires → half-open probe allowed
+        later = now + 5.1
+        assert br.allows_spawn(later)
+        assert br.state(later) == CircuitBreaker.HALF_OPEN
+        # a READY mark closes it
+        br.record_success()
+        assert br.state(later) == CircuitBreaker.CLOSED
+        assert br.failures == 0
+
+    def test_non_rapid_failure_resets_the_count(self):
+        br = CircuitBreaker(threshold=3, reset_s=5.0)
+        br.record_failure(0.0, rapid=True)
+        br.record_failure(0.0, rapid=True)
+        # a worker that served for a while before dying is not a crash
+        # loop: the count restarts at 1
+        br.record_failure(0.0, rapid=False)
+        assert br.failures == 1
+        assert br.state(0.0) == CircuitBreaker.CLOSED
+
+
+class TestControlPipeCodec:
+    def test_roundtrip(self):
+        for kind in (MSG_READY, MSG_HEARTBEAT, MSG_RELOADED, MSG_DRAINED):
+            buf = pack_msg(kind, 4242, 1, 2, 3, 4)
+            assert len(buf) == MSG_SIZE
+            assert unpack_msg(buf) == (kind, 4242, 1, 2, 3, 4)
+
+    def test_atomic_pipe_write_size(self):
+        # POSIX guarantees writes ≤ PIPE_BUF are atomic; the protocol
+        # depends on it (concurrent heartbeat + drain acks on one pipe)
+        assert MSG_SIZE <= 512
+
+    def test_large_counter_values_survive(self):
+        # completed/bad are unbounded counters → the q fields are 64-bit
+        buf = pack_msg(MSG_HEARTBEAT, 1, 7, 2**40, 2**33, 10**7)
+        assert unpack_msg(buf)[3] == 2**40
+
+
+class TestPauseResumeAccept:
+    def test_pause_stops_new_connections_resume_restores(self):
+        from predictionio_tpu.utils.http import (
+            HttpService, JsonRequestHandler,
+        )
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                self.send_json(200, {"ok": True})
+
+        svc = HttpService("127.0.0.1", 0, Handler, server_name="t-pause")
+        svc.start()
+        try:
+            import http.client
+
+            # established keep-alive connection before the pause
+            parked = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                                timeout=5)
+            parked.request("GET", "/")
+            r = parked.getresponse()
+            assert r.status == 200 and r.read()
+
+            svc.pause_accept()
+            assert not svc.accepting
+            # new connections are refused (listener closed)
+            with pytest.raises(OSError):
+                socket.create_connection(("127.0.0.1", svc.port),
+                                         timeout=0.5).close()
+            # ...but the parked connection keeps being served (the
+            # property the rolling deploy's zero-downtime claim rides on)
+            parked.request("GET", "/")
+            r = parked.getresponse()
+            assert r.status == 200 and r.read()
+
+            svc.resume_accept()
+            assert svc.accepting
+            fresh = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                               timeout=5)
+            fresh.request("GET", "/")
+            r = fresh.getresponse()
+            assert r.status == 200 and r.read()
+            fresh.close()
+            parked.close()
+        finally:
+            svc.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+class TestChaosMatrix:
+    """The full chaos drill — identical to CI's `quality.py --chaos-gate`
+    (hard-kill → delay:500 → error self-healing on a live pool, then the
+    crash-loop breaker with backoff-timestamp asserts). Minutes of
+    subprocess wall time, so slow-marked; the gate is the tier-1-adjacent
+    receipt."""
+
+    def test_chaos_gate_passes(self):
+        from predictionio_tpu.runtime.gate import run_gate
+
+        assert run_gate() == 0
+
+
+if __name__ == "__main__":
+    os.sys.exit(pytest.main([__file__, "-v"]))

@@ -103,7 +103,11 @@ def pool(tmp_path):
 
     db = tmp_path / "pool.db"
     expected = _train_into(db)
-    env = _train_env(db, tmp_path, 2, PIO_LOG_LEVEL="INFO")
+    # a tight drain deadline keeps the rolling-reload drill's wall time
+    # at ~1s/worker under sustained load (the deadline, not quiescence,
+    # bounds each drain when clients never stop sending)
+    env = _train_env(db, tmp_path, 2, PIO_LOG_LEVEL="INFO",
+                     PIO_SUPERVISOR_DRAIN_DEADLINE_S="1")
     proc = subprocess.Popen(
         [PIO, "deploy", "--ip", "127.0.0.1", "--port", "0", "--workers", "3",
          "--engine-id", "rec-test", "--engine-variant", "rec-test"],
@@ -193,6 +197,75 @@ class TestWorkerPool:
         status, body = _post(port, "/queries.json", {"user": "u0", "num": 3})
         assert status == 200
         assert body["itemScores"][0]["item"] == expected["u0"]
+
+    def test_concurrent_clients_survive_rolling_reload(self, pool):
+        """The zero-downtime contract (round 6): 8 concurrent keep-alive
+        clients sustained THROUGH a rolling reload lose no requests —
+        every answer is a 200, no connection is dropped, and the pool
+        ends up serving the new instance. The supervisor drains one
+        worker at a time (accept paused, in-flight quiesced or deadline,
+        hot-swap, health-check, resume), so parked connections keep
+        being served the whole way."""
+        import threading
+
+        proc, port, db, expected = pool
+        before = _query_until(port, want=lambda s: len(s) >= 2)
+        old_ids = set(before.values())
+        _train_into(db, ingest=False)  # a newer COMPLETED instance
+
+        stop = threading.Event()
+        results = [{"n": 0, "bad": [], "error": None} for _ in range(8)]
+        body = json.dumps({"user": "u0", "num": 3}).encode()
+
+        def client(rec):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            try:
+                while not stop.is_set():
+                    conn.request("POST", "/queries.json", body,
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = r.read()
+                    if r.status != 200:
+                        rec["bad"].append((r.status, payload[:100]))
+                    rec["n"] += 1
+                conn.close()
+            except BaseException as e:  # a drop IS the failure signal
+                rec["error"] = repr(e)
+
+        threads = [threading.Thread(target=client, args=(rec,))
+                   for rec in results]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(1.0)  # steady request stream before the deploy
+            status, rbody = _post(port, "/reload")
+            assert status == 200 and "all workers" in rbody["message"]
+
+            # the swap completes while the load keeps running: fresh
+            # connections must find every worker on the new instance
+            def all_new(seen):
+                return (len(seen) >= 2
+                        and all(v not in old_ids for v in seen.values()))
+
+            after = _query_until(port, deadline_s=30, want=all_new,
+                                 tries=600)
+            assert all_new(after), (
+                f"pool still on the old instance mid-load: {after}")
+            time.sleep(0.5)  # post-swap tail under load
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+        assert not any(t.is_alive() for t in threads), "client hung"
+        drops = [r["error"] for r in results if r["error"]]
+        assert not drops, f"connections dropped during the reload: {drops}"
+        bad = [b for r in results for b in r["bad"]]
+        assert not bad, f"non-200 answers during the reload: {bad[:5]}"
+        assert all(r["n"] > 0 for r in results), results
+        status, q = _post(port, "/queries.json", {"user": "u0", "num": 3})
+        assert status == 200
+        assert q["itemScores"][0]["item"] == expected["u0"]
 
     def test_pool_serves_multi_algorithm_blend(self, tmp_path):
         """The two round-5 serving features composed: a worker pool
