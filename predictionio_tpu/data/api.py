@@ -14,9 +14,10 @@ EventServiceActor» (SURVEY.md §2.2/§3.3 [U]). Routes:
 
 Auth is by access key (query param or `Authorization` header), scoped to the
 key's app and optional event-name whitelist, exactly like the reference.
-The reference runs this on Akka + spray-can; a threaded stdlib HTTP server
-is the idiomatic zero-dependency Python equivalent — the TPU is never on
-this path, so throughput is bounded by SQLite writes, not the server.
+The reference runs this on Akka + spray-can; the Python equivalent is the
+shared selector event loop (utils/httploop.py) with handlers registered on
+a pre-parsed Router — the handlers here are plain `fn(Request) -> Response`
+functions, transport-free.
 
 Single-event writes (`POST /events.json` and the webhook connectors) go
 through the ingest write plane (predictionio_tpu/ingest): concurrent
@@ -32,11 +33,18 @@ from __future__ import annotations
 import json
 import time
 from typing import Optional
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs
 
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
-from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+from predictionio_tpu.utils import fastjson
+from predictionio_tpu.utils.http import HttpService
+from predictionio_tpu.utils.routing import (
+    Request,
+    Response,
+    Router,
+    path_param,
+)
 
 from predictionio_tpu.data.events import (
     Event,
@@ -68,8 +76,8 @@ class Stats:
 
     Backed by the telemetry registry: the pre-telemetry version bumped a
     plain collections.Counter without holding its lock on the update path,
-    which under ThreadingHTTPServer (one thread per connection) could drop
-    increments. Registry counters take their family lock on every inc."""
+    which under concurrent handler threads could drop increments.
+    Registry counters take their family lock on every inc."""
 
     def __init__(self):
         self.start_time = time.time()
@@ -109,29 +117,42 @@ class EventServerConfig:
 # is per-request hot path.
 _AKEY_CACHE_TTL_S = 5.0
 
+_ALIVE = Response(200, body=fastjson.dumps_bytes({"status": "alive"}))
 
-class _EventHandler(JsonRequestHandler):
-    server_version = "pio-tpu-eventserver/0.1"
 
-    # injected by create_event_server
-    storage: Storage
-    stats: Optional[Stats]
-    plugins = None  # Optional[PluginRegistry]
-    ingest: GroupCommitWriter
-    akey_cache: dict
+class _EventRoutes:
+    """The event server's route handlers, bound once to server state."""
+
+    def __init__(self, storage: Storage, stats: Optional[Stats], plugins,
+                 ingest: GroupCommitWriter):
+        self.storage = storage
+        self.stats = stats
+        self.plugins = plugins
+        self.ingest = ingest
+        self.akey_cache: dict = {}
+
+    def router(self) -> Router:
+        r = Router()
+        r.get("/", self._handle_root)
+        r.get("/events.json", self._handle_find, blocking=True)
+        r.get("/stats.json", self._handle_stats)
+        r.add_prefix("GET", "/events/", ".json", self._handle_get_event,
+                     template="/events/<id>.json", blocking=True)
+        r.post("/events.json", self._handle_insert, blocking=True)
+        r.post("/batch/events.json", self._handle_batch, blocking=True)
+        r.add_prefix("POST", "/webhooks/", ".json", self._handle_webhook,
+                     template="/webhooks/<connector>.json", blocking=True)
+        r.add_prefix("DELETE", "/events/", ".json", self._handle_delete,
+                     template="/events/<id>.json", blocking=True)
+        return r
 
     # -- helpers -----------------------------------------------------------
-    _send_json = JsonRequestHandler.send_json
-
-    def _query(self) -> dict[str, str]:
-        qs = parse_qs(urlparse(self.path).query)
-        return {k: v[0] for k, v in qs.items()}
-
-    def _auth(self, q: dict[str, str]):
+    def _auth(self, req: Request):
         """Resolve access key → (AccessKey, app_id, channel_id) or None."""
+        q = req.params
         key = q.get("accessKey")
         if key is None:
-            auth = self.headers.get("Authorization", "")
+            auth = req.headers.get("Authorization", "")
             if auth.startswith("Basic "):
                 import base64
 
@@ -166,9 +187,8 @@ class _EventHandler(JsonRequestHandler):
             channel_id = channels[channel_name].id
         return access_key, access_key.app_id, channel_id
 
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(length) if length else b""
+    _UNAUTHORIZED = Response(
+        401, body=fastjson.dumps_bytes({"message": "Invalid accessKey."}))
 
     def _validate_event(self, d: dict, access_key, app_id: int,
                         channel_id) -> Event:
@@ -202,199 +222,198 @@ class _EventHandler(JsonRequestHandler):
             self.stats.update(app_id, event.event, 201)
         return eid
 
-    def _shed(self, app_id: int, e: IngestOverload):
+    def _shed(self, app_id: int, e: IngestOverload) -> Response:
         """429 + Retry-After for a write-plane overload (same HTTP
         mapping as the serving plane's ShedLoad)."""
         if self.stats:
             self.stats.update(app_id, "<shed>", 429)
-        return self._send_json(
-            429, {"message": str(e)},
-            headers={"Retry-After": f"{e.retry_after_s:g}"})
+        return Response.message(
+            429, str(e), headers={"Retry-After": f"{e.retry_after_s:g}"})
 
     # -- routes ------------------------------------------------------------
-    def do_GET(self):
-        path = urlparse(self.path).path
-        q = self._query()
-        if path == "/":
-            return self._send_json(200, {"status": "alive"})
-        auth = self._auth(q)
+    def _handle_root(self, req: Request) -> Response:
+        return _ALIVE
+
+    def _handle_find(self, req: Request) -> Response:
+        auth = self._auth(req)
         if auth is None:
-            return self._send_json(401, {"message": "Invalid accessKey."})
-        access_key, app_id, channel_id = auth
-
-        if path == "/events.json":
-            try:
-                events = self.storage.l_events().find(
-                    app_id=app_id,
-                    channel_id=channel_id,
-                    start_time=parse_time(q["startTime"]) if "startTime" in q else None,
-                    until_time=parse_time(q["untilTime"]) if "untilTime" in q else None,
-                    entity_type=q.get("entityType"),
-                    entity_id=q.get("entityId"),
-                    event_names=[q["event"]] if "event" in q else None,
-                    target_entity_type=q.get("targetEntityType"),
-                    target_entity_id=q.get("targetEntityId"),
-                    limit=int(q.get("limit", DEFAULT_FIND_LIMIT)),
-                    reversed=q.get("reversed", "false").lower() == "true",
-                )
-            except (ValueError, EventValidationError) as e:
-                return self._send_json(400, {"message": str(e)})
-            return self._send_json(200, [e.to_dict() for e in events])
-
-        if path.startswith("/events/") and path.endswith(".json"):
-            eid = unquote(path[len("/events/") : -len(".json")])
-            event = self.storage.l_events().get(eid, app_id, channel_id)
-            if event is None:
-                return self._send_json(404, {"message": "Not Found"})
-            return self._send_json(200, event.to_dict())
-
-        if path == "/stats.json":
-            if self.stats is None:
-                return self._send_json(
-                    404, {"message": "To see stats, launch Event Server with --stats."}
-                )
-            return self._send_json(200, self.stats.snapshot(app_id))
-
-        return self._send_json(404, {"message": "Not Found"})
-
-    def do_POST(self):
-        path = urlparse(self.path).path
-        q = self._query()
-        # Drain the body before any early reply: with HTTP/1.1 keep-alive,
-        # unread body bytes would be parsed as the next request line.
-        body = self._read_body()
-        auth = self._auth(q)
-        if auth is None:
-            return self._send_json(401, {"message": "Invalid accessKey."})
-        access_key, app_id, channel_id = auth
-
-        if path == "/events.json":
-            try:
-                d = json.loads(body or b"{}")
-                eid = self._insert_event(d, access_key, app_id, channel_id)
-            except IngestOverload as e:
-                return self._shed(app_id, e)
-            except PluginRejection as e:
-                if self.stats:
-                    self.stats.update(app_id, "<blocked>", 403)
-                return self._send_json(403, {"message": str(e)})
-            except (EventValidationError, json.JSONDecodeError, ValueError) as e:
-                if self.stats:
-                    self.stats.update(app_id, "<invalid>", 400)
-                return self._send_json(400, {"message": str(e)})
-            return self._send_json(201, {"eventId": eid})
-
-        if path == "/batch/events.json":
-            try:
-                items = json.loads(body or b"[]")
-                if not isinstance(items, list):
-                    raise ValueError("batch body must be a JSON array")
-            except (json.JSONDecodeError, ValueError) as e:
-                return self._send_json(400, {"message": str(e)})
-            if len(items) > BATCH_LIMIT:
-                return self._send_json(
-                    400,
-                    {"message": f"Batch request must have less than or equal to "
-                                f"{BATCH_LIMIT} events"},
-                )
-            # two-phase: validate every row first (per-row statuses), then
-            # store the valid ones in ONE transaction via insert_batch
-            results: list = []
-            prepared: list[tuple[int, Event]] = []
-            for i, d in enumerate(items):
-                try:
-                    event = self._validate_event(d, access_key, app_id,
-                                                 channel_id)
-                    prepared.append((i, event))
-                    results.append(None)  # filled after the batch insert
-                except PluginRejection as e:
-                    if self.stats:
-                        self.stats.update(app_id, "<blocked>", 403)
-                    results.append({"status": 403, "message": str(e)})
-                except (EventValidationError, ValueError) as e:
-                    results.append({"status": 400, "message": str(e)})
-            if prepared:
-                le = self.storage.l_events()
-                try:
-                    ids = le.insert_batch(
-                        [e for _, e in prepared], app_id, channel_id)
-                except le.integrity_errors:
-                    # duplicate caller-set eventId somewhere in the chunk:
-                    # the transaction rolled back — redo per event so only
-                    # the offending rows 400. Each row commits individually
-                    # here, so a non-integrity failure must become THAT
-                    # row's status, not a request-wide 500 that would
-                    # discard the statuses of rows already committed (a
-                    # naive full-batch retry would then duplicate them).
-                    ids = []
-                    for _, event in prepared:
-                        try:
-                            ids.append(le.insert(event, app_id, channel_id))
-                        except le.integrity_errors:
-                            ids.append(None)
-                        except Exception as e:  # noqa: BLE001
-                            ids.append(e)
-                for (i, event), eid in zip(prepared, ids):
-                    if eid is None:
-                        results[i] = {"status": 400, "message":
-                                      f"duplicate eventId {event.event_id!r}"}
-                        continue
-                    if isinstance(eid, Exception):
-                        results[i] = {"status": 500, "message": str(eid)}
-                        continue
-                    results[i] = {"status": 201, "eventId": eid}
-                    if self.stats:
-                        self.stats.update(app_id, event.event, 201)
-            return self._send_json(200, results)
-
-        if path.startswith("/webhooks/") and path.endswith(".json"):
-            form = self.headers.get("Content-Type", "").startswith(
-                "application/x-www-form-urlencoded"
-            )
-            name = path[len("/webhooks/") : -len(".json")]
-            connector = get_connector(name, form=form)
-            if connector is None:
-                return self._send_json(404, {"message": f"Unknown connector {name!r}"})
-            try:
-                if form:
-                    payload = {k: v[0] for k, v in parse_qs(body.decode()).items()}
-                else:
-                    payload = json.loads(body or b"{}")
-                if not isinstance(payload, dict):
-                    raise ValueError("webhook payload must be a JSON object")
-                event_dict = connector.to_event_dict(payload)
-                eid = self._insert_event(event_dict, access_key, app_id, channel_id)
-            except IngestOverload as e:
-                return self._shed(app_id, e)
-            except PluginRejection as e:
-                if self.stats:
-                    self.stats.update(app_id, "<blocked>", 403)
-                return self._send_json(403, {"message": str(e)})
-            except (EventValidationError, json.JSONDecodeError, ValueError, KeyError) as e:
-                return self._send_json(400, {"message": str(e)})
-            return self._send_json(201, {"eventId": eid})
-
-        return self._send_json(404, {"message": "Not Found"})
-
-    def do_DELETE(self):
-        path = urlparse(self.path).path
-        q = self._query()
-        self._read_body()  # drain for keep-alive correctness
-        auth = self._auth(q)
-        if auth is None:
-            return self._send_json(401, {"message": "Invalid accessKey."})
+            return self._UNAUTHORIZED
         _, app_id, channel_id = auth
-        if path.startswith("/events/") and path.endswith(".json"):
-            eid = unquote(path[len("/events/") : -len(".json")])
-            ok = self.storage.l_events().delete(eid, app_id, channel_id)
-            if ok:
-                return self._send_json(200, {"message": "Found"})
-            return self._send_json(404, {"message": "Not Found"})
-        return self._send_json(404, {"message": "Not Found"})
+        q = req.params
+        try:
+            events = self.storage.l_events().find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=parse_time(q["startTime"]) if "startTime" in q else None,
+                until_time=parse_time(q["untilTime"]) if "untilTime" in q else None,
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                target_entity_type=q.get("targetEntityType"),
+                target_entity_id=q.get("targetEntityId"),
+                limit=int(q.get("limit", DEFAULT_FIND_LIMIT)),
+                reversed=q.get("reversed", "false").lower() == "true",
+            )
+        except (ValueError, EventValidationError) as e:
+            return Response.message(400, str(e))
+        return Response.json(200, [e.to_dict() for e in events])
+
+    def _handle_get_event(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        _, app_id, channel_id = auth
+        eid = path_param(req.path, "/events/", ".json")
+        event = self.storage.l_events().get(eid, app_id, channel_id)
+        if event is None:
+            return Response.message(404, "Not Found")
+        return Response.json(200, event.to_dict())
+
+    def _handle_stats(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        _, app_id, _ = auth
+        if self.stats is None:
+            return Response.message(
+                404, "To see stats, launch Event Server with --stats.")
+        return Response.json(200, self.stats.snapshot(app_id))
+
+    def _handle_insert(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        access_key, app_id, channel_id = auth
+        try:
+            d = fastjson.loads(req.body or b"{}")
+            eid = self._insert_event(d, access_key, app_id, channel_id)
+        except IngestOverload as e:
+            return self._shed(app_id, e)
+        except PluginRejection as e:
+            if self.stats:
+                self.stats.update(app_id, "<blocked>", 403)
+            return Response.message(403, str(e))
+        except (EventValidationError, json.JSONDecodeError, ValueError) as e:
+            if self.stats:
+                self.stats.update(app_id, "<invalid>", 400)
+            return Response.message(400, str(e))
+        return Response(201, body=fastjson.event_id_response(eid))
+
+    def _handle_batch(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        access_key, app_id, channel_id = auth
+        try:
+            items = fastjson.loads(req.body or b"[]")
+            if not isinstance(items, list):
+                raise ValueError("batch body must be a JSON array")
+        except (json.JSONDecodeError, ValueError) as e:
+            return Response.message(400, str(e))
+        if len(items) > BATCH_LIMIT:
+            return Response.message(
+                400, f"Batch request must have less than or equal to "
+                     f"{BATCH_LIMIT} events")
+        # two-phase: validate every row first (per-row statuses), then
+        # store the valid ones in ONE transaction via insert_batch
+        results: list = []
+        prepared: list[tuple[int, Event]] = []
+        for i, d in enumerate(items):
+            try:
+                event = self._validate_event(d, access_key, app_id,
+                                             channel_id)
+                prepared.append((i, event))
+                results.append(None)  # filled after the batch insert
+            except PluginRejection as e:
+                if self.stats:
+                    self.stats.update(app_id, "<blocked>", 403)
+                results.append({"status": 403, "message": str(e)})
+            except (EventValidationError, ValueError) as e:
+                results.append({"status": 400, "message": str(e)})
+        if prepared:
+            le = self.storage.l_events()
+            try:
+                ids = le.insert_batch(
+                    [e for _, e in prepared], app_id, channel_id)
+            except le.integrity_errors:
+                # duplicate caller-set eventId somewhere in the chunk:
+                # the transaction rolled back — redo per event so only
+                # the offending rows 400. Each row commits individually
+                # here, so a non-integrity failure must become THAT
+                # row's status, not a request-wide 500 that would
+                # discard the statuses of rows already committed (a
+                # naive full-batch retry would then duplicate them).
+                ids = []
+                for _, event in prepared:
+                    try:
+                        ids.append(le.insert(event, app_id, channel_id))
+                    except le.integrity_errors:
+                        ids.append(None)
+                    except Exception as e:  # noqa: BLE001
+                        ids.append(e)
+            for (i, event), eid in zip(prepared, ids):
+                if eid is None:
+                    results[i] = {"status": 400, "message":
+                                  f"duplicate eventId {event.event_id!r}"}
+                    continue
+                if isinstance(eid, Exception):
+                    results[i] = {"status": 500, "message": str(eid)}
+                    continue
+                results[i] = {"status": 201, "eventId": eid}
+                if self.stats:
+                    self.stats.update(app_id, event.event, 201)
+            self.ingest.notify_committed(
+                [e for (_, e), eid in zip(prepared, ids)
+                 if eid is not None and not isinstance(eid, Exception)])
+        return Response.json(200, results)
+
+    def _handle_webhook(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        access_key, app_id, channel_id = auth
+        form = req.headers.get("Content-Type", "").startswith(
+            "application/x-www-form-urlencoded")
+        name = path_param(req.path, "/webhooks/", ".json")
+        connector = get_connector(name, form=form)
+        if connector is None:
+            return Response.message(404, f"Unknown connector {name!r}")
+        try:
+            if form:
+                payload = {k: v[0]
+                           for k, v in parse_qs(req.body.decode()).items()}
+            else:
+                payload = fastjson.loads(req.body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("webhook payload must be a JSON object")
+            event_dict = connector.to_event_dict(payload)
+            eid = self._insert_event(event_dict, access_key, app_id,
+                                     channel_id)
+        except IngestOverload as e:
+            return self._shed(app_id, e)
+        except PluginRejection as e:
+            if self.stats:
+                self.stats.update(app_id, "<blocked>", 403)
+            return Response.message(403, str(e))
+        except (EventValidationError, json.JSONDecodeError, ValueError,
+                KeyError) as e:
+            return Response.message(400, str(e))
+        return Response(201, body=fastjson.event_id_response(eid))
+
+    def _handle_delete(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            return self._UNAUTHORIZED
+        _, app_id, channel_id = auth
+        eid = path_param(req.path, "/events/", ".json")
+        ok = self.storage.l_events().delete(eid, app_id, channel_id)
+        if ok:
+            return Response.message(200, "Found")
+        return Response.message(404, "Not Found")
 
 
 class EventServer(HttpService):
-    """Owns the HTTP server thread; `create_event_server` is the reference's
+    """Owns the HTTP transport; `create_event_server` is the reference's
     factory spelling."""
 
     def __init__(self, config: EventServerConfig, storage: Optional[Storage] = None,
@@ -405,9 +424,9 @@ class EventServer(HttpService):
         self.storage = storage or Storage.get()
         self.stats = Stats() if config.stats else None
         self.plugins = plugins if plugins is not None else load_plugins_from_env()
-        # one write plane per server: every handler thread's single-event
-        # insert funnels into it (repos are stateless wrappers over the
-        # backend, so binding the two entry points once is safe)
+        # one write plane per server: every handler's single-event insert
+        # funnels into it (repos are stateless wrappers over the backend,
+        # so binding the two entry points once is safe)
         le = self.storage.l_events()
         self.ingest = GroupCommitWriter(
             insert_fn=le.insert,
@@ -415,14 +434,10 @@ class EventServer(HttpService):
             config=ingest_config or IngestConfig.from_env(),
             name="eventserver")
 
-        handler = type(
-            "BoundEventHandler",
-            (_EventHandler,),
-            {"storage": self.storage, "stats": self.stats,
-             "plugins": self.plugins, "ingest": self.ingest,
-             "akey_cache": {}},
-        )
-        super().__init__(config.ip, config.port, handler,
+        self.routes = _EventRoutes(self.storage, self.stats, self.plugins,
+                                   self.ingest)
+        super().__init__(config.ip, config.port,
+                         router=self.routes.router(),
                          server_name="eventserver")
 
     def shutdown(self) -> None:

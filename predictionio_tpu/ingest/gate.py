@@ -5,10 +5,11 @@ Run via `python quality.py --ingest-gate`. Mirrors the serving gate's
 two layers:
 
 1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
-   any `do_*` HTTP handler that routes single-event `POST /events.json`
-   (and the `/webhooks/` connectors) must funnel through
-   `_insert_event`, and `_insert_event` itself must call the write
-   plane's `submit` — never a bare storage `insert` — because a direct
+   any handler that routes single-event `POST /events.json` — a legacy
+   `do_*` method or a function registered on a Router
+   (`router.post("/events.json", self._handle_insert)`) — must funnel
+   through `_insert_event`, and `_insert_event` itself must call the
+   write plane's `submit` — never a bare storage `insert` — because a direct
    insert has no coalescing, no durable-before-201 ordering from the
    shared commit, and no shed path. (`/batch/events.json`'s handler is
    allowed its direct `insert_batch`/`insert` calls: the chunk already
@@ -30,6 +31,8 @@ from __future__ import annotations
 import ast
 import os
 import sys
+
+from predictionio_tpu.utils import route_scan
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -86,6 +89,26 @@ def _scan_file(path: str, rel: str) -> tuple[list[str], bool, bool]:
                     f"ingest write plane (_insert_event/submit) — "
                     f"single-event writes must get group commit and "
                     f"backpressure")
+    # event-loop transport: resolve router.post("/events.json", fn) back
+    # to fn's FunctionDef and hold it to the same funnel contract (POST
+    # only — GET /events.json is the read route)
+    for handler in route_scan.handlers_for(tree, _EVENTS_ROUTE,
+                                           method="POST"):
+        saw_route = True
+        if not isinstance(handler, ast.FunctionDef):
+            problems.append(
+                f"{rel}: POST {_EVENTS_ROUTE} is registered to a lambda — "
+                f"the write handler must be a named function the gate can "
+                f"hold to the write-plane contract")
+        elif not (_PLANE_ENTRIES & _attr_calls(handler)):
+            problems.append(
+                f"{rel}:{handler.lineno}: {handler.name} routes "
+                f"{_EVENTS_ROUTE} without dispatching through the ingest "
+                f"write plane (_insert_event/submit) — single-event "
+                f"writes must get group commit and backpressure")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
         if node.name == "_insert_event":
             saw_funnel = True
             calls = _attr_calls(node)

@@ -1,0 +1,70 @@
+"""Commit-notification bus: ingest write plane → serving result cache.
+
+The serving plane's optional per-user result cache answers /queries.json
+from memory; this bus is what keeps it read-your-writes. Every durable
+commit path in the write plane (inline lone commit, grouped commit,
+per-item fallback, and the batch route's direct insert_batch) publishes
+the entity ids of the committed events; subscribers (the result cache)
+drop whatever they hold for those entities.
+
+Deliberately minimal:
+
+- process-local. The cache and the write plane live in the same process
+  per SO_REUSEPORT worker; a worker's cache can go stale only for writes
+  landing on a *different* worker, which is why the cache also carries a
+  short TTL (PIO_HTTP_RESULT_CACHE_TTL_S) as the cross-process bound.
+- zero hot-path cost when unused: publishers check `has_subscribers`
+  (one attribute read) before building the entity-id list, so ingest
+  pays nothing unless a result cache is actually enabled.
+- subscriber errors are contained: a broken subscriber cannot fail a
+  commit that is already durable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Iterable, List
+
+log = logging.getLogger(__name__)
+
+
+class InvalidationBus:
+    __slots__ = ("_subs", "_lock")
+
+    def __init__(self):
+        self._subs: List[Callable[[Iterable[str]], None]] = []
+        self._lock = threading.Lock()
+
+    @property
+    def has_subscribers(self) -> bool:
+        return bool(self._subs)
+
+    def subscribe(self, fn: Callable[[Iterable[str]], None]) -> None:
+        with self._lock:
+            if fn not in self._subs:
+                # replace the list instead of mutating it so publish()
+                # iterates a stable snapshot without taking the lock
+                self._subs = self._subs + [fn]
+
+    def unsubscribe(self, fn: Callable[[Iterable[str]], None]) -> None:
+        # equality, not identity: bound methods (cache.invalidate_entities,
+        # list.append) are fresh objects on every attribute access, and
+        # subscribe's dedup (`fn not in ...`) already compares by equality
+        with self._lock:
+            self._subs = [s for s in self._subs if s != fn]
+
+    def publish(self, entity_ids: Iterable[str]) -> None:
+        """Fan committed entity ids out to every subscriber. Called by
+        the write plane AFTER the commit is durable — a subscriber that
+        invalidates on this signal can never cache ahead of storage."""
+        for fn in self._subs:
+            try:
+                fn(entity_ids)
+            except Exception:
+                log.exception("invalidation subscriber failed")
+
+
+# One bus per process: the write plane publishes here unconditionally,
+# whichever server object owns it; caches subscribe at construction.
+BUS = InvalidationBus()

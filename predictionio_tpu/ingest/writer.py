@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from predictionio_tpu.ingest.invalidation import BUS
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -314,7 +315,20 @@ class GroupCommitWriter:
             t0 = time.perf_counter()
             eid = self.insert_fn(event, app_id, channel_id)
             _COMMIT_SECONDS.observe(time.perf_counter() - t0)
+        self.notify_committed((event,))
         return eid
+
+    def notify_committed(self, events) -> None:
+        """Publish committed events' entity ids on the invalidation bus
+        (serving result cache drops those users' entries). Called after
+        every durable commit path here, and by the batch route whose
+        insert_batch bypasses this writer. Free when nothing subscribes."""
+        if not BUS.has_subscribers:
+            return
+        ids = [e.entity_id for e in events
+               if getattr(e, "entity_id", None)]
+        if ids:
+            BUS.publish(ids)
 
     # -- committer side ----------------------------------------------------
     def _take_group(self) -> Optional[List[_PendingWrite]]:
@@ -375,6 +389,9 @@ class GroupCommitWriter:
                 try:
                     r = self.insert_fn(*p.item)
                     p.commit_s = time.perf_counter() - t_item
+                    # invalidate BEFORE acknowledging: the waiter's 201
+                    # must imply the cache no longer serves stale answers
+                    self.notify_committed((p.item[0],))
                     p.finish(result=r)
                 except BaseException as item_e:  # noqa: BLE001
                     p.commit_s = time.perf_counter() - t_item
@@ -382,6 +399,7 @@ class GroupCommitWriter:
             return
         commit_s = time.perf_counter() - t0
         _COMMIT_SECONDS.observe(commit_s)
+        self.notify_committed([p.item[0] for p in group])
         for p, eid in zip(group, ids):
             p.commit_s = commit_s
             p.finish(result=eid)

@@ -365,10 +365,15 @@ def _worker_main(config, supervisor_pid: int, ctl_fd: int,
     def _quiesce(deadline_s: float) -> None:
         # Request quiescence, not connection count: established keep-alive
         # connections stay parked on this worker — what must reach zero is
-        # work in progress (HTTP handlers + admitted queries).
+        # work in progress. Three gauges cover both transports: HTTP
+        # handlers running (in-flight), admitted queries, and — on the
+        # event-loop transport — requests the loop has parsed but not
+        # fully answered (dispatched to a worker, or pipelined behind one
+        # and waiting their turn), which no handler-level gauge sees yet.
         t0 = time.monotonic()
         while time.monotonic() - t0 < deadline_s:
-            if in_flight_child.value <= 0 and _serving_in_flight() <= 0:
+            if in_flight_child.value <= 0 and _serving_in_flight() <= 0 \
+                    and server.busy_requests() <= 0:
                 return
             time.sleep(0.02)
 

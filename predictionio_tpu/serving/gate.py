@@ -4,12 +4,14 @@ Run via `python quality.py --serving-gate`. Mirrors the telemetry gate's
 two layers:
 
 1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
-   any `do_*` HTTP handler that routes `/queries.json` must call the
-   serving plane's `handle_query` (which is admit → dispatch → release),
-   and must not call an engine `predict`/`predict_batch` itself — a
-   handler that dispatches directly has no queue bound, no deadline
-   handling, and no shed path, which is exactly the saturation-collapse
-   mode this subsystem exists to prevent.
+   any handler that routes `/queries.json` — a legacy `do_*` HTTP method
+   or a function registered on a Router (`router.post("/queries.json",
+   self._handle_query)`) — must call the serving plane's `handle_query`
+   (which is admit → dispatch → release), and must not call an engine
+   `predict`/`predict_batch` itself — a handler that dispatches directly
+   has no queue bound, no deadline handling, and no shed path, which is
+   exactly the saturation-collapse mode this subsystem exists to
+   prevent.
 
 2. Runtime check: saturate a tiny ServingPlane (max_queue=1) and verify
    the second concurrent request raises ShedLoad carrying a positive
@@ -25,6 +27,8 @@ from __future__ import annotations
 import ast
 import os
 import sys
+
+from predictionio_tpu.utils import route_scan
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -66,18 +70,34 @@ def _scan_handler(fn: ast.FunctionDef, rel: str) -> list[str]:
     return problems
 
 
-def _scan_file(path: str, rel: str) -> list[str]:
+def _scan_file(path: str, rel: str) -> tuple[list[str], bool]:
+    """Returns (problems, saw_query_route)."""
     with open(path, encoding="utf-8") as f:
         try:
             tree = ast.parse(f.read(), filename=rel)
         except SyntaxError as e:
-            return [f"{rel}: unparseable ({e})"]
+            return [f"{rel}: unparseable ({e})"], False
     problems = []
+    saw_route = False
+    # legacy transport: do_* methods with the route constant inline
     for node in ast.walk(tree):
         if (isinstance(node, ast.FunctionDef) and node.name.startswith("do_")
                 and _contains_query_route(node)):
+            saw_route = True
             problems.extend(_scan_handler(node, rel))
-    return problems
+    # event-loop transport: resolve router.post("/queries.json", fn)
+    # back to fn's FunctionDef and hold it to the same contract
+    for handler in route_scan.handlers_for(tree, _QUERY_ROUTE,
+                                           method="POST"):
+        saw_route = True
+        if isinstance(handler, ast.FunctionDef):
+            problems.extend(_scan_handler(handler, rel))
+        else:
+            problems.append(
+                f"{rel}: {_QUERY_ROUTE} is registered to a lambda — the "
+                f"predict handler must be a named function the gate can "
+                f"hold to the admission contract")
+    return problems, saw_route
 
 
 def _static_scan() -> list[str]:
@@ -91,12 +111,9 @@ def _static_scan() -> list[str]:
             rel = os.path.relpath(path, _PKG_DIR)
             if rel in _EXEMPT:
                 continue
-            file_problems = _scan_file(path, rel)
+            file_problems, saw_route = _scan_file(path, rel)
             problems.extend(file_problems)
-            if not file_problems:
-                with open(path, encoding="utf-8") as f:
-                    if _QUERY_ROUTE in f.read():
-                        found_route = True
+            found_route = found_route or saw_route
     if not found_route:
         # the gate must notice if the predict route itself disappears —
         # an empty scan proves nothing

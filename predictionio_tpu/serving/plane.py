@@ -35,6 +35,7 @@ from predictionio_tpu.serving.admission import (
     deadline_from_headers,
 )
 from predictionio_tpu.serving.batcher import BatcherConfig, MicroBatcher
+from predictionio_tpu.serving.result_cache import MISS, ResultCache, cache_from_env
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils import faults
@@ -105,8 +106,22 @@ class ServingPlane:
                  dispatch_fn: Callable[[List], List],
                  degraded_fn: Optional[Callable] = None,
                  config: Optional[ServingConfig] = None,
-                 name: str = "predictionserver"):
+                 name: str = "predictionserver",
+                 result_cache: Optional[ResultCache] = None):
         self.config = config or ServingConfig()
+
+        # Optional per-user result cache (OFF unless PIO_HTTP_RESULT_CACHE
+        # opts in, or one is passed explicitly). Kept read-your-writes by
+        # the ingest write plane: every durable commit publishes its
+        # entity ids on the invalidation bus and this cache drops that
+        # user's entries (serving/result_cache.py has the full posture).
+        self.result_cache = (result_cache if result_cache is not None
+                             else cache_from_env())
+        if self.result_cache is not None:
+            from predictionio_tpu.ingest.invalidation import BUS
+
+            self._invalidate = self.result_cache.invalidate_entities
+            BUS.subscribe(self._invalidate)
 
         # `serving.pre_dispatch` fault site: after admission, before the
         # model runs — the chaos gate arms delay:/error modes here to turn
@@ -134,6 +149,12 @@ class ServingPlane:
         Raises ShedLoad (→ 429) when saturated and no degraded answer
         exists; DeadlineExceeded (→ 503) when the request's deadline
         expired before a result was produced."""
+        cache = self.result_cache
+        if cache is not None:
+            with spans.span("serving.result_cache"):
+                hit = cache.get(query)
+            if hit is not MISS:
+                return hit, False
         deadline = deadline_from_headers(headers, self.config.admission)
         try:
             with spans.span("serving.admission"):
@@ -145,11 +166,17 @@ class ServingPlane:
             raise
         try:
             if self.batcher is not None:
-                return self.batcher.submit(query, deadline), False
-            with spans.span("serving.dispatch"):
-                return self.dispatch_fn([query])[0], False
+                result = self.batcher.submit(query, deadline)
+            else:
+                with spans.span("serving.dispatch"):
+                    result = self.dispatch_fn([query])[0]
         finally:
             self.admission.release()
+        if cache is not None:
+            # full-quality results only: a degraded answer must never
+            # outlive the saturation that produced it
+            cache.put(query, result)
+        return result, False
 
     def _try_degraded(self, query):
         if self.degraded_fn is None:
@@ -166,3 +193,8 @@ class ServingPlane:
     def close(self) -> None:
         if self.batcher is not None:
             self.batcher.close()
+        if self.result_cache is not None:
+            from predictionio_tpu.ingest.invalidation import BUS
+
+            BUS.unsubscribe(self._invalidate)
+            self.result_cache.clear()

@@ -1,0 +1,168 @@
+"""Optional per-user result cache for the serving plane.
+
+A recommender's query stream is heavily repeated — the same user (or the
+same anonymous popularity query) asks for the same slate many times
+between events that would change the answer. With the transport and
+encode taxes paid down (utils/httploop.py, utils/fastjson.py), the
+remaining per-request cost on a repeated query is the dispatch itself;
+this cache removes it when the operator opts in.
+
+Correctness posture:
+
+- OFF by default (`PIO_HTTP_RESULT_CACHE=1` enables). The bench's parity
+  leg runs with it disabled, so A/B answers stay bitwise-equal.
+- read-your-writes within a worker: the cache subscribes to the ingest
+  invalidation bus (ingest/invalidation.py); every durable commit
+  publishes its events' entity ids and the cache drops that user's
+  entries before the writer's 201 is acknowledged. quality.py's
+  hotpath gate drills exactly this.
+- a short TTL (`PIO_HTTP_RESULT_CACHE_TTL_S`, default 5 s — same bound
+  the access-key cache uses) covers writes that land on a *different*
+  SO_REUSEPORT worker, where no in-process invalidation can arrive.
+- queries that carry no user key are indexed under "" and still
+  invalidated by ANY commit — an anonymous/popularity query can depend
+  on any event, so correctness beats retention.
+
+Capacity is LRU-bounded (`PIO_HTTP_RESULT_CACHE_SIZE`, default 1024
+entries); hits/misses/invalidations are observable as
+`http_result_cache_*` on /metrics and the dashboard's hot-path panel.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Iterable, Optional
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils import fastjson
+
+RESULT_HITS = REGISTRY.counter(
+    "http_result_cache_hits_total",
+    "Serving queries answered from the per-user result cache")
+RESULT_MISSES = REGISTRY.counter(
+    "http_result_cache_misses_total",
+    "Serving queries that missed the result cache and dispatched")
+RESULT_INVALIDATIONS = REGISTRY.counter(
+    "http_result_cache_invalidations_total",
+    "Result-cache entries dropped by ingest commit notifications")
+
+_HITS = RESULT_HITS.labels()
+_MISSES = RESULT_MISSES.labels()
+_INVALIDATIONS = RESULT_INVALIDATIONS.labels()
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+# sentinel distinguishing "miss" from a cached None result
+MISS = object()
+
+
+def cache_from_env() -> Optional["ResultCache"]:
+    """Build a cache when PIO_HTTP_RESULT_CACHE opts in; None otherwise."""
+    if os.environ.get("PIO_HTTP_RESULT_CACHE", "").strip().lower() \
+            not in _TRUTHY:
+        return None
+    size = int(float(os.environ.get("PIO_HTTP_RESULT_CACHE_SIZE") or 1024))
+    ttl = float(os.environ.get("PIO_HTTP_RESULT_CACHE_TTL_S") or 5.0)
+    return ResultCache(max_entries=size, ttl_s=ttl)
+
+
+class ResultCache:
+    """LRU + TTL map of canonical query → result, user-indexed so one
+    commit notification drops exactly that user's entries."""
+
+    def __init__(self, max_entries: int = 1024, ttl_s: float = 5.0):
+        self.max_entries = max_entries
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # key → (result, expires_at_monotonic, user)
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        # user → set of live keys (the invalidation index)
+        self._by_user: dict = {}
+
+    @staticmethod
+    def _key(query) -> Optional[str]:
+        try:
+            return fastjson.dumps(query)
+        except (TypeError, ValueError):
+            return None  # unhashable/unencodable query: never cached
+
+    @staticmethod
+    def _user(query) -> str:
+        if isinstance(query, dict):
+            user = query.get("user")
+            if user is not None:
+                return str(user)
+        return ""
+
+    def get(self, query):
+        """Return the cached result or the MISS sentinel."""
+        key = self._key(query)
+        if key is None:
+            _MISSES.inc()
+            return MISS
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] <= now:
+                if entry is not None:
+                    self._drop(key, entry)
+                _MISSES.inc()
+                return MISS
+            self._entries.move_to_end(key)
+            _HITS.inc()
+            return entry[0]
+
+    def put(self, query, result) -> None:
+        key = self._key(query)
+        if key is None:
+            return
+        user = self._user(query)
+        with self._lock:
+            old = self._entries.get(key)
+            if old is not None:
+                self._drop(key, old)
+            self._entries[key] = (result, time.monotonic() + self.ttl_s,
+                                  user)
+            self._by_user.setdefault(user, set()).add(key)
+            while len(self._entries) > self.max_entries:
+                evict_key, evict_entry = next(iter(self._entries.items()))
+                self._drop(evict_key, evict_entry)
+
+    def _drop(self, key: str, entry: tuple) -> None:
+        # lock held by caller
+        self._entries.pop(key, None)
+        keys = self._by_user.get(entry[2])
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                self._by_user.pop(entry[2], None)
+
+    def invalidate_entities(self, entity_ids: Iterable[str]) -> None:
+        """Ingest-commit hook (InvalidationBus subscriber): drop every
+        entry for the committed entities, plus all user-less entries —
+        an anonymous query may depend on any event."""
+        dropped = 0
+        with self._lock:
+            users = set(str(e) for e in entity_ids)
+            users.add("")
+            for user in users:
+                keys = self._by_user.pop(user, None)
+                if not keys:
+                    continue
+                for key in keys:
+                    if self._entries.pop(key, None) is not None:
+                        dropped += 1
+        if dropped:
+            _INVALIDATIONS.inc(dropped)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_user.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -143,9 +143,9 @@ def _serve_json(handler, obj, status: int = 200) -> None:
     handler.wfile.write(body)
 
 
-def serve_debug_requests(handler, raw_path: str) -> None:
+def _debug_requests_payload(raw_target: str) -> tuple:
     """GET /debug/requests.json?limit=&route=&kind= — ring dump."""
-    params = parse_qs(urlparse(raw_path).query)
+    params = parse_qs(urlparse(raw_target).query)
 
     def _one(name):
         vals = params.get(name)
@@ -157,23 +157,31 @@ def serve_debug_requests(handler, raw_path: str) -> None:
         limit = 50
     kind = _one("kind")
     if kind not in (None, "pinned", "sampled"):
-        return _serve_json(handler, {"error": "kind must be pinned|sampled"},
-                           status=400)
+        return 400, {"error": "kind must be pinned|sampled"}
     entries = RECORDER.snapshot(limit=limit, route=_one("route"), kind=kind)
-    _serve_json(handler, {"entries": entries, "sizes": RECORDER.sizes()})
+    return 200, {"entries": entries, "sizes": RECORDER.sizes()}
 
 
-def serve_debug_request_by_id(handler, path: str) -> None:
+def _debug_request_by_id_payload(path: str) -> tuple:
     """GET /debug/requests/<trace_id>.json — one timeline by trace id."""
     trace_id = path[len("/debug/requests/"):-len(".json")]
     if not tracing._SAFE_TRACE_ID.match(trace_id):
-        return _serve_json(handler, {"error": "bad trace id"}, status=400)
+        return 400, {"error": "bad trace id"}
     entry = RECORDER.get(trace_id)
     if entry is None:
-        return _serve_json(
-            handler, {"error": "trace not held by the flight recorder",
-                      "trace_id": trace_id}, status=404)
-    _serve_json(handler, entry)
+        return 404, {"error": "trace not held by the flight recorder",
+                     "trace_id": trace_id}
+    return 200, entry
+
+
+def serve_debug_requests(handler, raw_path: str) -> None:
+    status, obj = _debug_requests_payload(raw_path)
+    _serve_json(handler, obj, status=status)
+
+
+def serve_debug_request_by_id(handler, path: str) -> None:
+    status, obj = _debug_request_by_id_payload(path)
+    _serve_json(handler, obj, status=status)
 
 
 def _run_instrumented(self, http_method: str, orig) -> None:
@@ -315,3 +323,157 @@ def instrument(handler_cls: Type, server_name: str) -> Type:
     ns["send_response"] = send_response
     ns["send_error"] = send_error
     return type(handler_cls.__name__ + "Instrumented", (handler_cls,), ns)
+
+
+# -- function-level instrumentation (event-loop transport) --------------------
+#
+# The selector loop dispatches plain `fn(Request) -> Response` routes, not
+# BaseHTTPRequestHandler methods, so class wrapping cannot apply. run_route
+# is _run_instrumented for that world: same counters, same trace
+# propagation, same timeline + flight-recorder offer, same access-log
+# format — plus the transport's parse/dispatch/encode stamps, which only
+# exist on this path.
+
+_KNOWN_VERBS = ("GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH")
+
+
+def run_route(server: str, req, route, instrument: bool = True) -> tuple:
+    """Run one routed request with full telemetry; returns
+    (Response with rendered body, trace_id). Never raises: handler
+    escapes become a counted-and-logged 500 (the threaded transport's
+    handle_error contract), because the calling thread is a long-lived
+    loop/worker thread, not a per-request thread that may die."""
+    from predictionio_tpu.utils import routing
+
+    if not instrument:
+        try:
+            resp = route.fn(req)
+        except Exception:
+            logging.getLogger("predictionio_tpu.http").warning(
+                "exception processing request", exc_info=True)
+            resp = routing.Response.message(500, "Internal Server Error")
+        resp.render_body()
+        return resp, ""
+
+    path = req.path
+    route_tmpl = route.template
+    ctx, inbound = tracing.context_from_headers(req.headers)
+    token = tracing.activate(ctx)
+    introspect = path == "/metrics" or path.startswith("/debug/requests")
+    tl = tl_token = None
+    if not introspect:
+        tl, tl_token = spans.begin(server, route_tmpl, req.method,
+                                   ctx.trace_id)
+        if req.headers.get(DEBUG_HEADER):
+            tl.pinned = True
+        if req._t_parsed:
+            # Transport stamps land on the timeline's own monotonic axis.
+            # Offsets are negative — the bytes were read and parsed before
+            # this handler started — which is exactly the point: the
+            # breakdown shows how much pre-handler time the transport
+            # charged this request.
+            tl.record("http.parse", req._t_recv - tl.t0,
+                      max(0.0, req._t_parsed - req._t_recv))
+            tl.record("http.dispatch", req._t_parsed - tl.t0,
+                      max(0.0, tl.t0 - req._t_parsed))
+    in_flight = _in_flight(server)
+    in_flight.inc()
+    t0 = time.perf_counter()
+    failed = False
+    try:
+        if not introspect and "jax" in sys.modules:
+            key = (server, req.method, route_tmpl)
+            name = _ANN_NAMES.get(key)
+            if name is None:
+                name = _ANN_NAMES[key] = \
+                    f"{server} {req.method} {route_tmpl}"
+            ann = tracing._jax_annotation(name)
+            if ann is not None:
+                try:
+                    ann.__enter__()
+                except Exception:
+                    ann = None
+            try:
+                resp = route.fn(req)
+            finally:
+                if ann is not None:
+                    try:
+                        ann.__exit__(None, None, None)
+                    except Exception:
+                        pass
+        else:
+            resp = route.fn(req)
+        if resp.body is None:
+            if tl is not None:
+                enc0 = time.monotonic()
+                resp.render_body()
+                tl.record("http.encode", enc0 - tl.t0,
+                          time.monotonic() - enc0)
+            else:
+                resp.render_body()
+    except BaseException:
+        failed = True
+        HTTP_ERRORS.labels(server=server).inc()
+        logging.getLogger("predictionio_tpu.http").warning(
+            "exception processing request trace=%s", ctx.trace_id,
+            exc_info=True)
+        resp = routing.Response.message(500, "Internal Server Error")
+        resp.render_body()
+    finally:
+        in_flight.dec()
+        duration = time.perf_counter() - t0
+        status = resp.status if not failed else 500
+        record_request(server, req.method, route_tmpl, status, duration)
+        if tl is not None:
+            spans.finish(tl, tl_token, status, duration, error=failed)
+            RECORDER.offer(tl)
+        access_logger.log(
+            logging.INFO if inbound else logging.DEBUG,
+            "%s %s %s -> %s %.1fms trace=%s",
+            server, req.method, route_tmpl, status, duration * 1e3,
+            ctx.trace_id)
+        tracing.deactivate(token)
+    return resp, ctx.trace_id
+
+
+def record_parse_layer(server: str, verb: str, status: int) -> str:
+    """Parse-layer error accounting for the event-loop transport: mint a
+    trace id and count the request under capped labels — mirror of the
+    instrumented send_error override, which handles the same errors on
+    the threaded transport before any do_* wrapper runs."""
+    ctx, _ = tracing.context_from_headers(None)
+    if verb not in _KNOWN_VERBS:
+        verb = "<other>"
+    record_request(server, verb, "<other>", int(status), 0.0)
+    return ctx.trace_id
+
+
+def _metrics_route(req):
+    from predictionio_tpu.utils import routing
+
+    slo.refresh()
+    return routing.Response(200, body=REGISTRY.render().encode(),
+                            content_type=METRICS_CONTENT_TYPE)
+
+
+def _debug_list_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _debug_requests_payload(req.target)
+    return routing.Response.json(status, obj)
+
+
+def _debug_one_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _debug_request_by_id_payload(req.path)
+    return routing.Response.json(status, obj)
+
+
+def register_builtin_routes(router) -> None:
+    """Every routed service exposes /metrics and the flight-recorder
+    debug routes, same as instrument() guarantees for handler classes."""
+    router.get("/metrics", _metrics_route)
+    router.get(_DEBUG_LIST_ROUTE, _debug_list_route)
+    router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
+                      template=_DEBUG_ONE_ROUTE)

@@ -54,6 +54,12 @@ control endpoint — <code>/status.json</code> on the port announced as
 random sample of the rest) — newest first, full JSON at
 <a href="/debug/requests.json">/debug/requests.json</a>.</p>
 {flight}
+<h2>HTTP hot path</h2>
+<p>Event-loop transport health: parked keep-alive connections, requests
+amortized per connection, and the encode-side caches (encoder envelope
+cache; per-user result cache with its ingest-commit invalidations).
+Raw families: <code>http_*</code> on <a href="/metrics">/metrics</a>.</p>
+{hotpath}
 <h2>Telemetry</h2>
 <p>Process-local metrics; the raw Prometheus view is at
 <a href="/metrics">/metrics</a>.</p>
@@ -199,6 +205,52 @@ def _flight_table() -> str:
     return "".join(out)
 
 
+def _ratio(hits: float, misses: float) -> str:
+    total = hits + misses
+    if not total:
+        return "—"
+    return f"{hits / total:.1%} ({hits:g}/{total:g})"
+
+
+def _sum_counter(m) -> float:
+    return sum(value for _key, value in m.collect()) if m is not None else 0.0
+
+
+def _hotpath_table(registry=REGISTRY) -> str:
+    rows = []
+    parked = registry.get("http_parked_connections")
+    if parked is not None:
+        for key, value in sorted(parked.collect()):
+            rows.append(("parked connections",
+                         _label_str(parked.labelnames, key), f"{value:g}"))
+    rpc = registry.get("http_requests_per_connection")
+    if rpc is not None and isinstance(rpc, Histogram):
+        for key, (_, total, count) in sorted(rpc.collect()):
+            mean = (total / count) if count else 0.0
+            rows.append(("requests / connection",
+                         _label_str(rpc.labelnames, key),
+                         f"n={count} mean={mean:.1f}"))
+    rows.append(("encoder cache hit ratio", "",
+                 _ratio(_sum_counter(registry.get(
+                            "http_encoder_cache_hits_total")),
+                        _sum_counter(registry.get(
+                            "http_encoder_cache_misses_total")))))
+    rows.append(("result cache hit ratio", "",
+                 _ratio(_sum_counter(registry.get(
+                            "http_result_cache_hits_total")),
+                        _sum_counter(registry.get(
+                            "http_result_cache_misses_total")))))
+    inval = _sum_counter(registry.get("http_result_cache_invalidations_total"))
+    rows.append(("result cache invalidations", "", f"{inval:g}"))
+    out = ["<table><tr><th>Metric</th><th>Labels</th><th>Value</th></tr>"]
+    for name, labels, value in rows:
+        out.append(f"<tr><td>{html.escape(name)}</td>"
+                   f"<td>{html.escape(labels)}</td>"
+                   f"<td>{html.escape(value)}</td></tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -249,6 +301,7 @@ class Dashboard(HttpService):
                     slo=_slo_table(),
                     supervisor=_supervisor_table(),
                     flight=_flight_table(),
+                    hotpath=_hotpath_table(),
                     telemetry=_telemetry_table(),
                 ))
 

@@ -1,0 +1,166 @@
+"""Cached JSON codecs for the HTTP hot path.
+
+`json.dumps(obj, separators=...)` constructs a fresh JSONEncoder on
+every call, and `json.loads(b"...")` runs byte-order-mark detection
+before it ever reaches the C scanner — both measurable taxes at the
+per-request rate the serving/ingest planes run at (ROADMAP item 3: the
+r05 ladder went flat on shared-core CPU, not on the model). This module
+binds one compact C encoder and one C decoder at import and exposes:
+
+- `dumps_bytes(obj)` / `loads(data)` — the cached generic codec pair.
+  Every hot-path handler must use these instead of bare `json.dumps` /
+  `json.loads` (enforced by `quality.py --hotpath-gate`).
+- envelope encoders — preserialized byte fragments for the fixed parts
+  of high-volume responses (`{"eventId": ...}` on event ingest,
+  `{"itemScores": [...]}` on predictions), so the fixed bytes are never
+  re-encoded. Fragment paths count as encoder-cache hits; anything that
+  falls back to the generic encoder counts as a miss, so the hit ratio
+  is observable (`http_encoder_cache_*` on /metrics).
+- `message_body(status, message)` — a bounded cache of fully rendered
+  `{"message": ...}` bodies for the small vocabulary of shed/error
+  replies (429/503/404/health), interned so repeated sheds cost a dict
+  lookup, not an encode.
+
+Compact separators change response *whitespace* relative to the old
+`json.dumps` default — JSON-insignificant, and both transports (event
+loop and threaded fallback) encode through here, so A/B parity stays
+bitwise.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+ENCODER_HITS = REGISTRY.counter(
+    "http_encoder_cache_hits_total",
+    "Hot-path responses encoded via a preserialized envelope fragment "
+    "or an interned static body")
+ENCODER_MISSES = REGISTRY.counter(
+    "http_encoder_cache_misses_total",
+    "Hot-path responses that fell back to the generic cached encoder")
+
+_HITS = ENCODER_HITS.labels()
+_MISSES = ENCODER_MISSES.labels()
+
+# One compact C encoder / one C decoder for the whole process, bound once.
+_ENCODER = json.JSONEncoder(separators=(",", ":"))
+_encode = _ENCODER.encode
+_DECODER = json.JSONDecoder()
+_decode = _DECODER.decode
+
+
+def dumps_bytes(obj) -> bytes:
+    """Compact-encode to UTF-8 bytes via the process-bound C encoder."""
+    return _encode(obj).encode("utf-8")
+
+
+def dumps(obj) -> str:
+    return _encode(obj)
+
+
+def loads(data):
+    """Decode JSON from bytes or str, skipping json.loads' per-call
+    BOM/encoding detection for the overwhelmingly common UTF-8 case.
+    Raises json.JSONDecodeError / UnicodeDecodeError (a ValueError) on
+    bad input — same contract the route handlers already map to 400."""
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode("utf-8")
+    return _decode(data)
+
+
+# -- envelope fragments ------------------------------------------------------
+
+# JSON string characters that need no escaping: everything printable-ASCII
+# except the two JSON-special characters. Event ids are uuid hex and item
+# ids are catalog keys, so this matches essentially always; anything else
+# falls back to the generic encoder (correctness over the fast path).
+_PLAIN_STR = re.compile(r'^[ !#-\[\]-~]*$')
+
+_EVENT_ID_PRE = b'{"eventId":"'
+_EVENT_ID_POST = b'"}'
+
+
+def event_id_response(event_id: str) -> bytes:
+    """`{"eventId": "..."}` — the 201 body of every single-event ingest."""
+    if _PLAIN_STR.match(event_id):
+        _HITS.inc()
+        return _EVENT_ID_PRE + event_id.encode("ascii") + _EVENT_ID_POST
+    _MISSES.inc()
+    return dumps_bytes({"eventId": event_id})
+
+
+_ITEM_PRE = '{"item":"'
+_ITEM_MID = '","score":'
+_SCORES_PRE = b'{"itemScores":['
+_SCORES_POST = b']}'
+_EMPTY_SCORES = b'{"itemScores":[]}'
+
+
+def _fragment_item_scores(scores: list) -> Optional[bytes]:
+    """Fast path for the dominant prediction shape
+    `{"itemScores": [{"item": str, "score": float}, ...]}`. Floats are
+    rendered with `repr`, which is exactly what the C encoder emits for
+    finite floats; any shape surprise returns None and the caller falls
+    back to the generic encoder."""
+    parts = []
+    for s in scores:
+        if type(s) is not dict or len(s) != 2:
+            return None
+        item = s.get("item")
+        score = s.get("score")
+        if type(item) is not str or not _PLAIN_STR.match(item):
+            return None
+        if type(score) is float:
+            if not math.isfinite(score):
+                return None
+            score_txt = repr(score)
+        elif type(score) is int and type(score) is not bool:
+            score_txt = str(score)
+        else:
+            return None
+        parts.append(_ITEM_PRE + item + _ITEM_MID + score_txt + "}")
+    return _SCORES_PRE + ",".join(parts).encode("ascii") + _SCORES_POST
+
+
+def prediction_response(result) -> bytes:
+    """Encode one prediction result, fragment-assembling the fixed
+    envelope when the result is the standard item-scores shape."""
+    if type(result) is dict and len(result) == 1:
+        scores = result.get("itemScores")
+        if type(scores) is list:
+            if not scores:
+                _HITS.inc()
+                return _EMPTY_SCORES
+            body = _fragment_item_scores(scores)
+            if body is not None:
+                _HITS.inc()
+                return body
+    _MISSES.inc()
+    return dumps_bytes(result)
+
+
+# -- interned small bodies ---------------------------------------------------
+
+# {"message": ...} replies (shed, not-found, health) repeat a small
+# vocabulary of strings; intern the rendered bytes. Bounded: admission
+# messages embed the in-flight count, so the key space is a few hundred
+# at most, but cap it anyway so a hostile message stream cannot grow it.
+_MESSAGE_CACHE: dict = {}
+_MESSAGE_CACHE_MAX = 512
+
+
+def message_body(message: str) -> bytes:
+    body = _MESSAGE_CACHE.get(message)
+    if body is not None:
+        _HITS.inc()
+        return body
+    body = dumps_bytes({"message": message})
+    if len(_MESSAGE_CACHE) < _MESSAGE_CACHE_MAX:
+        _MESSAGE_CACHE[message] = body
+    _MISSES.inc()
+    return body

@@ -1,0 +1,212 @@
+"""Hot-path gate — CI check that the request hot path stays on the fast
+primitives the event-loop transport was built around.
+
+Run via `python quality.py --hotpath-gate`. Two layers:
+
+1. Static scan (AST, no imports, no jax): resolve the hot-route handlers
+   — whatever is registered for `POST /queries.json`,
+   `POST /events.json`, and `POST /batch/events.json` on a Router — and
+   walk their same-module call closure. Any bare `json.dumps`/
+   `json.loads` there is a violation: the hot path must go through
+   `utils/fastjson.py` (module-bound encoder, pre-serialized envelope
+   fragments, interned static bodies). A stock `json.dumps(obj)` re-does
+   encoder construction and option resolution per call — exactly the
+   per-request tax this transport removed — and silently diverges from
+   the envelope bytes the A/B parity bench asserts on.
+
+2. Runtime read-your-writes drill (no HTTP, no jax): prime a per-user
+   result cache through a ServingPlane, prove the second identical query
+   is answered from cache (no second dispatch), then commit an event for
+   that user through a real GroupCommitWriter and prove the very next
+   query re-dispatches — the commit's invalidation must land before the
+   ack returns, else a client can read its own stale recommendation.
+   Also pins the fastjson interning contract the encoder cache depends
+   on.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from predictionio_tpu.utils import route_scan
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXEMPT = {
+    os.path.join("utils", "hotpath_gate.py"),
+}
+
+# the routes whose handlers (plus same-module call closure) must not
+# touch the stock json encoder/decoder
+_HOT_ROUTES = (
+    ("POST", "/queries.json"),
+    ("POST", "/events.json"),
+    ("POST", "/batch/events.json"),
+)
+
+_BARE_JSON = {"dumps", "loads"}
+
+
+def _bare_json_calls(fn: ast.AST) -> list:
+    """(lineno, name) for every `json.dumps(...)`/`json.loads(...)`
+    call inside fn. fastjson.dumps/loads spell the module differently and
+    don't match."""
+    hits = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BARE_JSON
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "json"):
+            hits.append((node.lineno, f"json.{node.func.attr}"))
+    return hits
+
+
+def _scan_file(path: str, rel: str) -> tuple:
+    """Returns (problems, hot_routes_found_here)."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            return [f"{rel}: unparseable ({e})"], 0
+    problems = []
+    found = 0
+    for method, route in _HOT_ROUTES:
+        handlers = route_scan.handlers_for(tree, route, method=method)
+        if not handlers:
+            continue
+        found += 1
+        for fn in route_scan.reachable_functions(tree, handlers):
+            for lineno, name in _bare_json_calls(fn):
+                fn_name = getattr(fn, "name", "<lambda>")
+                problems.append(
+                    f"{rel}:{lineno}: {fn_name} (reachable from "
+                    f"{method} {route}) calls bare {name}() on the hot "
+                    f"path — use utils.fastjson (bound encoder, cached "
+                    f"envelopes) so encode cost and envelope bytes stay "
+                    f"pinned")
+    return problems, found
+
+
+def _static_scan() -> list:
+    problems = []
+    found = 0
+    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, _PKG_DIR)
+            if rel in _EXEMPT:
+                continue
+            file_problems, file_found = _scan_file(path, rel)
+            problems.extend(file_problems)
+            found += file_found
+    if found < len(_HOT_ROUTES):
+        # the gate must notice if the hot routes stop being resolvable —
+        # an empty scan proves nothing
+        problems.append(
+            f"static: only {found}/{len(_HOT_ROUTES)} hot routes "
+            f"resolved to router-registered handlers; the hot-path gate "
+            f"has nothing to hold")
+    return problems
+
+
+def _runtime_check() -> list:
+    import itertools
+
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.ingest.writer import GroupCommitWriter, IngestConfig
+    from predictionio_tpu.serving import ServingConfig, ServingPlane
+    from predictionio_tpu.serving.result_cache import ResultCache
+    from predictionio_tpu.telemetry.registry import REGISTRY
+    from predictionio_tpu.utils import fastjson
+
+    problems = []
+
+    # fastjson interning: the encoder cache's whole premise is that the
+    # same static message renders to the SAME bytes object (zero encodes
+    # after warmup)
+    if fastjson.message_body("probe") is not fastjson.message_body("probe"):
+        problems.append(
+            "runtime: fastjson.message_body does not intern repeated "
+            "static bodies — the encoder cache is not caching")
+
+    dispatches = []
+
+    def dispatch(queries):
+        dispatches.append(list(queries))
+        return [{"rank": 1} for _ in queries]
+
+    plane = ServingPlane(
+        dispatch, config=ServingConfig(batching=False),
+        name="hotpathgate",
+        result_cache=ResultCache(max_entries=64, ttl_s=60.0))
+    ids = itertools.count(1)
+    writer = GroupCommitWriter(
+        insert_fn=lambda event, app_id, channel_id=None: str(next(ids)),
+        grouped_fn=lambda items: [str(next(ids)) for _ in items],
+        config=IngestConfig(), name="hotpathgate")
+    try:
+        query = {"user": "u1", "num": 3}
+        plane.handle_query(query)
+        plane.handle_query(query)
+        if len(dispatches) != 1:
+            problems.append(
+                f"runtime: repeated identical query dispatched "
+                f"{len(dispatches)} time(s) — the result cache never hit")
+        # the commit for u1 must invalidate u1's cached result BEFORE the
+        # ack: a client that writes then immediately re-queries must see
+        # a fresh dispatch, not its pre-write recommendation
+        writer.submit(
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i9"),
+            app_id=1)
+        plane.handle_query(query)
+        if len(dispatches) < 2:
+            problems.append(
+                "runtime: query after a committed write for the same "
+                "user was still answered from cache — ingest commit did "
+                "not invalidate (read-your-writes broken)")
+        # a user the commit did NOT touch keeps their cache entry
+        other = {"user": "u2", "num": 3}
+        plane.handle_query(other)
+        n = len(dispatches)
+        plane.handle_query(other)
+        if len(dispatches) != n:
+            problems.append(
+                "runtime: an unrelated user's cache entry was dropped by "
+                "the commit — invalidation is not per-entity")
+    finally:
+        writer.close()
+        plane.close()
+    text = REGISTRY.render()
+    for family in ("http_result_cache_hits_total",
+                   "http_result_cache_misses_total",
+                   "http_result_cache_invalidations_total",
+                   "http_encoder_cache_hits_total",
+                   "http_encoder_cache_misses_total"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"runtime: /metrics is missing {family}")
+    return problems
+
+
+def run_gate() -> int:
+    problems = _static_scan()
+    try:
+        problems += _runtime_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"runtime check crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"hotpath gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

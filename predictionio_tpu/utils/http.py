@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -18,6 +19,24 @@ from predictionio_tpu.telemetry import middleware as telemetry_middleware
 from predictionio_tpu.telemetry import tracing
 
 logger = logging.getLogger("predictionio_tpu.http")
+
+
+class BodyReadTimeout(ConnectionError):
+    """A client promised Content-Length bytes and stopped sending.
+
+    Subclasses ConnectionError so _Server.handle_error files it as a
+    client drop (debug log), not a handler bug (warning + counter) —
+    the 408 was already sent before this is raised."""
+
+
+def _read_timeout_s() -> float:
+    raw = os.environ.get("PIO_HTTP_READ_TIMEOUT_S")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return 20.0
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -56,9 +75,38 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
     def read_body(self) -> bytes:
         """Drain the request body (required before any early reply on
-        HTTP/1.1 keep-alive connections)."""
+        HTTP/1.1 keep-alive connections).
+
+        A read timeout bounds the wait: a client that sends
+        `Content-Length: N` and then fewer than N bytes used to park this
+        handler thread in `rfile.read` forever. Now it gets a 408 and the
+        connection is closed (the request is unfinishable mid-stream)."""
         length = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(length) if length else b""
+        if not length:
+            return b""
+        old_timeout = self.connection.gettimeout()
+        self.connection.settimeout(_read_timeout_s())
+        try:
+            data = self.rfile.read(length)
+        except (TimeoutError, OSError) as e:
+            self.close_connection = True
+            try:
+                self.send_json(408, {"message": "Request read timeout"})
+            except OSError:
+                pass
+            raise BodyReadTimeout(
+                f"read {length}-byte body: {e!r}") from e
+        finally:
+            try:
+                self.connection.settimeout(old_timeout)
+            except OSError:
+                pass
+        if len(data) < length:
+            # client half-closed before sending the promised bytes
+            self.close_connection = True
+            raise BodyReadTimeout(
+                f"client sent {len(data)} of {length} body bytes")
+        return data
 
 
 class _Server(ThreadingHTTPServer):
@@ -109,38 +157,86 @@ class _ReusePortServer(_Server):
 
 
 class HttpService:
-    """Owns a ThreadingHTTPServer + background thread lifecycle."""
+    """Owns one HTTP transport + background thread lifecycle.
+
+    Two transports behind one lifecycle contract:
+
+    - `handler_cls=` — the classic ThreadingHTTPServer path (dashboard,
+      admin, supervisor control, object store: low-rate services where
+      thread-per-connection is fine and handler classes are idiomatic).
+    - `router=` — a pre-parsed dispatch table served by the selector
+      event loop (utils/httploop.py) — the hot-path transport for the
+      prediction and event servers. `PIO_HTTP_LOOP=0` is the escape
+      hatch: the same router is adapted onto the threaded transport
+      (routing.handler_from_router), so a loop regression never strands
+      a deploy.
+    """
 
     def __init__(self, ip: str, port: int,
-                 handler_cls: Type[BaseHTTPRequestHandler],
+                 handler_cls: Optional[Type[BaseHTTPRequestHandler]] = None,
                  reuse_port: bool = False,
                  server_name: Optional[str] = None,
-                 instrument: bool = True):
+                 instrument: bool = True,
+                 router=None):
         # Telemetry is on for every service; `instrument=False` exists for
         # out-of-package A/B overhead measurement only (quality.py's
         # telemetry gate rejects it inside predictionio_tpu/).
         name = server_name or type(self).__name__.lower()
-        if instrument:
-            handler_cls = telemetry_middleware.instrument(handler_cls, name)
         self.server_name = name
-        cls = _ReusePortServer if reuse_port else _Server
-        self.httpd = cls((ip, port), handler_cls)
-        self.httpd.pio_server_name = name
+        self.router = router
+        self._loop = None
+        self.httpd = None
         self._bind_ip = ip
         self._reuse_port = reuse_port
         self._accepting = True
         self._thread: Optional[threading.Thread] = None
+        if router is not None:
+            if handler_cls is not None:
+                raise TypeError("pass handler_cls OR router, not both")
+            from predictionio_tpu.utils import httploop, routing
+
+            telemetry_middleware.register_builtin_routes(router)
+            if httploop.loop_enabled():
+                self._loop = httploop.EventLoopHttpServer(
+                    ip, port, router, name, reuse_port=reuse_port,
+                    instrument=instrument)
+                return
+            handler_cls = routing.handler_from_router(router)
+        if handler_cls is None:
+            raise TypeError("one of handler_cls or router is required")
+        if instrument:
+            handler_cls = telemetry_middleware.instrument(handler_cls, name)
+        cls = _ReusePortServer if reuse_port else _Server
+        self.httpd = cls((ip, port), handler_cls)
+        self.httpd.pio_server_name = name
 
     @property
     def port(self) -> int:
+        if self._loop is not None:
+            return self._loop.port
         return self.httpd.server_address[1]
 
+    def busy_requests(self) -> int:
+        """Requests the transport holds that have not been fully answered
+        (event loop only; the threaded transport's in-flight work is
+        already visible through the http_in_flight gauge). The
+        supervisor's drain quiescence polls this so requests parked
+        between parse and dispatch survive a rolling reload."""
+        if self._loop is not None:
+            return self._loop.busy_requests()
+        return 0
+
     def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        target = (self._loop.serve_forever if self._loop is not None
+                  else self.httpd.serve_forever)
+        self._thread = threading.Thread(target=target, daemon=True)
         self._thread.start()
 
     def serve_forever(self) -> None:
-        self.httpd.serve_forever()
+        if self._loop is not None:
+            self._loop.serve_forever()
+        else:
+            self.httpd.serve_forever()
 
     def pause_accept(self) -> None:
         """Stop accepting new connections while continuing to serve the
@@ -157,6 +253,10 @@ class HttpService:
 
         Only meaningful for services started with `start()` (the worker
         pool path). Idempotent."""
+        if self._loop is not None:
+            self._loop.pause_accept()
+            self._accepting = False
+            return
         if not self._accepting:
             return
         self._accepting = False
@@ -183,6 +283,10 @@ class HttpService:
         the accept loop. On SO_REUSEPORT pools the rebind always succeeds
         because the supervisor holds a never-listening reservation socket
         on the port; standalone services rebind the same port best-effort."""
+        if self._loop is not None:
+            self._loop.resume_accept()
+            self._accepting = True
+            return
         if self._accepting:
             return
         import socket
@@ -211,9 +315,16 @@ class HttpService:
 
     @property
     def accepting(self) -> bool:
+        if self._loop is not None:
+            return self._loop.accepting
         return self._accepting
 
     def shutdown(self) -> None:
+        if self._loop is not None:
+            self._loop.shutdown()
+            if self._thread:
+                self._thread.join(timeout=5)
+            return
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

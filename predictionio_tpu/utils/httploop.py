@@ -1,0 +1,725 @@
+"""Keep-alive-first selector event loop — the hot-path HTTP transport.
+
+ROADMAP item 3's receipt: the r05 serving ladder went flat from 8→32
+clients (1813.8 → 1780.7 qps) while p95 grew ~4×, because
+ThreadingHTTPServer pins one thread per connection (32 threads fighting
+the GIL to run socketserver + email-parser machinery per request). This
+transport replaces that with:
+
+- ONE loop thread owning a `selectors` selector: persistent connections
+  park in the selector between requests (no thread pinned to an idle
+  keep-alive connection), request bytes are parsed by a minimal HTTP/1.1
+  state machine (request line + headers + Content-Length body — no
+  email.parser, no per-request handler object), and routes resolve
+  through the server's pre-parsed `Router` dispatch table.
+- a SMALL worker pool for handler bodies that block on the device or
+  storage (`blocking=True` routes: /queries.json admission+batch wait,
+  /events.json group-commit wait). Workers render the response; the
+  loop thread owns every socket write, so responses stay ordered under
+  keep-alive pipelining.
+
+Per connection, requests are processed strictly in arrival order: a
+pipelined second request waits in the connection's pending queue until
+the first response is flushed. Parse/dispatch handoff/encode times are
+stamped onto each request's flight-recorder timeline (`http.parse`,
+`http.dispatch`, `http.encode`), so ladder regressions attribute to a
+transport stage, not just "the server".
+
+Lifecycle matches the threaded transport exactly — `serve_forever`,
+`pause_accept` (drain the accept backlog, close the listener, keep
+serving parked connections), `resume_accept`, `shutdown` — so the
+supervisor's rolling deploys and the SO_REUSEPORT pool work unchanged.
+Env knobs (see docs/operations.md): PIO_HTTP_LOOP, PIO_HTTP_WORKERS,
+PIO_HTTP_READ_TIMEOUT_S, PIO_HTTP_IDLE_TIMEOUT_S, PIO_HTTP_MAX_BODY.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from http.client import responses as _REASONS
+from typing import Optional
+
+from predictionio_tpu.telemetry import middleware as telemetry_middleware
+from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils import fastjson
+from predictionio_tpu.utils.routing import (
+    FALLBACK_404,
+    Headers,
+    Request,
+    Response,
+    Router,
+)
+
+logger = logging.getLogger("predictionio_tpu.http")
+
+PARKED = REGISTRY.gauge(
+    "http_parked_connections",
+    "Keep-alive connections parked in the event-loop selector "
+    "(established, no request in progress)",
+    labelnames=("server",))
+REQS_PER_CONN = REGISTRY.histogram(
+    "http_requests_per_connection",
+    "Requests served over one connection before it closed "
+    "(keep-alive amortization)",
+    labelnames=("server",),
+    buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000, 10000))
+
+# request head (request line + headers) larger than this is rejected —
+# same order as stdlib's 64KiB line limit
+_HEAD_LIMIT = 65536
+_RECV_SIZE = 65536
+
+_KNOWN_METHODS = frozenset(
+    {"GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH"})
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring unparseable %s=%r", name, raw)
+        return default
+
+
+def loop_enabled() -> bool:
+    """The transport escape hatch: PIO_HTTP_LOOP=0 falls every router
+    service back onto the threaded transport (same dispatch table)."""
+    return os.environ.get("PIO_HTTP_LOOP", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+# connection lifecycle states
+_PARKED = 0       # established, nothing buffered, waiting for bytes
+_READING = 1      # partial request head/body buffered
+_PROCESSING = 2   # one request dispatched (inline or worker), no writes yet
+_WRITING = 3      # response bytes pending in outbuf
+
+
+class _Conn:
+    __slots__ = ("sock", "fd", "buf", "outbuf", "pending", "state",
+                 "head", "body_needed", "t_first", "deadline",
+                 "idle_deadline", "n_requests", "close_after", "on_sent",
+                 "closed")
+
+    def __init__(self, sock: socket.socket, fd: int):
+        self.sock = sock
+        self.fd = fd
+        self.buf = b""
+        self.outbuf = b""
+        self.pending: deque = deque()   # parsed Requests awaiting dispatch
+        # born _READING: accept's _set_parked(conn, True) must see a
+        # not-parked state or the gauge increment is elided while the
+        # first unpark still decrements (net -1 per connection)
+        self.state = _READING
+        self.head = None                # (method, target, headers) mid-body
+        self.body_needed = 0
+        self.t_first = 0.0              # monotonic stamp of first byte of
+        self.deadline = 0.0             # current partial request
+        self.idle_deadline = 0.0
+        self.n_requests = 0
+        self.close_after = False        # close once outbuf drains
+        self.on_sent = None             # fires when current response flushed
+        self.closed = False
+
+
+class _ParseError(Exception):
+    def __init__(self, status: int, message: str, verb: str = "<other>"):
+        super().__init__(message)
+        self.status = status
+        self.verb = verb
+
+
+def _parse_head(block: bytes):
+    """Minimal HTTP/1.1 head parser: (method, target, headers_dict).
+    Raises _ParseError(400) on a malformed request line, (501) on an
+    unknown method token, (505) on a non-1.x version."""
+    try:
+        line_end = block.index(b"\r\n")
+    except ValueError:
+        line_end = len(block)
+    line = block[:line_end]
+    parts = line.split()
+    if len(parts) != 3:
+        raise _ParseError(400, f"Bad request syntax ({line[:64]!r})")
+    method_b, target_b, version_b = parts
+    if not version_b.startswith(b"HTTP/1."):
+        raise _ParseError(
+            505, f"Invalid HTTP version ({version_b[:16]!r})")
+    try:
+        method = method_b.decode("ascii")
+        target = target_b.decode("iso-8859-1")
+    except UnicodeDecodeError:
+        raise _ParseError(400, "Bad request line encoding") from None
+    headers: dict = {}
+    for raw in block[line_end + 2:].split(b"\r\n"):
+        if not raw:
+            continue
+        sep = raw.find(b":")
+        if sep <= 0:
+            raise _ParseError(400, f"Malformed header line ({raw[:64]!r})",
+                              verb=method if method in _KNOWN_METHODS
+                              else "<other>")
+        headers[raw[:sep].decode("iso-8859-1").lower()] = \
+            raw[sep + 1:].strip().decode("iso-8859-1")
+    http10 = version_b == b"HTTP/1.0"
+    return method, target, headers, http10
+
+
+class EventLoopHttpServer:
+    """One selector loop + worker pool serving a `Router` dispatch table."""
+
+    def __init__(self, ip: str, port: int, router: Router, server_name: str,
+                 reuse_port: bool = False, instrument: bool = True,
+                 workers: Optional[int] = None,
+                 read_timeout_s: Optional[float] = None,
+                 idle_timeout_s: Optional[float] = None):
+        self.router = router
+        self.server_name = server_name
+        self.instrument = instrument
+        self._reuse_port = reuse_port
+        self._bind_ip = ip
+        self.read_timeout_s = (read_timeout_s if read_timeout_s is not None
+                               else _env_float("PIO_HTTP_READ_TIMEOUT_S", 20.0))
+        self.idle_timeout_s = (idle_timeout_s if idle_timeout_s is not None
+                               else _env_float("PIO_HTTP_IDLE_TIMEOUT_S", 300.0))
+        self.max_body = int(_env_float("PIO_HTTP_MAX_BODY", 64 * 1024 * 1024))
+        self.n_workers = workers if workers is not None else int(
+            _env_float("PIO_HTTP_WORKERS", 32))
+
+        self._sel = selectors.DefaultSelector()
+        self._listener = self._bind(ip, port)
+        self.server_address = self._listener.getsockname()
+        self._accepting = True
+        self._conns: dict[int, _Conn] = {}
+        self._n_parked = 0
+        self._parked_gauge = PARKED.labels(server=server_name)
+        self._rpc_hist = REQS_PER_CONN.labels(server=server_name)
+        self._errors = telemetry_middleware.HTTP_ERRORS.labels(
+            server=server_name)
+
+        # cross-thread → loop handoff: callables drained by the loop,
+        # socketpair wake so the selector notices
+        self._loop_calls: deque = deque()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+
+        self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._workers: list[threading.Thread] = []
+        self._active = 0             # requests dispatched, response not flushed
+        self._next_timeout_sweep = 0.0
+        self._stopping = False
+        self._loop_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._lifecycle_lock = threading.Lock()
+
+    # -- sockets -----------------------------------------------------------
+    def _bind(self, ip: str, port: int) -> socket.socket:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self._reuse_port:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        sock.bind((ip, port))
+        sock.listen(128)
+        sock.setblocking(False)
+        self._sel.register(sock, selectors.EVENT_READ, "accept")
+        return sock
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    # -- loop-thread handoff ----------------------------------------------
+    def call_soon(self, fn) -> None:
+        calls = self._loop_calls
+        # elide the wake syscall when an undrained callback already holds
+        # a wake byte in the pipe: the loop's drain re-checks the deque
+        # after every callback, so an append racing the drain is either
+        # seen by the same sweep or lands on an empty deque and wakes
+        need_wake = not calls
+        calls.append(fn)
+        if need_wake:
+            try:
+                self._wake_w.send(b"x")
+            except (BlockingIOError, OSError):
+                pass  # wake byte already pending / loop gone
+
+    def _on_loop_thread(self) -> bool:
+        return threading.current_thread() is self._loop_thread
+
+    def _control(self, fn, timeout: float = 10.0):
+        """Run `fn` on the loop thread and return its result (re-raising
+        its exception) — pause/resume/shutdown arrive from supervisor
+        signal threads. Runs inline when the loop is not alive (not yet
+        started, or already stopped)."""
+        if self._on_loop_thread() or self._loop_thread is None \
+                or not self._loop_thread.is_alive():
+            return fn()
+        done = threading.Event()
+        box: list = [None, None]
+
+        def wrapped():
+            try:
+                box[0] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised at caller
+                box[1] = e
+            finally:
+                done.set()
+
+        self.call_soon(wrapped)
+        if not done.wait(timeout):
+            raise TimeoutError(f"event loop did not run control call "
+                               f"within {timeout:g}s")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self) -> None:
+        with self._lifecycle_lock:
+            if self._stopping:
+                return
+            self._loop_thread = threading.current_thread()
+            if not self._workers:
+                for i in range(self.n_workers):
+                    t = threading.Thread(
+                        target=self._worker, daemon=True,
+                        name=f"{self.server_name}-httploop-worker-{i}")
+                    t.start()
+                    self._workers.append(t)
+        try:
+            while not self._stopping:
+                self._tick()
+        finally:
+            self._close_all()
+            self._stopped.set()
+
+    def shutdown(self) -> None:
+        """Stop the loop and close everything. Responses already queued
+        are flushed best-effort before the close (the /stop reply must
+        reach its client). Idempotent; callable whether or not
+        serve_forever ever ran."""
+        with self._lifecycle_lock:
+            if self._stopping:
+                self._stopped.wait(5)
+                return
+            self._stopping = True
+        for _ in self._workers:
+            self._jobs.put(None)
+        loop = self._loop_thread
+        if loop is not None and loop.is_alive() and not self._on_loop_thread():
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
+            self._stopped.wait(10)
+        else:
+            self._close_all()
+            self._stopped.set()
+        for t in self._workers:
+            if t is not threading.current_thread():
+                t.join(timeout=2)
+
+    def pause_accept(self) -> None:
+        """Close the listener (SO_REUSEPORT pools rebalance away from this
+        process) after accepting the already-completed backlog; parked
+        keep-alive connections keep being served."""
+        def _do():
+            if not self._accepting:
+                return
+            self._accepting = False
+            self._do_accept(self._listener)       # drain completed handshakes
+            try:
+                self._sel.unregister(self._listener)
+            except (KeyError, ValueError):
+                pass
+            self._listener.close()
+        self._control(_do)
+
+    def resume_accept(self) -> None:
+        def _do():
+            if self._accepting:
+                return
+            self._listener = self._bind(self._bind_ip, self.server_address[1])
+            self.server_address = self._listener.getsockname()
+            self._accepting = True
+        self._control(_do)
+
+    @property
+    def accepting(self) -> bool:
+        return self._accepting
+
+    def busy_requests(self) -> int:
+        """Requests the transport has accepted responsibility for but not
+        fully answered (dispatched + pipelined-pending). The supervisor's
+        drain quiescence adds this to the handler in-flight gauge so a
+        request parked between parse and dispatch cannot be dropped by a
+        reload; idle parked connections deliberately do NOT count."""
+        n = self._active
+        for conn in list(self._conns.values()):
+            n += len(conn.pending)
+        return n
+
+    @property
+    def parked_connections(self) -> int:
+        return self._n_parked
+
+    # -- loop body ---------------------------------------------------------
+    def _tick(self) -> None:
+        timeout = 0.25
+        for key, _ in self._sel.select(timeout):
+            what = key.data
+            if what == "accept":
+                self._do_accept(key.fileobj)
+            elif what == "wake":
+                try:
+                    self._wake_r.recv(4096)
+                except (BlockingIOError, OSError):
+                    pass
+            elif isinstance(what, _Conn):
+                if key.events & selectors.EVENT_WRITE:
+                    self._do_write(what)
+                if not what.closed and key.events & selectors.EVENT_READ:
+                    self._do_read(what)
+        while self._loop_calls:
+            try:
+                self._loop_calls.popleft()()
+            except Exception:
+                logger.exception("event-loop callback failed")
+        self._check_timeouts()
+
+    def _do_accept(self, listener) -> None:
+        while True:
+            try:
+                sock, _addr = listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock, sock.fileno())
+            self._conns[conn.fd] = conn
+            conn.idle_deadline = time.monotonic() + self.idle_timeout_s
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            self._set_parked(conn, True)
+
+    def _set_parked(self, conn: _Conn, parked: bool) -> None:
+        was = conn.state == _PARKED
+        if parked and not was:
+            conn.state = _PARKED
+            self._n_parked += 1
+            self._parked_gauge.set(self._n_parked)
+        elif not parked and was:
+            self._n_parked -= 1
+            self._parked_gauge.set(self._n_parked)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        self._set_parked(conn, False)
+        conn.closed = True
+        self._conns.pop(conn.fd, None)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.n_requests:
+            self._rpc_hist.observe(conn.n_requests)
+
+    def _do_read(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(_RECV_SIZE)
+        except (BlockingIOError, InterruptedError):
+            return
+        except (ConnectionError, OSError) as e:
+            logger.debug("client dropped: %r", e)
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        now = time.monotonic()
+        if not conn.buf and conn.head is None:
+            conn.t_first = now
+            conn.deadline = now + self.read_timeout_s
+        conn.buf += data
+        if conn.state == _PARKED:
+            self._set_parked(conn, False)
+            conn.state = _READING
+        try:
+            self._parse_available(conn, now)
+        except _ParseError as e:
+            self._reply_parse_error(conn, e)
+            return
+        self._pump(conn)
+
+    def _parse_available(self, conn: _Conn, now: float) -> None:
+        """Consume every complete request currently in the buffer."""
+        while True:
+            if conn.head is None:
+                idx = conn.buf.find(b"\r\n\r\n")
+                if idx < 0:
+                    if len(conn.buf) > _HEAD_LIMIT:
+                        raise _ParseError(431, "Request head too large")
+                    return
+                block, conn.buf = conn.buf[:idx], conn.buf[idx + 4:]
+                method, target, headers, http10 = _parse_head(block)
+                if method not in _KNOWN_METHODS:
+                    raise _ParseError(
+                        501, f"Unsupported method ({method!r})")
+                if "transfer-encoding" in headers:
+                    raise _ParseError(
+                        501, "Transfer-Encoding not supported",
+                        verb=method)
+                try:
+                    clen = int(headers.get("content-length") or 0)
+                except ValueError:
+                    raise _ParseError(400, "Bad Content-Length",
+                                      verb=method) from None
+                if clen < 0 or clen > self.max_body:
+                    raise _ParseError(413, "Body too large", verb=method)
+                conn.head = (method, target, headers, http10)
+                conn.body_needed = clen
+            method, target, headers, http10 = conn.head
+            if len(conn.buf) < conn.body_needed:
+                return
+            body = bytes(conn.buf[:conn.body_needed])
+            conn.buf = conn.buf[conn.body_needed:]
+            conn.head = None
+            conn.body_needed = 0
+            req = Request(method, target, Headers(headers), body)
+            req._t_recv = conn.t_first
+            req._t_parsed = time.monotonic()
+            # per-request keep-alive decision (stdlib semantics)
+            conn_hdr = headers.get("connection", "").lower()
+            if http10:
+                close = conn_hdr != "keep-alive"
+            else:
+                close = conn_hdr == "close"
+            conn.pending.append((req, close))
+            conn.t_first = 0.0
+            conn.deadline = 0.0
+            if conn.buf:
+                # stamp the pipelined follow-up's own read clock
+                conn.t_first = time.monotonic()
+                conn.deadline = conn.t_first + self.read_timeout_s
+                continue
+            return
+
+    # -- dispatch ----------------------------------------------------------
+    def _pump(self, conn: _Conn) -> None:
+        """Start the next pending request if the connection is free."""
+        if conn.closed or conn.state in (_PROCESSING, _WRITING):
+            return
+        if not conn.pending:
+            if conn.head is None and not conn.buf:
+                conn.idle_deadline = time.monotonic() + self.idle_timeout_s
+                self._set_parked(conn, True)
+            return
+        req, close = conn.pending.popleft()
+        self._set_parked(conn, False)
+        conn.state = _PROCESSING
+        conn.close_after = close
+        conn.n_requests += 1
+        self._active += 1
+        route = self.router.lookup(req.method, req.path)
+        if route is None:
+            if self.router.handles_method(req.method):
+                route = FALLBACK_404
+            else:
+                # stdlib parity: a known verb with no handler at all → 501
+                self._active -= 1
+                conn.state = _READING
+                self._reply_parse_error(
+                    conn, _ParseError(
+                        501, f"Unsupported method ({req.method!r})",
+                        verb=req.method),
+                    keep_alive=not close)
+                return
+        req._t_queued = time.monotonic()
+        if route.blocking:
+            self._jobs.put((conn, req, route))
+        else:
+            resp, trace_id = telemetry_middleware.run_route(
+                self.server_name, req, route, instrument=self.instrument)
+            self._complete(conn, resp, trace_id)
+
+    def _worker(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                return
+            conn, req, route = item
+            try:
+                resp, trace_id = telemetry_middleware.run_route(
+                    self.server_name, req, route, instrument=self.instrument)
+            except BaseException:  # noqa: BLE001 — worker must survive
+                logger.exception("run_route failed")
+                resp, trace_id = Response.message(
+                    500, "Internal Server Error"), ""
+            self.call_soon(lambda c=conn, r=resp, t=trace_id:
+                           self._complete(c, r, t))
+
+    # -- responses ---------------------------------------------------------
+    def _reply_parse_error(self, conn: _Conn, e: _ParseError,
+                           keep_alive: bool = False) -> None:
+        """Parse-layer reply: mint a trace id, count the request under
+        capped labels (middleware send_error parity), answer, and close
+        unless the request was cleanly framed."""
+        trace_id = telemetry_middleware.record_parse_layer(
+            self.server_name, e.verb, e.status) if self.instrument else ""
+        resp = Response.message(e.status, str(e))
+        self._set_parked(conn, False)
+        conn.state = _PROCESSING
+        conn.close_after = not keep_alive
+        conn.buf = b"" if not keep_alive else conn.buf
+        conn.head = None
+        conn.body_needed = 0
+        self._active += 1
+        self._complete(conn, resp, trace_id)
+
+    def _complete(self, conn: _Conn, resp: Response, trace_id: str) -> None:
+        """Loop-thread: assemble head+body, queue on the connection, and
+        flush. Runs for inline routes, worker completions, and parse
+        errors alike."""
+        self._active -= 1
+        if conn.closed:
+            if resp.on_sent is not None:
+                resp.on_sent()
+            return
+        body = resp.body if resp.body is not None else resp.render_body()
+        close = conn.close_after or resp.close
+        head = [
+            f"HTTP/1.1 {resp.status} "
+            f"{_REASONS.get(resp.status, 'Unknown')}\r\n"
+            f"Content-Type: {resp.content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n",
+        ]
+        if trace_id:
+            head.append(f"X-PIO-Trace-Id: {trace_id}\r\n")
+        if resp.headers:
+            for k, v in resp.headers.items():
+                head.append(f"{k}: {v}\r\n")
+        if close:
+            head.append("Connection: close\r\n")
+        head.append("\r\n")
+        conn.close_after = close
+        conn.on_sent = resp.on_sent
+        conn.outbuf += "".join(head).encode("latin-1") + body
+        conn.state = _WRITING
+        self._do_write(conn)
+
+    def _do_write(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        try:
+            while conn.outbuf:
+                sent = conn.sock.send(conn.outbuf)
+                if sent == 0:
+                    raise ConnectionError("zero-length send")
+                conn.outbuf = conn.outbuf[sent:]
+        except (BlockingIOError, InterruptedError):
+            self._watch(conn, write=True)
+            return
+        except (ConnectionError, OSError) as e:
+            logger.debug("client dropped mid-response: %r", e)
+            if conn.on_sent is not None:
+                on_sent, conn.on_sent = conn.on_sent, None
+                self._run_on_sent(on_sent)
+            self._close_conn(conn)
+            return
+        # response fully flushed
+        if conn.on_sent is not None:
+            on_sent, conn.on_sent = conn.on_sent, None
+            self._run_on_sent(on_sent)
+        if conn.close_after:
+            self._close_conn(conn)
+            return
+        conn.state = _READING
+        self._watch(conn, write=False)
+        self._pump(conn)
+
+    def _run_on_sent(self, fn) -> None:
+        try:
+            fn()
+        except Exception:
+            logger.exception("on_sent callback failed")
+
+    def _watch(self, conn: _Conn, write: bool) -> None:
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if write else 0)
+        try:
+            self._sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError):
+            pass
+
+    # -- timeouts ----------------------------------------------------------
+    def _check_timeouts(self) -> None:
+        now = time.monotonic()
+        # 20 Hz sweep: walking every connection each tick is measurable
+        # loop-thread CPU at thousands of ticks/s, and 50 ms of deadline
+        # slack is noise against multi-second timeouts
+        if now < self._next_timeout_sweep:
+            return
+        self._next_timeout_sweep = now + 0.05
+        for conn in list(self._conns.values()):
+            if conn.closed:
+                continue
+            if conn.state == _READING and conn.deadline and \
+                    now > conn.deadline and (conn.buf or conn.head):
+                # slowloris / short-body: the client promised more bytes
+                # than it sent within the read timeout
+                try:
+                    self._reply_parse_error(
+                        conn, _ParseError(408, "Request read timeout"))
+                except Exception:
+                    self._close_conn(conn)
+            elif conn.state == _PARKED and now > conn.idle_deadline:
+                self._close_conn(conn)
+
+    def _close_all(self) -> None:
+        if self._accepting:
+            try:
+                self._sel.unregister(self._listener)
+            except (KeyError, ValueError):
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._accepting = False
+        # best-effort flush of already-queued responses (e.g. /stop's 200)
+        for conn in list(self._conns.values()):
+            if conn.outbuf:
+                try:
+                    conn.sock.settimeout(0.5)
+                    conn.sock.sendall(conn.outbuf)
+                except OSError:
+                    pass
+            self._close_conn(conn)
+        try:
+            self._sel.unregister(self._wake_r)
+        except (KeyError, ValueError):
+            pass
+        self._wake_r.close()
+        self._wake_w.close()
+        try:
+            self._sel.close()
+        except OSError:
+            pass

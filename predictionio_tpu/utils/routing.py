@@ -1,0 +1,255 @@
+"""Route dispatch tables for the HTTP services.
+
+The pre-event-loop servers resolved routes with `self.path ==` chains
+inside `do_GET`/`do_POST` — re-parsed per request, untypeable by the CI
+gates, and welded to BaseHTTPRequestHandler. A `Router` is the
+replacement: handlers are plain functions `fn(Request) -> Response`
+registered once at server construction with their route template and a
+`blocking` flag (True = the body may block on the device/storage, so the
+event loop runs it on its worker pool instead of the loop thread).
+
+One dispatch table serves BOTH transports:
+
+- the selector event loop (utils/httploop.py) — the default;
+- a thin `JsonRequestHandler` adapter (`handler_from_router`) — the
+  `PIO_HTTP_LOOP=0` escape hatch, instrumented by the classic class
+  middleware, so a transport regression never strands a deploy.
+
+Handlers deal only in `Request`/`Response`; everything socket-shaped
+stays in the transports.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from predictionio_tpu.utils import fastjson
+
+
+class Headers:
+    """Case-insensitive read-only header view (keys stored lowercase).
+
+    Quacks like the email.message.Message the old handlers read from:
+    `.get(name, default)` with case-insensitive names."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: Optional[dict] = None):
+        self._d = d if d is not None else {}
+
+    def get(self, name: str, default=None):
+        return self._d.get(name.lower(), default)
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def items(self):
+        return self._d.items()
+
+    def __repr__(self):
+        return f"Headers({self._d!r})"
+
+
+EMPTY_HEADERS = Headers({})
+
+
+class Request:
+    """One parsed HTTP request, transport-independent.
+
+    `_t_recv/_t_parsed/_t_queued` are monotonic stamps the event loop
+    writes so the middleware can record http.parse / http.dispatch spans
+    on the handler's timeline without the parser knowing about spans."""
+
+    __slots__ = ("method", "target", "path", "headers", "body",
+                 "_params", "_t_recv", "_t_parsed", "_t_queued")
+
+    def __init__(self, method: str, target: str, headers: Headers,
+                 body: bytes, path: Optional[str] = None):
+        self.method = method
+        self.target = target          # raw request target incl. query
+        self.path = path if path is not None else urlparse(target).path
+        self.headers = headers
+        self.body = body
+        self._params: Optional[dict] = None
+        self._t_recv = 0.0
+        self._t_parsed = 0.0
+        self._t_queued = 0.0
+
+    @property
+    def params(self) -> dict:
+        """First-value query parameters (the old `_query()` helper)."""
+        if self._params is None:
+            qs = parse_qs(urlparse(self.target).query)
+            self._params = {k: v[0] for k, v in qs.items()}
+        return self._params
+
+
+class Response:
+    """One response: status + headers + a body that is either prebuilt
+    bytes or a payload rendered lazily by `render_body()` — lazily so the
+    transport can time encoding as its own flight-recorder span and so
+    interned static bodies skip encoding entirely."""
+
+    __slots__ = ("status", "body", "payload", "encoder", "headers",
+                 "content_type", "close", "on_sent")
+
+    def __init__(self, status: int, *, body: Optional[bytes] = None,
+                 payload=None, encoder: Optional[Callable] = None,
+                 headers: Optional[dict] = None,
+                 content_type: str = "application/json; charset=utf-8",
+                 close: bool = False):
+        self.status = status
+        self.body = body
+        self.payload = payload
+        self.encoder = encoder
+        self.headers = headers
+        self.content_type = content_type
+        self.close = close          # force Connection: close after sending
+        self.on_sent: Optional[Callable] = None   # runs after the bytes hit the socket
+
+    @classmethod
+    def json(cls, status: int, payload, headers: Optional[dict] = None,
+             encoder: Optional[Callable] = None) -> "Response":
+        return cls(status, payload=payload, headers=headers, encoder=encoder)
+
+    @classmethod
+    def message(cls, status: int, message: str,
+                headers: Optional[dict] = None) -> "Response":
+        """`{"message": ...}` through the interned-body cache."""
+        return cls(status, body=fastjson.message_body(message),
+                   headers=headers)
+
+    @classmethod
+    def html(cls, status: int, html_body: str) -> "Response":
+        return cls(status, body=html_body.encode(),
+                   content_type="text/html; charset=utf-8")
+
+    def render_body(self) -> bytes:
+        if self.body is None:
+            if self.encoder is not None:
+                self.body = self.encoder(self.payload)
+            else:
+                self.body = fastjson.dumps_bytes(self.payload)
+        return self.body
+
+
+class Route:
+    __slots__ = ("fn", "template", "blocking")
+
+    def __init__(self, fn: Callable[[Request], Response], template: str,
+                 blocking: bool):
+        self.fn = fn
+        self.template = template
+        self.blocking = blocking
+
+
+class Router:
+    """Pre-parsed dispatch table: exact paths resolve with one dict
+    lookup, prefix routes (`/events/<id>.json`) with a short scan.
+    Registered once at server construction — never rebuilt per request."""
+
+    def __init__(self):
+        self._exact: Dict[Tuple[str, str], Route] = {}
+        self._prefix: Dict[str, List[Tuple[str, str, Route]]] = {}
+        self._methods: set = set()
+
+    # -- registration ------------------------------------------------------
+    def add(self, method: str, path: str, fn, *, blocking: bool = False,
+            template: Optional[str] = None) -> None:
+        method = method.upper()
+        self._methods.add(method)
+        self._exact[(method, path)] = Route(fn, template or path, blocking)
+
+    def add_prefix(self, method: str, prefix: str, suffix: str, fn, *,
+                   template: str, blocking: bool = False) -> None:
+        method = method.upper()
+        self._methods.add(method)
+        self._prefix.setdefault(method, []).append(
+            (prefix, suffix, Route(fn, template, blocking)))
+
+    def get(self, path: str, fn, **kw) -> None:
+        self.add("GET", path, fn, **kw)
+
+    def post(self, path: str, fn, **kw) -> None:
+        self.add("POST", path, fn, **kw)
+
+    def delete(self, path: str, fn, **kw) -> None:
+        self.add("DELETE", path, fn, **kw)
+
+    # -- dispatch ----------------------------------------------------------
+    def handles_method(self, method: str) -> bool:
+        return method in self._methods
+
+    def lookup(self, method: str, path: str) -> Optional[Route]:
+        route = self._exact.get((method, path))
+        if route is not None:
+            return route
+        for prefix, suffix, r in self._prefix.get(method, ()):
+            if path.startswith(prefix) and path.endswith(suffix):
+                return r
+        return None
+
+
+def path_param(path: str, prefix: str, suffix: str) -> str:
+    """Decode the variable segment of a prefix route
+    (`/events/<id>.json` → id)."""
+    return unquote(path[len(prefix):len(path) - len(suffix)])
+
+
+NOT_FOUND = Response(404, body=fastjson.message_body("Not Found"))
+
+
+def _fallback_404(req: Request) -> Response:
+    return NOT_FOUND
+
+
+FALLBACK_404 = Route(_fallback_404, "<other>", False)
+
+
+def handler_from_router(router: Router, include_body_methods=("POST", "PUT",
+                                                              "DELETE")):
+    """Build a JsonRequestHandler subclass that dispatches through
+    `router` — the threaded escape-hatch transport (PIO_HTTP_LOOP=0).
+    The classic class middleware instruments the generated do_* methods,
+    so telemetry/trace/flight-recorder behavior matches the old
+    hand-written handlers."""
+    from urllib.parse import urlparse as _urlparse
+
+    from predictionio_tpu.utils.http import JsonRequestHandler
+
+    def _dispatch(self, method: str) -> None:
+        body = self.read_body() if method in include_body_methods else b""
+        target = self.path
+        path = _urlparse(target).path
+        route = router.lookup(method, path) or FALLBACK_404
+        req = Request(method, target, Headers(
+            {k.lower(): v for k, v in self.headers.items()}), body,
+            path=path)
+        resp = route.fn(req)
+        payload_bytes = resp.render_body()
+        self.send_response(resp.status)
+        self.send_header("Content-Type", resp.content_type)
+        self.send_header("Content-Length", str(len(payload_bytes)))
+        if resp.headers:
+            for k, v in resp.headers.items():
+                self.send_header(k, str(v))
+        if resp.close:
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        self.end_headers()
+        self.wfile.write(payload_bytes)
+        if resp.on_sent is not None:
+            self.wfile.flush()
+            resp.on_sent()
+
+    ns = {}
+    for method in sorted(router._methods):
+        def do(self, _m=method):
+            _dispatch(self, _m)
+        do.__name__ = f"do_{method}"
+        ns[f"do_{method}"] = do
+    return type("RouterHandler", (JsonRequestHandler,), ns)

@@ -33,8 +33,10 @@ from predictionio_tpu.serving import (
 )
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.utils import fastjson
 from predictionio_tpu.utils.faults import FaultInjected
-from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+from predictionio_tpu.utils.http import HttpService
+from predictionio_tpu.utils.routing import Request, Response, Router
 
 from predictionio_tpu.storage.base import EngineInstance
 from predictionio_tpu.storage.registry import Storage
@@ -182,106 +184,107 @@ class PredictionServer(HttpService):
             _dispatch, degraded_fn=_degraded,
             config=serving_config or ServingConfig.from_env(),
             name="predictionserver")
+        self._worker_pid = worker_pid
 
-        class Handler(JsonRequestHandler):
-            server_version = "pio-tpu-server/0.1"
+        # Route dispatch table, registered once at construction. The
+        # query/reload/stop handlers block (device dispatch, storage
+        # load), so the event loop runs them on its worker pool.
+        router = Router()
+        router.get("/", self._handle_status)
+        router.post("/queries.json", self._handle_query, blocking=True)
+        router.post("/reload", self._handle_reload, blocking=True)
+        router.post("/stop", self._handle_stop, blocking=True)
 
-            _send = JsonRequestHandler.send_json
-
-            def do_GET(self):
-                state = server._state
-                if self.path == "/":
-                    return self._send(200, {
-                        "status": "alive",
-                        "engineId": server.config.engine_id,
-                        "engineVersion": server.config.engine_version,
-                        "engineVariant": server.config.engine_variant,
-                        "engineFactory": state.instance.engine_factory,
-                        "engineInstanceId": state.instance.id,
-                        "startTime": state.instance.start_time.isoformat(),
-                        # which pool worker answered — the observable
-                        # receipt that SO_REUSEPORT is really balancing
-                        "workerPid": worker_pid,
-                    })
-                return self._send(404, {"message": "Not Found"})
-
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                if self.path == "/queries.json":
-                    retry_after = server.serving.config.admission.retry_after_s
-                    try:
-                        query = json.loads(body or b"{}")
-                        result, degraded = server.serving.handle_query(
-                            query, self.headers)
-                        result = server.plugins.on_prediction(
-                            query, result, server._state.instance.id)
-                    except ShedLoad as e:
-                        # saturated and no degraded answer: an explicit,
-                        # immediate 429 beats queueing into collapse
-                        QUERIES_FAILED.inc()
-                        return self._send(
-                            429, {"message": str(e)},
-                            headers={"Retry-After": f"{e.retry_after_s:g}"})
-                    except DeadlineExceeded as e:
-                        QUERIES_FAILED.inc()
-                        return self._send(
-                            503, {"message": str(e)},
-                            headers={"Retry-After": f"{retry_after:g}"})
-                    except PluginRejection as e:
-                        QUERIES_FAILED.inc()
-                        return self._send(403, {"message": str(e)})
-                    except FaultInjected as e:
-                        # chaos-drill errors are server faults, not client
-                        # ones: a 500 spends SLO budget (a 400 would not),
-                        # which is what the supervisor's error-rate rule
-                        # and the chaos gate watch for
-                        QUERIES_FAILED.inc()
-                        return self._send(500, {"message": str(e)})
-                    except Exception as e:
-                        QUERIES_FAILED.inc()
-                        log.warning("Query failed: %s", e)
-                        return self._send(400, {"message": str(e)})
-                    return self._send(
-                        200, result,
-                        headers={"X-PIO-Degraded": "1"} if degraded else None)
-                if self.path == "/reload":
-                    if server.supervisor_pid is not None:
-                        # pool mode: the kernel routed this request to ONE
-                        # worker; SIGHUP asks the supervisor for a ROLLING
-                        # reload — each worker (this one included) drains
-                        # and swaps in turn, so the pool never answers
-                        # from zero workers mid-deploy
-                        import signal
-
-                        os.kill(server.supervisor_pid, signal.SIGHUP)
-                        return self._send(200, {
-                            "message": "Rolling reload signaled to "
-                                       "all workers"})
-                    try:
-                        server.reload()
-                    except Exception as e:
-                        return self._send(500, {"message": str(e)})
-                    return self._send(200, {
-                        "message": "Reloaded",
-                        "engineInstanceId": server._state.instance.id,
-                    })
-                if self.path == "/stop":
-                    if server.supervisor_pid is not None:
-                        import signal
-
-                        self._send(200, {
-                            "message": "Shutting down all workers."})
-                        os.kill(server.supervisor_pid, signal.SIGTERM)
-                        return None
-                    self._send(200, {"message": "Shutting down."})
-                    threading.Thread(target=server.shutdown, daemon=True).start()
-                    return None
-                return self._send(404, {"message": "Not Found"})
-
-        HttpService.__init__(self, config.ip, config.port, Handler,
+        HttpService.__init__(self, config.ip, config.port,
+                             router=router,
                              reuse_port=reuse_port,
                              server_name="predictionserver")
+
+    # -- route handlers ------------------------------------------------------
+    def _handle_status(self, req: Request) -> Response:
+        state = self._state
+        return Response.json(200, {
+            "status": "alive",
+            "engineId": self.config.engine_id,
+            "engineVersion": self.config.engine_version,
+            "engineVariant": self.config.engine_variant,
+            "engineFactory": state.instance.engine_factory,
+            "engineInstanceId": state.instance.id,
+            "startTime": state.instance.start_time.isoformat(),
+            # which pool worker answered — the observable receipt that
+            # SO_REUSEPORT is really balancing
+            "workerPid": self._worker_pid,
+        })
+
+    def _handle_query(self, req: Request) -> Response:
+        retry_after = self.serving.config.admission.retry_after_s
+        try:
+            query = fastjson.loads(req.body or b"{}")
+            result, degraded = self.serving.handle_query(
+                query, req.headers)
+            result = self.plugins.on_prediction(
+                query, result, self._state.instance.id)
+        except ShedLoad as e:
+            # saturated and no degraded answer: an explicit, immediate
+            # 429 beats queueing into collapse
+            QUERIES_FAILED.inc()
+            return Response.message(
+                429, str(e),
+                headers={"Retry-After": f"{e.retry_after_s:g}"})
+        except DeadlineExceeded as e:
+            QUERIES_FAILED.inc()
+            return Response.message(
+                503, str(e),
+                headers={"Retry-After": f"{retry_after:g}"})
+        except PluginRejection as e:
+            QUERIES_FAILED.inc()
+            return Response.message(403, str(e))
+        except FaultInjected as e:
+            # chaos-drill errors are server faults, not client ones: a
+            # 500 spends SLO budget (a 400 would not), which is what the
+            # supervisor's error-rate rule and the chaos gate watch for
+            QUERIES_FAILED.inc()
+            return Response.message(500, str(e))
+        except Exception as e:
+            QUERIES_FAILED.inc()
+            log.warning("Query failed: %s", e)
+            return Response.message(400, str(e))
+        return Response(
+            200, payload=result, encoder=fastjson.prediction_response,
+            headers={"X-PIO-Degraded": "1"} if degraded else None)
+
+    def _handle_reload(self, req: Request) -> Response:
+        if self.supervisor_pid is not None:
+            # pool mode: the kernel routed this request to ONE worker;
+            # SIGHUP asks the supervisor for a ROLLING reload — each
+            # worker (this one included) drains and swaps in turn, so
+            # the pool never answers from zero workers mid-deploy
+            import signal
+
+            os.kill(self.supervisor_pid, signal.SIGHUP)
+            return Response.message(
+                200, "Rolling reload signaled to all workers")
+        try:
+            self.reload()
+        except Exception as e:
+            return Response.message(500, str(e))
+        return Response.json(200, {
+            "message": "Reloaded",
+            "engineInstanceId": self._state.instance.id,
+        })
+
+    def _handle_stop(self, req: Request) -> Response:
+        if self.supervisor_pid is not None:
+            import signal
+
+            resp = Response.message(200, "Shutting down all workers.")
+            resp.on_sent = lambda: os.kill(self.supervisor_pid,
+                                           signal.SIGTERM)
+            return resp
+        resp = Response.message(200, "Shutting down.")
+        resp.on_sent = lambda: threading.Thread(
+            target=self.shutdown, daemon=True).start()
+        return resp
 
     def reload(self) -> None:
         """Swap to the newest COMPLETED instance (idempotent, atomic).

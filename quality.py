@@ -42,6 +42,14 @@ def main():
                         "erroring-worker recovery plus crash-loop circuit "
                         "breaking; fails unless capacity self-heals with "
                         "bounded restarts")
+    p.add_argument("--hotpath-gate", action="store_true",
+                   help="run the HTTP hot-path CI gate (no jax, no data): "
+                        "fails if a hot-route handler (or anything it "
+                        "calls in-module) uses bare json.dumps/json.loads "
+                        "instead of utils.fastjson, or if a committed "
+                        "ingest write fails to invalidate the per-user "
+                        "serving result cache before the ack "
+                        "(read-your-writes drill)")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -75,6 +83,11 @@ def main():
 
     if args.chaos_gate:
         from predictionio_tpu.runtime.gate import run_gate
+
+        return run_gate()
+
+    if args.hotpath_gate:
+        from predictionio_tpu.utils.hotpath_gate import run_gate
 
         return run_gate()
 

@@ -1,0 +1,211 @@
+"""Hot-path encode/cache layer: fastjson envelope parity, the per-user
+result cache, and the ingest→serving invalidation bus.
+
+The one invariant everything here hangs off: the fast paths must be
+byte-identical to the generic compact encoder (the serving A/B bench
+asserts bitwise-equal answers across transports), and a committed write
+must be visible to the very next query from the same user.
+"""
+
+import json
+import threading
+
+import pytest
+
+from predictionio_tpu.ingest.invalidation import InvalidationBus
+from predictionio_tpu.serving.result_cache import MISS, ResultCache
+from predictionio_tpu.utils import fastjson
+
+
+def _stock(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+class TestFastjson:
+    @pytest.mark.parametrize("obj", [
+        {"a": 1, "b": [1.5, None, True], "c": {"d": "é"}},
+        {"itemScores": [{"item": "i1", "score": 4.25}]},
+        {"message": "queue saturated (8/8 in flight)"},
+        [],
+        {"nested": {"deep": [{"x": 1e-9}, {"y": -3.0}]}},
+    ])
+    def test_dumps_bytes_matches_stock_compact(self, obj):
+        assert fastjson.dumps_bytes(obj) == _stock(obj)
+
+    def test_loads_round_trip(self):
+        obj = {"user": "u1", "num": 4, "scores": [1.5, 2.0]}
+        assert fastjson.loads(fastjson.dumps_bytes(obj)) == obj
+        assert fastjson.loads(fastjson.dumps(obj)) == obj
+        with pytest.raises(ValueError):
+            fastjson.loads(b"{nope")
+
+    def test_event_id_envelope_bitwise(self):
+        eid = "3f2a77c09e1b4c8d"
+        assert fastjson.event_id_response(eid) == _stock({"eventId": eid})
+        # non-plain ids fall back to the generic encoder, still correct
+        weird = 'id"with\\specials\n'
+        assert fastjson.event_id_response(weird) == _stock({"eventId": weird})
+
+    @pytest.mark.parametrize("result", [
+        {"itemScores": []},
+        {"itemScores": [{"item": "i1", "score": 4.5},
+                        {"item": "i2", "score": 0.125}]},
+        {"itemScores": [{"item": "i1", "score": 3}]},        # int score
+        {"itemScores": [{"item": "i1", "score": 1e-17}]},    # repr path
+        {"itemScores": [{"item": "a b!~[]", "score": 0.5}]},
+    ])
+    def test_prediction_envelope_bitwise(self, result):
+        assert fastjson.prediction_response(result) == _stock(result)
+
+    @pytest.mark.parametrize("result", [
+        {"itemScores": [{"item": "i1", "score": float("nan")}]},
+        {"itemScores": [{"item": "unié", "score": 1.0}]},
+        {"itemScores": [{"item": "i1", "score": 1.0, "extra": 2}]},
+        {"itemScores": [{"item": "i1", "score": True}]},
+        {"itemScores": "not-a-list"},
+        {"other": 1},
+    ])
+    def test_prediction_fallback_still_generic(self, result):
+        # shapes the fragment path declines must match the C encoder too
+        # (NaN renders as the non-standard 'NaN' either way)
+        expect = json.dumps(result, separators=(",", ":")).encode()
+        assert fastjson.prediction_response(result) == expect
+
+    def test_message_body_interned_and_bitwise(self):
+        msg = "Shutting down."
+        assert fastjson.message_body(msg) == _stock({"message": msg})
+        assert fastjson.message_body(msg) is fastjson.message_body(msg)
+
+
+class TestResultCache:
+    def test_hit_miss_and_user_keying(self):
+        c = ResultCache(max_entries=8, ttl_s=60.0)
+        q1 = {"user": "u1", "num": 3}
+        assert c.get(q1) is MISS
+        c.put(q1, {"r": 1})
+        assert c.get(q1) == {"r": 1}
+        # a different query (even same user) is its own entry
+        assert c.get({"user": "u1", "num": 4}) is MISS
+
+    def test_ttl_expiry(self, monkeypatch):
+        c = ResultCache(max_entries=8, ttl_s=0.01)
+        q = {"user": "u1"}
+        c.put(q, "r")
+        import time
+        time.sleep(0.03)
+        assert c.get(q) is MISS
+
+    def test_lru_eviction_bounded(self):
+        c = ResultCache(max_entries=3, ttl_s=60.0)
+        for i in range(5):
+            c.put({"user": f"u{i}"}, i)
+        assert len(c) == 3
+        assert c.get({"user": "u0"}) is MISS          # evicted
+        assert c.get({"user": "u4"}) == 4             # newest survives
+
+    def test_invalidate_entities_is_per_user(self):
+        c = ResultCache(max_entries=8, ttl_s=60.0)
+        c.put({"user": "u1", "num": 3}, "a")
+        c.put({"user": "u1", "num": 4}, "b")
+        c.put({"user": "u2", "num": 3}, "c")
+        c.invalidate_entities(["u1"])
+        assert c.get({"user": "u1", "num": 3}) is MISS
+        assert c.get({"user": "u1", "num": 4}) is MISS
+        assert c.get({"user": "u2", "num": 3}) == "c"
+
+    def test_anonymous_entries_invalidated_by_any_commit(self):
+        # a query with no user key can depend on any entity → any commit
+        # must drop it
+        c = ResultCache(max_entries=8, ttl_s=60.0)
+        c.put({"num": 10}, "top10")
+        c.invalidate_entities(["whoever"])
+        assert c.get({"num": 10}) is MISS
+
+    def test_unencodable_query_never_cached(self):
+        c = ResultCache(max_entries=8, ttl_s=60.0)
+        q = {"user": "u1", "weird": object()}
+        c.put(q, "r")          # silently uncacheable
+        assert c.get(q) is MISS
+
+
+class TestInvalidationBus:
+    def test_publish_reaches_subscribers(self):
+        bus = InvalidationBus()
+        got = []
+        bus.subscribe(got.append)
+        assert bus.has_subscribers
+        bus.publish(["u1", "u2"])
+        assert got == [["u1", "u2"]]
+        bus.unsubscribe(got.append)
+        assert not bus.has_subscribers
+
+    def test_subscriber_exception_contained(self):
+        bus = InvalidationBus()
+        got = []
+
+        def boom(_ids):
+            raise RuntimeError("subscriber bug")
+
+        bus.subscribe(boom)
+        bus.subscribe(got.append)
+        bus.publish(["u1"])    # must not raise, must reach the healthy sub
+        assert got == [["u1"]]
+
+    def test_writer_publishes_committed_entity_ids(self):
+        """GroupCommitWriter must publish entity ids on the process bus
+        after a durable commit — grouped AND inline paths."""
+        import itertools
+
+        from predictionio_tpu.data.events import Event
+        from predictionio_tpu.ingest.invalidation import BUS
+        from predictionio_tpu.ingest.writer import (
+            GroupCommitWriter, IngestConfig,
+        )
+
+        published = []
+        BUS.subscribe(published.append)
+        ids = itertools.count(1)
+        try:
+            for grouping in (True, False):
+                writer = GroupCommitWriter(
+                    insert_fn=lambda e, a, c=None: str(next(ids)),
+                    grouped_fn=lambda items: [str(next(ids)) for _ in items],
+                    config=IngestConfig(grouping=grouping),
+                    name="bustest")
+                try:
+                    writer.submit(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"user-{grouping}",
+                              target_entity_type="item",
+                              target_entity_id="i1"),
+                        app_id=1)
+                finally:
+                    writer.close()
+            flat = [eid for batch in published for eid in batch]
+            assert "user-True" in flat and "user-False" in flat
+        finally:
+            BUS.unsubscribe(published.append)
+
+
+def test_bus_unsubscribe_under_concurrent_publish():
+    """Copy-on-write subscriber list: unsubscribing mid-publish-storm
+    must neither deadlock nor raise."""
+    bus = InvalidationBus()
+    seen = []
+    bus.subscribe(seen.append)
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            bus.publish(["u"])
+
+    t = threading.Thread(target=storm)
+    t.start()
+    try:
+        for _ in range(50):
+            bus.subscribe(len)          # churn the list
+            bus.unsubscribe(len)
+    finally:
+        stop.set()
+        t.join(5)
+    assert seen  # publishes reached the stable subscriber

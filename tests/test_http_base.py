@@ -58,6 +58,54 @@ def test_handler_exception_logs_not_stderr(capfd, caplog):
     assert HTTP_ERRORS.labels(server="boomsvc").value == errors_before + 1
 
 
+def test_short_body_times_out_408_not_forever(monkeypatch):
+    """A client that promises Content-Length N and sends fewer bytes must
+    get a 408 within the read timeout, not pin a server thread forever
+    (the pre-event-loop read_body blocked indefinitely on the socket)."""
+    import json
+    import socket
+    import time
+
+    monkeypatch.setenv("PIO_HTTP_READ_TIMEOUT_S", "0.5")
+
+    class _Echo(JsonRequestHandler):
+        def do_POST(self):
+            body = self.read_body()
+            self.send_json(200, {"n": len(body)})
+
+    svc = HttpService("127.0.0.1", 0, _Echo, server_name="shortbody")
+    svc.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", svc.port), timeout=10)
+        t0 = time.monotonic()
+        s.sendall(b"POST /x HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 100\r\n\r\nonly-a-few-bytes")
+        # read to EOF: the 408 must arrive AND the server must close the
+        # connection (a half-read body cannot be reframed)
+        raw = b""
+        s.settimeout(10)
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            raw += chunk
+        elapsed = time.monotonic() - t0
+        assert b" 408 " in raw.split(b"\r\n", 1)[0], raw[:200]
+        assert 0.3 <= elapsed < 5.0, elapsed
+        _head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"timeout" in json.loads(body)["message"].lower().encode()
+        s.close()
+        # a well-framed request on a fresh connection still serves
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+        conn.request("POST", "/x", b"12345",
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read())["n"] == 5
+        conn.close()
+    finally:
+        svc.shutdown()
+
+
 def test_client_disconnect_is_not_an_error(capfd, caplog):
     """A client dropping mid-request (routine under kill drills and load
     ladders) is debug noise, not an error record."""

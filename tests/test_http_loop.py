@@ -1,0 +1,275 @@
+"""Event-loop HTTP transport (utils/httploop.py) — protocol conformance.
+
+The selector loop replaced thread-per-connection serving for the hot
+routes; these tests pin the HTTP/1.1 semantics that keep-alive parking
+makes easy to get wrong: pipelining order, malformed-request containment
+(one bad client must not kill the shared loop), slowloris timeouts, and
+the pause/resume lifecycle the supervisor's rolling deploys drive.
+"""
+
+import http.client
+import json
+import socket
+import time
+
+import pytest
+
+from predictionio_tpu.utils.http import HttpService
+from predictionio_tpu.utils.routing import Request, Response, Router
+
+
+def _router():
+    r = Router()
+    r.get("/", lambda req: Response.json(200, {"ok": True}))
+    r.post("/echo", lambda req: Response.json(
+        200, {"n": len(req.body or b""), "q": req.params.get("q", "")}))
+
+    def _slow(req):
+        time.sleep(0.05)
+        return Response.json(200, {"slow": True})
+
+    r.post("/slow", _slow, blocking=True)
+    return r
+
+
+@pytest.fixture
+def svc():
+    service = HttpService("127.0.0.1", 0, router=_router(),
+                          server_name="looptest")
+    service.start()
+    yield service
+    service.shutdown()
+
+
+def _recv_responses(sock, n, timeout=10.0):
+    """Read exactly n HTTP responses (Content-Length framed) off a raw
+    socket; returns a list of (status, body_bytes)."""
+    sock.settimeout(timeout)
+    buf = b""
+    out = []
+    while len(out) < n:
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError(
+                    f"connection closed after {len(out)}/{n} responses; "
+                    f"buffer {buf[:200]!r}")
+            buf += chunk
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        length = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.lower() == b"content-length":
+                length = int(v)
+        while len(rest) < length:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError("closed mid-body")
+            rest += chunk
+        out.append((status, rest[:length]))
+        buf = rest[length:]
+    return out, buf
+
+
+def test_keep_alive_reuses_one_connection(svc):
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    for i in range(5):
+        conn.request("POST", f"/echo?q=v{i}", b"x" * i,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 200 and body == {"n": i, "q": f"v{i}"}
+    conn.close()
+
+
+def test_pipelined_requests_answered_in_order(svc):
+    """Two requests in ONE tcp segment → two responses, request order."""
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"POST /echo?q=a HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 2\r\n\r\nAA"
+              b"POST /echo?q=b HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 3\r\n\r\nBBB")
+    (r1, r2), _ = _recv_responses(s, 2)
+    assert r1[0] == 200 and json.loads(r1[1]) == {"n": 2, "q": "a"}
+    assert r2[0] == 200 and json.loads(r2[1]) == {"n": 3, "q": "b"}
+    s.close()
+
+
+def test_pipelined_blocking_routes_stay_ordered(svc):
+    """Pipelining across worker-pool routes must still answer in request
+    order (strict per-connection FIFO), even when the first is slower."""
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"POST /slow HTTP/1.1\r\nHost: x\r\nContent-Length: 0\r\n\r\n"
+              b"POST /echo?q=after HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 0\r\n\r\n")
+    responses, _ = _recv_responses(s, 2)
+    assert json.loads(responses[0][1]) == {"slow": True}
+    assert json.loads(responses[1][1])["q"] == "after"
+    s.close()
+
+
+def test_malformed_request_line_400_loop_survives(svc):
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"this is not http\r\n\r\n")
+    responses, _ = _recv_responses(s, 1)
+    assert responses[0][0] == 400
+    s.close()
+    # the shared loop still serves other clients
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    conn.request("GET", "/")
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+def test_unknown_verb_501(svc):
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"BREW /coffee HTTP/1.1\r\nHost: x\r\n\r\n")
+    responses, _ = _recv_responses(s, 1)
+    assert responses[0][0] == 501
+    s.close()
+
+
+def test_slowloris_partial_header_times_out(monkeypatch):
+    monkeypatch.setenv("PIO_HTTP_READ_TIMEOUT_S", "0.4")
+    service = HttpService("127.0.0.1", 0, router=_router(),
+                          server_name="slowloris")
+    service.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", service.port), timeout=10)
+        t0 = time.monotonic()
+        s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\nX-Drip")  # never finishes
+        responses, _ = _recv_responses(s, 1)
+        elapsed = time.monotonic() - t0
+        assert responses[0][0] == 408
+        assert 0.2 <= elapsed < 5.0, elapsed
+        s.settimeout(5)
+        assert s.recv(1024) == b""  # server closed the unframeable conn
+        s.close()
+        # idle PARKED connections are not subject to the read timeout:
+        # a keep-alive client that simply goes quiet between requests
+        # stays parked
+        conn = http.client.HTTPConnection("127.0.0.1", service.port,
+                                          timeout=5)
+        conn.request("GET", "/")
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200
+        time.sleep(0.8)  # > read timeout, parked the whole time
+        conn.request("GET", "/")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        service.shutdown()
+
+
+def test_connection_close_honored(svc):
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+    responses, _ = _recv_responses(s, 1)
+    assert responses[0][0] == 200
+    s.settimeout(5)
+    assert s.recv(1024) == b""
+    s.close()
+
+
+def test_http10_defaults_to_close(svc):
+    s = socket.create_connection(("127.0.0.1", svc.port), timeout=5)
+    s.sendall(b"GET / HTTP/1.0\r\nHost: x\r\n\r\n")
+    responses, _ = _recv_responses(s, 1)
+    assert responses[0][0] == 200
+    s.settimeout(5)
+    assert s.recv(1024) == b""
+    s.close()
+
+
+def test_pause_resume_accept_cycle(svc):
+    assert svc.accepting
+    svc.pause_accept()
+    assert not svc.accepting
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", svc.port), timeout=0.5)
+    svc.resume_accept()
+    assert svc.accepting
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    conn.request("GET", "/")
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+def test_parked_connection_served_across_pause(svc):
+    """pause_accept only closes the LISTENER: already-parked keep-alive
+    clients keep being served through the drain (zero-drop reload)."""
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    conn.request("GET", "/")
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 200
+    svc.pause_accept()
+    try:
+        conn.request("GET", "/")
+        assert conn.getresponse().status == 200
+    finally:
+        svc.resume_accept()
+        conn.close()
+
+
+def test_busy_requests_counts_pipelined_backlog(svc):
+    assert svc.busy_requests() == 0
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    conn.request("GET", "/")
+    conn.getresponse().read()
+    assert svc.busy_requests() == 0  # parked between requests ≠ busy
+    conn.close()
+
+
+def test_threaded_fallback_env(monkeypatch):
+    """PIO_HTTP_LOOP=0 routes the same Router through the threaded
+    adapter — the escape hatch must serve identically."""
+    monkeypatch.setenv("PIO_HTTP_LOOP", "0")
+    service = HttpService("127.0.0.1", 0, router=_router(),
+                          server_name="fallback")
+    assert service.httpd is not None  # threaded transport engaged
+    service.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", service.port,
+                                          timeout=5)
+        conn.request("POST", "/echo?q=z", b"abc",
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200 and json.loads(r.read()) == {"n": 3, "q": "z"}
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        service.shutdown()
+
+
+def test_metrics_and_trace_header(svc):
+    conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+    conn.request("GET", "/")
+    r = conn.getresponse()
+    r.read()
+    assert r.getheader("X-PIO-Trace-Id")
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    for family in ("http_requests_total", "http_parked_connections",
+                   "http_requests_per_connection"):
+        assert f"# TYPE {family} " in text, family
+    assert 'server="looptest"' in text
+
+
+def test_parked_gauge_never_underflows(svc):
+    """Regression: conns were born in _PARKED, so accept's park was a
+    no-op while the first unpark still decremented — the gauge went
+    negative one per served-then-closed connection."""
+    for _ in range(4):
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+        conn.request("GET", "/")
+        conn.getresponse().read()
+        conn.close()
+    deadline = time.monotonic() + 2
+    while svc._loop.parked_connections != 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert svc._loop.parked_connections == 0
