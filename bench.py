@@ -280,11 +280,18 @@ def _kill_proc(proc) -> None:
             pass
 
 
-def _train_serving_model(storage_spec: str, bench_tmp: str):
+def _train_serving_model(storage_spec: str, bench_tmp: str,
+                         extra_variants=()):
     """Shared serving-bench setup: 20k synthetic ratings into BenchApp,
     one ALS train registered under engine id "bench". Returns the live
     Storage (installed as the process default by Storage.reset) and its
-    SourceConfig (pool mode passes the sqlite path to workers)."""
+    SourceConfig (pool mode passes the sqlite path to workers).
+
+    `extra_variants` trains additional servable arms of the same engine
+    on the same ingested data — each a second run_train whose
+    engine.json carries a distinct "variant" key (engine_id stays
+    "bench"), which is exactly what `PIO_EXPERIMENT_VARIANTS` deploys
+    side by side (bench.py --variant-qps)."""
     import tempfile
 
     from predictionio_tpu.data.datamap import DataMap
@@ -314,17 +321,27 @@ def _train_serving_model(storage_spec: str, bench_tmp: str):
 
     with tempfile.TemporaryDirectory() as tmp:
         engine_json = os.path.join(tmp, "engine.json")
+        base = {
+            "id": "bench", "engineFactory":
+                "predictionio_tpu.templates.recommendation."
+                "RecommendationEngine",
+            "datasource": {"params": {"appName": "BenchApp"}},
+            "algorithms": [{"name": "als", "params":
+                            {"rank": RANK, "numIterations": 10,
+                             "lambda": 0.05, "seed": 1}}],
+        }
         with open(engine_json, "w") as f:
-            json.dump({
-                "id": "bench", "engineFactory":
-                    "predictionio_tpu.templates.recommendation."
-                    "RecommendationEngine",
-                "datasource": {"params": {"appName": "BenchApp"}},
-                "algorithms": [{"name": "als", "params":
-                                {"rank": RANK, "numIterations": 10,
-                                 "lambda": 0.05, "seed": 1}}],
-            }, f)
+            json.dump(base, f)
         run_train(engine_json=engine_json)
+        for i, name in enumerate(extra_variants):
+            d = dict(base, variant=name)
+            # a genuinely different arm (different seed), same engine id
+            d["algorithms"] = [{"name": "als", "params":
+                                dict(base["algorithms"][0]["params"],
+                                     seed=2 + i)}]
+            with open(engine_json, "w") as f:
+                json.dump(d, f)
+            run_train(engine_json=engine_json)
     return storage, src
 
 
@@ -726,6 +743,236 @@ def bench_serving_qps(emit: bool = True, ladder=None,
     }
     if emit:
         print(json.dumps(record))
+    return record
+
+
+def bench_variant_qps(emit: bool = True, duration_s: float = 5.0):
+    """Experiment-router overhead A/B (bench.py --variant-qps): two
+    trained arms of the "bench" engine behind one /queries.json, sticky
+    mode, against the identical single-plane server. Three legs:
+
+    1. A/B — both servers (single plane vs VariantRouter pinned to one
+       arm with sticky weights "1,0") are loaded CONCURRENTLY in the
+       SAME window, n_clients threads each, at the 8- and 32-client
+       rungs; the bar is the MEDIAN over windows of the in-window
+       ratio router_p95 / single_p95 ≤ 1.05 at both rungs. The design
+       is forced by the measurement box: the shared 1-vCPU core's
+       speed drifts by more than the 5% bar on a seconds-to-minutes
+       timescale, so sequential comparisons — even short adjacent
+       alternating pairs — mostly measure which config drew the
+       luckier window. Loading both servers at once makes every window
+       self-pairing: the instantaneous box conditions (and, since both
+       servers share this process's interpreter, the same GIL
+       schedule) apply to both sides identically, so drift and
+       position bias cancel inside each ratio, and the median over
+       windows ignores polluted ones. Contention between the two
+       loaded servers is symmetric — both serve the identical
+       workload — so it shifts the operating point, not the ratio.
+       Pinning isolates the ROUTER layer (the digest + dict lookup +
+       the bookkeeping handoff): both servers then funnel every query
+       through one micro-batcher and one model, so any tail gap is
+       the router's. (An even split is measured too, informational:
+       two live arms genuinely halve micro-batch amortization and
+       alternate two model working sets — that is the price of
+       running two models, not of the router.)
+    2. attribution — the flight recorder must carry the
+       `experiment.route` span on the router server, so the overhead
+       is measured, not guessed;
+    3. assignment receipts — X-PIO-Variant over a spread of user ids
+       on an EVEN split must cover BOTH arms, and repeating a user must
+       repeat its variant (the sticky contract, observed through the
+       real HTTP surface)."""
+    import contextlib
+    import http.client
+    import tempfile as _tf
+
+    from predictionio_tpu.serving import ServingConfig
+    from predictionio_tpu.workflow.create_server import (
+        PredictionServer, ServerConfig,
+    )
+
+    bench_tmp = _tf.mkdtemp(prefix="pio_bench_")
+    _train_serving_model("memory", bench_tmp, extra_variants=("bench-b",))
+    rng = np.random.default_rng(7)
+    pl = [json.dumps({"user": str(u), "num": 10}).encode()
+          for u in rng.integers(0, 943, 512)]
+    payloads = lambda j: pl[j % len(pl)]  # noqa: E731
+
+    @contextlib.contextmanager
+    def env(**kv):
+        old = {k: os.environ.get(k) for k in kv}
+        os.environ.update(kv)
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def serve(experiment: bool, weights: str = ""):
+        with env(PIO_HTTP_LOOP="1", PIO_HTTP_RESULT_CACHE="0",
+                 PIO_EXPERIMENT_VARIANTS=("bench,bench-b" if experiment
+                                          else ""),
+                 PIO_EXPERIMENT_WEIGHTS=weights,
+                 PIO_EXPERIMENT_MODE="sticky"):
+            server = PredictionServer(
+                ServerConfig(ip="127.0.0.1", port=0, engine_id="bench",
+                             engine_variant="bench"),
+                serving_config=ServingConfig())
+            server.start()
+        return server
+
+    def warm(port, seconds=1.0):
+        t_end = time.time() + seconds
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+        while time.time() < t_end:
+            conn.request("POST", "/queries.json", pl[0],
+                         {"Content-Type": "application/json"})
+            conn.getresponse().read()
+        conn.close()
+
+    def _median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2
+
+    import threading as _threading
+
+    rungs = (8, 32)
+    n_windows = 8
+    window_s = 3.0
+    results = {"single": {}, "router": {}}
+    paired = {}
+    # "1,0" pins the router to the first arm — see the docstring for
+    # why both servers are loaded concurrently in every window.
+    s_single = serve(False)
+    s_router = serve(True, weights="1,0")
+    try:
+        warm(s_single.port)
+        warm(s_router.port)
+        for n_clients in rungs:
+            windows = {"single": [], "router": []}
+            for _ in range(n_windows):
+                out = {}
+
+                def _load(name, port):
+                    out[name] = _run_http_load(
+                        port, "/queries.json", payloads, n_clients,
+                        duration_s=window_s)
+
+                loaders = [
+                    _threading.Thread(target=_load,
+                                      args=("single", s_single.port)),
+                    _threading.Thread(target=_load,
+                                      args=("router", s_router.port)),
+                ]
+                for t in loaders:
+                    t.start()
+                for t in loaders:
+                    t.join()
+                windows["single"].append(out["single"])
+                windows["router"].append(out["router"])
+            for name in ("single", "router"):
+                qps = _median([w[0] for w in windows[name]])
+                p50 = _median([w[1] for w in windows[name]])
+                p95 = _median([w[2] for w in windows[name]])
+                results[name][str(n_clients)] = {
+                    "qps": round(qps, 1),
+                    "p50_ms": round(p50 * 1e3, 2),
+                    "p95_ms": round(p95 * 1e3, 2),
+                    "n_requests": sum(w[3] for w in windows[name]),
+                }
+            ratios = [r[2] / s[2] for r, s in zip(windows["router"],
+                                                  windows["single"])]
+            median = _median(ratios)
+            paired[str(n_clients)] = {
+                "ratios": [round(x, 3) for x in sorted(ratios)],
+                "median": round(median, 3)}
+            results["router"][str(n_clients)]["p95_vs_single"] = \
+                round(median, 3)
+    finally:
+        s_single.shutdown()
+        s_router.shutdown()
+
+    # attribution + assignment receipts + the informational even-split
+    # rung on one fresh router server (no weights: 50/50)
+    server = serve(True)
+    try:
+        warm(server.port)
+        qps, p50, p95, n = _run_http_load(
+            server.port, "/queries.json", payloads, 32,
+            duration_s=duration_s)
+        even_split_32 = {"qps": round(qps, 1),
+                         "p50_ms": round(p50 * 1e3, 2),
+                         "p95_ms": round(p95 * 1e3, 2), "n_requests": n}
+        span_breakdown = _span_breakdown(server.port, "/queries.json",
+                                         payloads)
+        seen: dict = {}
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        for u in range(64):
+            body = json.dumps({"user": str(u), "num": 10}).encode()
+            for _ in range(2):  # twice: the repeat must not move
+                conn.request("POST", "/queries.json", body,
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                variant = r.getheader("X-PIO-Variant")
+                if r.status != 200 or variant is None:
+                    raise SystemExit(
+                        f"variant_qps: user {u} got status {r.status}, "
+                        f"X-PIO-Variant={variant!r}")
+                if seen.setdefault(str(u), variant) != variant:
+                    raise SystemExit(
+                        f"variant_qps: user {u} moved from "
+                        f"{seen[str(u)]} to {variant} between requests "
+                        f"(sticky assignment broken)")
+        conn.close()
+    finally:
+        server.shutdown()
+    coverage = {v: sum(1 for x in seen.values() if x == v)
+                for v in ("bench", "bench-b")}
+    if not all(coverage.values()):
+        raise SystemExit(f"variant_qps: 64 users never reached both "
+                         f"arms ({coverage})")
+    if "experiment.route" not in span_breakdown:
+        raise SystemExit(f"variant_qps: flight recorder has no "
+                         f"experiment.route span — router overhead is "
+                         f"unattributable ({sorted(span_breakdown)})")
+
+    bar = {f"p95_{rung}_within_5pct": paired[rung]["median"] <= 1.05
+           for rung in map(str, rungs)}
+
+    record = {
+        "metric": "variant_router_qps",
+        "value": results["router"]["32"]["qps"],
+        "unit": "qps",
+        "concurrency": 32,
+        "single": results["single"],
+        "router": results["router"],
+        # per-window concurrent router/single p95 ratios behind the
+        # bar medians
+        "paired_p95_ratios": paired,
+        # two live arms, 50/50: the price of a second model (split
+        # micro-batches, two working sets) — informational, not barred
+        "even_split_32": even_split_32,
+        "span_breakdown": {k: v for k, v in span_breakdown.items()
+                           if k in ("experiment.route", "http.dispatch",
+                                    "serving.admission",
+                                    "predictionserver.predict")},
+        "assignment_coverage": coverage,
+        # acceptance bar (ISSUE r8): the router layer costs ≤5% median
+        # paired p95 at both rungs vs the identical single-plane server
+        "bar": bar,
+    }
+    if emit:
+        print(json.dumps(record))
+    if not all(bar.values()):
+        raise SystemExit(f"variant_qps: router overhead bar failed "
+                         f"({bar}; paired={paired} "
+                         f"single={results['single']} "
+                         f"router={results['router']})")
     return record
 
 
@@ -1989,6 +2236,13 @@ if __name__ == "__main__":
                     help="backing store: memory | sqlite | sqlite:///path"
                          " | postgres://... (default: memory for "
                          "--serving, sqlite for --ingest)")
+    ap.add_argument("--variant-qps", action="store_true",
+                    help="experiment-router overhead A/B: two trained "
+                         "arms behind one /queries.json (sticky mode) vs "
+                         "the identical single-plane server; bar is "
+                         "router p95 ≤ 1.05× single p95 at 8 and 32 "
+                         "clients, with the experiment.route span "
+                         "attributing the cost")
     ap.add_argument("--rolling-deploy", action="store_true",
                     help="zero-downtime drill: a supervised >=4-worker "
                          "pool under sustained load through a mid-load "
@@ -2045,6 +2299,8 @@ if __name__ == "__main__":
     elif args.serving_qps:
         bench_serving_qps(
             ladder=tuple(CLIENT_LADDER) if args.clients else None)
+    elif args.variant_qps:
+        bench_variant_qps()
     elif args.rolling_deploy:
         bench_rolling_deploy(workers=args.workers if args.workers > 1 else 4,
                              clients=CLIENT_LADDER[-1])

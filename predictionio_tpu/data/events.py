@@ -20,7 +20,7 @@ class EventValidationError(ValueError):
     """Raised when an event violates the reserved-event / naming rules."""
 
 
-SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete", "$reward"})
 
 
 def _now() -> datetime:
@@ -138,6 +138,9 @@ def validate_event(e: Event) -> None:
     - special events must not have a target entity;
     - ``$unset`` must carry a non-empty properties map;
     - ``$delete`` must carry no properties;
+    - ``$reward`` must carry a non-empty string ``variant`` and a
+      numeric ``reward`` in [0, 1] in its properties (the experiment
+      plane's bandit-feedback event — docs/experimentation.md);
     - ``pio_``-prefixed entity types / property names are reserved.
     """
     if e.event.startswith("$") and e.event not in SPECIAL_EVENTS:
@@ -159,3 +162,19 @@ def validate_event(e: Event) -> None:
             raise EventValidationError("$unset must have a non-empty properties map.")
         if e.event == "$delete" and not e.properties.is_empty:
             raise EventValidationError("$delete must not have properties.")
+        if e.event == "$reward":
+            props = e.properties.to_dict()
+            variant = props.get("variant")
+            if not isinstance(variant, str) or not variant:
+                raise EventValidationError(
+                    "$reward must carry a non-empty string 'variant' property."
+                )
+            reward = props.get("reward")
+            if isinstance(reward, bool) or not isinstance(reward, (int, float)):
+                raise EventValidationError(
+                    "$reward must carry a numeric 'reward' property."
+                )
+            if not 0.0 <= float(reward) <= 1.0:
+                raise EventValidationError(
+                    f"$reward 'reward' must be in [0, 1], got {reward!r}."
+                )

@@ -1,0 +1,39 @@
+"""Experimentation plane: many trained engine variants behind one route.
+
+PredictionIO's lineage is A/B-testable engines; this package is that
+capability rebuilt on the subsystems already here. One `VariantRouter`
+sits where a single ServingPlane used to, in front of one
+admission-gated plane PER trained engine variant:
+
+- **sticky mode** — a deterministic digest of the user id picks the
+  variant (bandit-free A/B with stable assignment: the same user maps
+  to the same variant across worker restarts, pool resizes, and rolling
+  deploys, because the digest — unlike Python's per-process-randomized
+  `hash()` — depends on nothing but the bytes of the id).
+- **bandit mode** — Thompson sampling over per-variant Beta posteriors.
+  Feedback arrives as `$reward` events through the normal group-commit
+  ingest funnel (ingest/writer.py); a `RewardTailer` polls the durable
+  event store and updates the posteriors, so every serving worker —
+  whichever process ingested the reward — converges on the same split.
+
+Per-variant `experiment_*` telemetry (traffic share, posterior mean,
+reward counts, request outcomes) and per-variant SLO objectives
+(`/queries.json@<variant>`) ride the existing registry; per-variant
+result-cache keys (serving/result_cache.py) keep cached answers from
+leaking across variants. Configuration is the `PIO_EXPERIMENT_*` env
+family (workflow/create_server.py turns it on), so pre-fork pool
+workers inherit one consistent experiment posture across fork/exec —
+same story as PIO_SERVING_* / PIO_INGEST_*.
+
+See docs/experimentation.md for the operator guide and bandit math.
+"""
+
+from predictionio_tpu.experiment.bandit import (  # noqa: F401
+    ThompsonBandit,
+    sticky_variant,
+)
+from predictionio_tpu.experiment.rewards import RewardTailer  # noqa: F401
+from predictionio_tpu.experiment.router import (  # noqa: F401
+    ExperimentConfig,
+    VariantRouter,
+)

@@ -1,0 +1,145 @@
+"""Traffic assignment: deterministic sticky split + Thompson sampling.
+
+Both assigners are pure — no storage, no telemetry, no engine state —
+so the routing decision is unit-testable math and the router
+(experiment/router.py) stays a thin orchestration layer.
+
+**Sticky split.** `sticky_variant` maps a user id onto the unit
+interval with a stable digest (crc32 of the id bytes) and walks the
+variants' cumulative weight buckets, sorted by name so the bucket
+layout is independent of configuration order. Python's builtin
+`hash()` is deliberately NOT used: it is salted per process
+(PYTHONHASHSEED), so a worker restart or a pool resize would reshuffle
+every user onto a new variant — exactly the instability an A/B
+assignment must not have.
+
+**Thompson sampling.** Each variant keeps a Beta(α, β) posterior over
+its reward rate, starting from the uniform prior Beta(1, 1). A reward
+r ∈ [0, 1] updates α += r, β += 1 − r (the fractional generalization of
+the Bernoulli update). To choose, sample one value from every
+posterior and play the argmax — variants are explored in proportion to
+the probability they are the best, which annealls exploration away as
+evidence accumulates. Sampling uses stdlib `random.betavariate`; no
+new dependencies.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def sticky_buckets(variants: Sequence[str],
+                   weights: Optional[Sequence[float]] = None
+                   ) -> List[Tuple[str, float]]:
+    """Cumulative weight buckets over name-sorted variants — the
+    precomputable half of `sticky_variant`, split out so the serving
+    router pays the sort/normalize once at construction instead of per
+    query. Returns [(name, cumulative_upper_bound), ...]."""
+    if not variants:
+        raise ValueError("sticky_variant needs at least one variant")
+    if weights is None:
+        pairs = sorted((v, 1.0) for v in variants)
+    else:
+        if len(weights) != len(variants):
+            raise ValueError(
+                f"{len(weights)} weights for {len(variants)} variants")
+        pairs = sorted(zip(variants, (float(w) for w in weights)))
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        raise ValueError("sticky weights must sum to a positive value")
+    buckets, acc = [], 0.0
+    for name, w in pairs:
+        acc += w / total
+        buckets.append((name, acc))
+    return buckets
+
+
+def bucket_variant(user: object, buckets: List[Tuple[str, float]]) -> str:
+    """Map `user` onto precomputed `sticky_buckets` output.
+
+    crc32 is uniform enough over real id spaces for bucketing, cheap,
+    and — the property that matters — identical in every process."""
+    x = (zlib.crc32(str(user).encode("utf-8")) & 0xFFFFFFFF) / 4294967296.0
+    for name, bound in buckets:
+        if x < bound:
+            return name
+    return buckets[-1][0]  # float-accumulation guard
+
+
+def sticky_variant(user: object, variants: Sequence[str],
+                   weights: Optional[Sequence[float]] = None) -> str:
+    """Deterministically map `user` to one of `variants`.
+
+    The mapping depends only on the id bytes and the (variant, weight)
+    set — stable across processes, restarts, and worker counts. With
+    `weights` (same order as `variants`) the split follows the
+    normalized weights; default is an even split."""
+    return bucket_variant(user, sticky_buckets(variants, weights))
+
+
+class ThompsonBandit:
+    """Per-variant Beta posteriors with Thompson-sampling choice.
+
+    Thread-safe: the serving hot path calls `choose()` while the reward
+    tailer calls `reward()` from its poll thread."""
+
+    def __init__(self, variants: Iterable[str],
+                 seed: Optional[int] = None,
+                 prior_alpha: float = 1.0, prior_beta: float = 1.0):
+        names = list(variants)
+        if not names:
+            raise ValueError("ThompsonBandit needs at least one variant")
+        self._posteriors: Dict[str, list] = {
+            v: [float(prior_alpha), float(prior_beta)] for v in names}
+        self._reward_counts: Dict[str, int] = {v: 0 for v in names}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @property
+    def variants(self) -> list:
+        return list(self._posteriors)
+
+    def choose(self) -> str:
+        """Sample every posterior, play the argmax."""
+        with self._lock:
+            best, best_draw = None, -1.0
+            for v, (a, b) in self._posteriors.items():
+                draw = self._rng.betavariate(a, b)
+                if draw > best_draw:
+                    best, best_draw = v, draw
+            return best
+
+    def reward(self, variant: str, value: float) -> bool:
+        """Credit `value` ∈ [0, 1] to `variant`'s posterior. Returns
+        False (no-op) for variants this bandit does not route — rewards
+        in the store may reference experiments that are no longer
+        deployed."""
+        if variant not in self._posteriors:
+            return False
+        r = min(max(float(value), 0.0), 1.0)
+        with self._lock:
+            post = self._posteriors[variant]
+            post[0] += r
+            post[1] += 1.0 - r
+            self._reward_counts[variant] += 1
+        return True
+
+    def posterior_mean(self, variant: str) -> float:
+        a, b = self._posteriors[variant]
+        return a / (a + b)
+
+    def reward_count(self, variant: str) -> int:
+        return self._reward_counts[variant]
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Dashboard/status-page view of every posterior."""
+        with self._lock:
+            return {
+                v: {"alpha": round(a, 4), "beta": round(b, 4),
+                    "mean": round(a / (a + b), 4),
+                    "rewards": self._reward_counts[v]}
+                for v, (a, b) in self._posteriors.items()
+            }

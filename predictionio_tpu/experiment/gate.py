@@ -1,0 +1,243 @@
+"""Experiment gate — CI drill that the experimentation plane earns its
+keep. Run via `python quality.py --experiment-gate`. Four drills:
+
+1. **Sticky determinism**: the user→variant mapping must be a pure
+   function of (id bytes, variant set, weights) — identical in-process
+   on repeat calls, AND identical across two fresh interpreters started
+   with different PYTHONHASHSEED values (the trap that makes builtin
+   `hash()` unusable for assignment).
+
+2. **Cache isolation**: a ResultCache shared by two variants must never
+   answer variant A's query from variant B's entry, and variant-scoped
+   invalidation (`invalidate_variant`, variant-scoped bus messages)
+   must drop only the named variant's entries.
+
+3. **Bandit convergence**: a seeded ThompsonBandit routing through a
+   real GroupCommitWriter → memory event store → RewardTailer loop,
+   fed Bernoulli rewards (good arm p=0.9, bad arm p=0.1), must send
+   ≥ 80% of the final traffic window to the good arm. This drill walks
+   the reward through the actual ingest funnel — validation, group
+   commit, durable store, tail — not an in-memory shortcut.
+
+4. **Telemetry**: the experiment_* families must render on /metrics.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_STICKY_SNIPPET = """
+import json, sys
+from predictionio_tpu.experiment.bandit import sticky_variant
+users = [f"user-{i}" for i in range(400)]
+mapping = {u: sticky_variant(u, ["champ", "challenger"]) for u in users}
+json.dump(mapping, sys.stdout, sort_keys=True)
+"""
+
+
+def _sticky_problems() -> list:
+    from predictionio_tpu.experiment.bandit import sticky_variant
+
+    problems = []
+    users = [f"user-{i}" for i in range(400)]
+    first = {u: sticky_variant(u, ["champ", "challenger"]) for u in users}
+    again = {u: sticky_variant(u, ["challenger", "champ"]) for u in users}
+    if first != again:
+        problems.append(
+            "sticky: mapping depends on variant declaration order")
+    share = sum(1 for v in first.values() if v == "champ") / len(users)
+    if not 0.35 <= share <= 0.65:
+        problems.append(
+            f"sticky: even split sent {share:.0%} to one arm over "
+            f"{len(users)} users (digest badly skewed)")
+    heavy = {u: sticky_variant(u, ["champ", "challenger"], [0.9, 0.1])
+             for u in users}
+    heavy_share = sum(1 for v in heavy.values() if v == "champ") / len(users)
+    if not 0.80 <= heavy_share <= 0.98:
+        problems.append(
+            f"sticky: 90/10 weights produced a {heavy_share:.0%} share")
+    maps = []
+    for hashseed in ("1", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", _STICKY_SNIPPET], env=env,
+            capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            problems.append(
+                f"sticky: subprocess (PYTHONHASHSEED={hashseed}) failed: "
+                f"{out.stderr.strip()[-200:]}")
+            return problems
+        maps.append(out.stdout)
+    if maps[0] != maps[1]:
+        problems.append(
+            "sticky: user→variant mapping differs between interpreters "
+            "with different PYTHONHASHSEED (assignment is not stable "
+            "across restarts)")
+    elif sys.version_info and maps[0] != _reference_mapping():
+        problems.append(
+            "sticky: subprocess mapping differs from this process's")
+    return problems
+
+
+def _reference_mapping() -> str:
+    import json
+
+    from predictionio_tpu.experiment.bandit import sticky_variant
+
+    users = [f"user-{i}" for i in range(400)]
+    return json.dumps(
+        {u: sticky_variant(u, ["champ", "challenger"]) for u in users},
+        sort_keys=True)
+
+
+def _cache_problems() -> list:
+    from predictionio_tpu.serving.result_cache import MISS, ResultCache
+
+    problems = []
+    cache = ResultCache(max_entries=64, ttl_s=60.0)
+    q = {"user": "u1", "num": 4}
+    cache.put(q, {"from": "a"}, "a")
+    cache.put(q, {"from": "b"}, "b")
+    got_a, got_b = cache.get(q, "a"), cache.get(q, "b")
+    if got_a is MISS or got_a.get("from") != "a" \
+            or got_b is MISS or got_b.get("from") != "b":
+        problems.append(
+            f"cache: variant keying broken (a→{got_a!r}, b→{got_b!r})")
+    cache.invalidate_variant("a")
+    if cache.get(q, "a") is not MISS:
+        problems.append("cache: invalidate_variant('a') left a's entry")
+    if cache.get(q, "b") is MISS:
+        problems.append("cache: invalidate_variant('a') dropped b's entry")
+    cache.put(q, {"from": "a"}, "a")
+    cache.invalidate_entities(["u1"], variant="b")
+    if cache.get(q, "a") is MISS:
+        problems.append(
+            "cache: variant-scoped invalidation for 'b' dropped an 'a' "
+            "entry (reward credit staling the other arm)")
+    cache.invalidate_entities(["u1"])  # unscoped: both must drop
+    if cache.get(q, "a") is not MISS or cache.get(q, "b") is not MISS:
+        problems.append("cache: unscoped invalidation left entries behind")
+    return problems
+
+
+def _convergence_problems() -> list:
+    import random
+    from collections import deque
+
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.experiment.bandit import ThompsonBandit
+    from predictionio_tpu.experiment.rewards import RewardTailer
+    from predictionio_tpu.experiment.router import (
+        ExperimentConfig, VariantRouter,
+    )
+    from predictionio_tpu.ingest import IngestConfig
+    from predictionio_tpu.ingest.writer import GroupCommitWriter
+    from predictionio_tpu.serving.plane import ServingConfig, ServingPlane
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+
+    problems = []
+    src = SourceConfig(name="EXPGATE", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    app_id = storage.meta_apps().insert(App(id=0, name="ExpGateApp"))
+    le = storage.l_events()
+    writer = GroupCommitWriter(insert_fn=le.insert,
+                               grouped_fn=le.insert_grouped,
+                               config=IngestConfig())
+    reward_p = {"good": 0.9, "bad": 0.1}
+    planes = {
+        v: ServingPlane(
+            dispatch_fn=(lambda queries, _v=v:
+                         [{"variant": _v} for _ in queries]),
+            config=ServingConfig(batching=False), result_cache=None,
+            variant=v)
+        for v in reward_p
+    }
+    config = ExperimentConfig(variants=("good", "bad"), mode="bandit",
+                              seed=1234, app_id=app_id)
+    router = VariantRouter(planes, config,
+                           bandit=ThompsonBandit(config.variants, seed=1234))
+    tailer = RewardTailer(storage, router.bandit, app_id=app_id,
+                          interval_s=0.05)
+    rng = random.Random(99)
+    window = deque(maxlen=150)
+    try:
+        for i in range(400):
+            result, _ = router.handle_query({"user": f"u{i}", "num": 1})
+            variant = result["variant"]
+            window.append(variant)
+            r = 1.0 if rng.random() < reward_p[variant] else 0.0
+            writer.submit(
+                Event(event="$reward", entity_type="user",
+                      entity_id=f"u{i}",
+                      properties=_props({"variant": variant, "reward": r})),
+                app_id)
+            if i % 10 == 9:
+                tailer.poll_once()
+        tailer.poll_once()
+    finally:
+        writer.close()
+        router.close()
+    good_share = sum(1 for v in window if v == "good") / len(window)
+    if good_share < 0.8:
+        problems.append(
+            f"bandit: good arm got only {good_share:.0%} of the final "
+            f"{len(window)} queries (want ≥ 80%); posteriors "
+            f"{router.bandit.snapshot()}")
+    applied = router.bandit.reward_count("good") \
+        + router.bandit.reward_count("bad")
+    if applied < 390:
+        problems.append(
+            f"bandit: tailer applied only {applied}/400 rewards from "
+            f"the store")
+    stored = sum(1 for _ in le.find(app_id, event_names=["$reward"]))
+    if stored != 400:
+        problems.append(
+            f"bandit: store holds {stored}/400 $reward events "
+            f"(ingest funnel dropped some)")
+    storage.close()
+    return problems
+
+
+def _props(d: dict):
+    from predictionio_tpu.data.datamap import DataMap
+
+    return DataMap(d)
+
+
+def _telemetry_problems() -> list:
+    from predictionio_tpu.telemetry.registry import REGISTRY
+
+    problems = []
+    text = REGISTRY.render()
+    for family in ("experiment_requests_total", "experiment_traffic_share",
+                   "experiment_posterior_mean", "experiment_rewards_total"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"telemetry: /metrics is missing {family}")
+    return problems
+
+
+def run_gate() -> int:
+    problems = []
+    for drill in (_sticky_problems, _cache_problems,
+                  _convergence_problems, _telemetry_problems):
+        try:
+            problems += drill()
+        except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+            problems.append(f"{drill.__name__} crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"experiment gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

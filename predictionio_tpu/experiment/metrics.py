@@ -1,0 +1,32 @@
+"""The experiment_* metric families, defined in one place.
+
+Router, reward tailer, gate, and dashboard all import from here so the
+registry sees a single consistent definition (REGISTRY.counter/gauge is
+get-or-create, but type/label mismatches raise — one definition site
+keeps that impossible).
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+EXPERIMENT_REQUESTS = REGISTRY.counter(
+    "experiment_requests_total",
+    "Queries routed by the experiment plane, by variant and outcome "
+    "(ok|degraded|shed|deadline|error)",
+    labelnames=("variant", "outcome"))
+
+EXPERIMENT_TRAFFIC_SHARE = REGISTRY.gauge(
+    "experiment_traffic_share",
+    "Fraction of recent routed queries (sliding window) sent to each variant",
+    labelnames=("variant",))
+
+EXPERIMENT_POSTERIOR_MEAN = REGISTRY.gauge(
+    "experiment_posterior_mean",
+    "Mean of each variant's Beta reward posterior, alpha / (alpha + beta)",
+    labelnames=("variant",))
+
+EXPERIMENT_REWARDS = REGISTRY.counter(
+    "experiment_rewards_total",
+    "$reward events applied to each variant's posterior by the reward tailer",
+    labelnames=("variant",))

@@ -7,6 +7,15 @@ per-item fallback, and the batch route's direct insert_batch) publishes
 the entity ids of the committed events; subscribers (the result cache)
 drop whatever they hold for those entities.
 
+Messages optionally carry an **engine variant id**. A plain data commit
+(`variant=None`) may change any variant's answer, so every subscriber
+acts on it; a variant-scoped commit (today: a `$reward` event, whose
+properties name the variant it credits) only concerns that variant's
+cache, and the per-variant serving planes of the experiment router
+(experiment/router.py) filter on it. Subscribers that predate variants —
+one-argument callables — keep working: the bus detects at subscribe time
+whether the callable can take the variant and calls it accordingly.
+
 Deliberately minimal:
 
 - process-local. The cache and the write plane live in the same process
@@ -22,45 +31,69 @@ Deliberately minimal:
 
 from __future__ import annotations
 
+import inspect
 import logging
 import threading
-from typing import Callable, Iterable, List
+from typing import Callable, Iterable, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+
+def _accepts_variant(fn: Callable) -> bool:
+    """True when `fn(entity_ids, variant)` is callable: a second
+    positional slot (or *args) exists. Builtin callables that refuse
+    introspection (list.append) are treated as single-argument."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    positional = 0
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            return True
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            positional += 1
+    return positional >= 2
 
 
 class InvalidationBus:
     __slots__ = ("_subs", "_lock")
 
     def __init__(self):
-        self._subs: List[Callable[[Iterable[str]], None]] = []
+        self._subs: List[Tuple[Callable, bool]] = []
         self._lock = threading.Lock()
 
     @property
     def has_subscribers(self) -> bool:
         return bool(self._subs)
 
-    def subscribe(self, fn: Callable[[Iterable[str]], None]) -> None:
+    def subscribe(self, fn: Callable) -> None:
         with self._lock:
-            if fn not in self._subs:
+            if all(s != fn for s, _ in self._subs):
                 # replace the list instead of mutating it so publish()
                 # iterates a stable snapshot without taking the lock
-                self._subs = self._subs + [fn]
+                self._subs = self._subs + [(fn, _accepts_variant(fn))]
 
-    def unsubscribe(self, fn: Callable[[Iterable[str]], None]) -> None:
+    def unsubscribe(self, fn: Callable) -> None:
         # equality, not identity: bound methods (cache.invalidate_entities,
         # list.append) are fresh objects on every attribute access, and
-        # subscribe's dedup (`fn not in ...`) already compares by equality
+        # subscribe's dedup (`s != fn`) already compares by equality
         with self._lock:
-            self._subs = [s for s in self._subs if s != fn]
+            self._subs = [(s, w) for s, w in self._subs if s != fn]
 
-    def publish(self, entity_ids: Iterable[str]) -> None:
+    def publish(self, entity_ids: Iterable[str],
+                variant: Optional[str] = None) -> None:
         """Fan committed entity ids out to every subscriber. Called by
         the write plane AFTER the commit is durable — a subscriber that
-        invalidates on this signal can never cache ahead of storage."""
-        for fn in self._subs:
+        invalidates on this signal can never cache ahead of storage.
+        `variant=None` means the commit may affect every variant;
+        a named variant scopes the message to that variant's caches."""
+        for fn, wants_variant in self._subs:
             try:
-                fn(entity_ids)
+                if wants_variant:
+                    fn(entity_ids, variant)
+                else:
+                    fn(entity_ids)
             except Exception:
                 log.exception("invalidation subscriber failed")
 

@@ -322,13 +322,33 @@ class GroupCommitWriter:
         """Publish committed events' entity ids on the invalidation bus
         (serving result cache drops those users' entries). Called after
         every durable commit path here, and by the batch route whose
-        insert_batch bypasses this writer. Free when nothing subscribes."""
+        insert_batch bypasses this writer. Free when nothing subscribes.
+
+        `$reward` events publish variant-scoped: the reward credits one
+        engine variant and cannot stale another variant's cached
+        answers, so only that variant's entries drop. Everything else
+        publishes unscoped (any variant's answer may depend on it)."""
         if not BUS.has_subscribers:
             return
-        ids = [e.entity_id for e in events
-               if getattr(e, "entity_id", None)]
+        ids = []
+        by_variant: dict = {}
+        for e in events:
+            eid = getattr(e, "entity_id", None)
+            if not eid:
+                continue
+            if getattr(e, "event", None) == "$reward":
+                try:
+                    variant = e.properties.to_dict().get("variant")
+                except Exception:  # noqa: BLE001 — malformed props: unscoped
+                    variant = None
+                if isinstance(variant, str) and variant:
+                    by_variant.setdefault(variant, []).append(eid)
+                    continue
+            ids.append(eid)
         if ids:
             BUS.publish(ids)
+        for variant, vids in by_variant.items():
+            BUS.publish(vids, variant=variant)
 
     # -- committer side ----------------------------------------------------
     def _take_group(self) -> Optional[List[_PendingWrite]]:

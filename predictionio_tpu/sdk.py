@@ -282,6 +282,41 @@ class EventClient(_BaseClient):
             raise
         return out["eventId"]
 
+    def create_reward(self, user: str, variant: str, reward: float,
+                      event_time: Union[None, str, datetime] = None,
+                      event_id: Optional[str] = None) -> str:
+        """POST a `$reward` event crediting `reward` ∈ [0, 1] to one
+        engine variant (the experiment plane's bandit feedback —
+        docs/experimentation.md). Returns the eventId.
+
+        Rewards ride the full idempotent busy-retry path: unlike a
+        plain append, a `$reward` is keyed by its eventId and carries
+        its own variant/value, so a late replay after a 429/503 cannot
+        land "behind" anything — re-sending is always safe. The id is
+        therefore ALWAYS pinned client-side (caller-supplied or
+        generated here), busy replays are ON, and a duplicate rejection
+        for an id generated in this call maps back to success (our own
+        earlier attempt committed)."""
+        generated = event_id is None
+        eid = event_id or uuid.uuid4().hex
+        body: dict[str, Any] = {
+            "event": "$reward",
+            "entityType": "user",
+            "entityId": user,
+            "eventId": eid,
+            "properties": {"variant": variant, "reward": float(reward)},
+        }
+        if event_time:
+            body["eventTime"] = _format_time(event_time)
+        try:
+            out = self._request("POST", "/events.json", self._auth(), body,
+                                idempotent=True, retry_busy=True)
+        except PredictionIOError as e:
+            if generated and e.status == 400 and "duplicate eventId" in e.message:
+                return eid
+            raise
+        return out["eventId"]
+
     def create_batch_events(self, events: Sequence[dict]) -> list[dict]:
         """POST /batch/events.json (≤50 events) → per-event results.
 

@@ -100,15 +100,20 @@ class ServingPlane:
     `dispatch_fn(queries: list) -> list[results]` — the batched predict
     path (Engine.predict_batch bound to the served state).
     `degraded_fn(query) -> result` — optional cheap fallback used when
-    admission sheds; raise/return None to decline."""
+    admission sheds; raise/return None to decline.
+    `variant` — the engine variant this plane serves; scopes the result
+    cache's keys so answers never leak across variants when several
+    planes live behind one route (experiment/router.py)."""
 
     def __init__(self,
                  dispatch_fn: Callable[[List], List],
                  degraded_fn: Optional[Callable] = None,
                  config: Optional[ServingConfig] = None,
                  name: str = "predictionserver",
-                 result_cache: Optional[ResultCache] = None):
+                 result_cache: Optional[ResultCache] = None,
+                 variant: str = ""):
         self.config = config or ServingConfig()
+        self.variant = variant
 
         # Optional per-user result cache (OFF unless PIO_HTTP_RESULT_CACHE
         # opts in, or one is passed explicitly). Kept read-your-writes by
@@ -120,7 +125,16 @@ class ServingPlane:
         if self.result_cache is not None:
             from predictionio_tpu.ingest.invalidation import BUS
 
-            self._invalidate = self.result_cache.invalidate_entities
+            cache, own_variant = self.result_cache, variant
+
+            def _invalidate(entity_ids, msg_variant=None):
+                # a variant-scoped commit (a $reward credit) can only
+                # stale this plane's entries if it names this variant
+                if msg_variant is None or msg_variant == own_variant:
+                    cache.invalidate_entities(entity_ids,
+                                              variant=msg_variant)
+
+            self._invalidate = _invalidate
             BUS.subscribe(self._invalidate)
 
         # `serving.pre_dispatch` fault site: after admission, before the
@@ -152,7 +166,7 @@ class ServingPlane:
         cache = self.result_cache
         if cache is not None:
             with spans.span("serving.result_cache"):
-                hit = cache.get(query)
+                hit = cache.get(query, self.variant)
             if hit is not MISS:
                 return hit, False
         deadline = deadline_from_headers(headers, self.config.admission)
@@ -175,7 +189,7 @@ class ServingPlane:
         if cache is not None:
             # full-quality results only: a degraded answer must never
             # outlive the saturation that produced it
-            cache.put(query, result)
+            cache.put(query, result, self.variant)
         return result, False
 
     def _try_degraded(self, query):

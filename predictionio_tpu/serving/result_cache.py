@@ -22,6 +22,13 @@ Correctness posture:
 - queries that carry no user key are indexed under "" and still
   invalidated by ANY commit — an anonymous/popularity query can depend
   on any event, so correctness beats retention.
+- keys are **variant-scoped**: the serving plane passes its engine
+  variant into get/put and the variant becomes part of the cache key,
+  so two variants answering the same query can never serve each other's
+  results (the experiment router's A/B correctness bar), and a variant
+  hot swap drops exactly its own entries via `invalidate_variant`.
+  Commit notifications that name a variant (a `$reward` credit) only
+  touch that variant's entries.
 
 Capacity is LRU-bounded (`PIO_HTTP_RESULT_CACHE_SIZE`, default 1024
 entries); hits/misses/invalidations are observable as
@@ -70,22 +77,29 @@ def cache_from_env() -> Optional["ResultCache"]:
 
 
 class ResultCache:
-    """LRU + TTL map of canonical query → result, user-indexed so one
-    commit notification drops exactly that user's entries."""
+    """LRU + TTL map of (variant, canonical query) → result,
+    user-indexed so one commit notification drops exactly that user's
+    entries and variant-indexed so a hot swap drops exactly one
+    variant's entries."""
 
     def __init__(self, max_entries: int = 1024, ttl_s: float = 5.0):
         self.max_entries = max_entries
         self.ttl_s = ttl_s
         self._lock = threading.Lock()
-        # key → (result, expires_at_monotonic, user)
+        # key → (result, expires_at_monotonic, user, variant)
         self._entries: "OrderedDict[str, tuple]" = OrderedDict()
         # user → set of live keys (the invalidation index)
         self._by_user: dict = {}
+        # variant → set of live keys (the hot-swap index)
+        self._by_variant: dict = {}
 
     @staticmethod
-    def _key(query) -> Optional[str]:
+    def _key(query, variant: str) -> Optional[str]:
         try:
-            return fastjson.dumps(query)
+            # \x1f separator: cannot appear in a variant id that came
+            # from engine.json / PIO_EXPERIMENT_VARIANTS, so the key
+            # space of one variant is disjoint from every other's
+            return variant + "\x1f" + fastjson.dumps(query)
         except (TypeError, ValueError):
             return None  # unhashable/unencodable query: never cached
 
@@ -97,9 +111,10 @@ class ResultCache:
                 return str(user)
         return ""
 
-    def get(self, query):
-        """Return the cached result or the MISS sentinel."""
-        key = self._key(query)
+    def get(self, query, variant: str = ""):
+        """Return the cached result for this variant or the MISS
+        sentinel (a hit under another variant's key is a miss here)."""
+        key = self._key(query, variant)
         if key is None:
             _MISSES.inc()
             return MISS
@@ -115,8 +130,8 @@ class ResultCache:
             _HITS.inc()
             return entry[0]
 
-    def put(self, query, result) -> None:
-        key = self._key(query)
+    def put(self, query, result, variant: str = "") -> None:
+        key = self._key(query, variant)
         if key is None:
             return
         user = self._user(query)
@@ -125,8 +140,9 @@ class ResultCache:
             if old is not None:
                 self._drop(key, old)
             self._entries[key] = (result, time.monotonic() + self.ttl_s,
-                                  user)
+                                  user, variant)
             self._by_user.setdefault(user, set()).add(key)
+            self._by_variant.setdefault(variant, set()).add(key)
             while len(self._entries) > self.max_entries:
                 evict_key, evict_entry = next(iter(self._entries.items()))
                 self._drop(evict_key, evict_entry)
@@ -134,27 +150,53 @@ class ResultCache:
     def _drop(self, key: str, entry: tuple) -> None:
         # lock held by caller
         self._entries.pop(key, None)
-        keys = self._by_user.get(entry[2])
-        if keys is not None:
-            keys.discard(key)
-            if not keys:
-                self._by_user.pop(entry[2], None)
+        for index, slot in ((self._by_user, entry[2]),
+                            (self._by_variant, entry[3])):
+            keys = index.get(slot)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    index.pop(slot, None)
 
-    def invalidate_entities(self, entity_ids: Iterable[str]) -> None:
+    def invalidate_entities(self, entity_ids: Iterable[str],
+                            variant: Optional[str] = None) -> None:
         """Ingest-commit hook (InvalidationBus subscriber): drop every
         entry for the committed entities, plus all user-less entries —
-        an anonymous query may depend on any event."""
+        an anonymous query may depend on any event. A variant-scoped
+        message (`variant` not None) only drops that variant's entries;
+        other variants' cached answers were not affected by it."""
         dropped = 0
         with self._lock:
             users = set(str(e) for e in entity_ids)
             users.add("")
             for user in users:
-                keys = self._by_user.pop(user, None)
+                keys = self._by_user.get(user)
                 if not keys:
                     continue
-                for key in keys:
-                    if self._entries.pop(key, None) is not None:
-                        dropped += 1
+                for key in list(keys):
+                    entry = self._entries.get(key)
+                    if entry is None:
+                        keys.discard(key)
+                        continue
+                    if variant is not None and entry[3] != variant:
+                        continue
+                    self._drop(key, entry)
+                    dropped += 1
+        if dropped:
+            _INVALIDATIONS.inc(dropped)
+
+    def invalidate_variant(self, variant: str) -> None:
+        """Drop every entry cached under one variant — the hot-swap
+        hook: a reloaded variant must not serve pre-swap answers for
+        the TTL tail."""
+        dropped = 0
+        with self._lock:
+            keys = self._by_variant.get(variant)
+            for key in list(keys or ()):
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._drop(key, entry)
+                    dropped += 1
         if dropped:
             _INVALIDATIONS.inc(dropped)
 
@@ -162,6 +204,7 @@ class ResultCache:
         with self._lock:
             self._entries.clear()
             self._by_user.clear()
+            self._by_variant.clear()
 
     def __len__(self) -> int:
         with self._lock:

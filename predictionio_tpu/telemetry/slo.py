@@ -110,6 +110,28 @@ class _Tracker:
                 if duration_s > self.objective.latency_threshold_s:
                     b.bad_latency += 1
 
+    def observe_many(self, samples, now: float) -> None:
+        """Batch form of `observe` for deferred-bookkeeping feeders: one
+        lock acquisition and one bucket resolution for the whole batch
+        (all samples land in `now`'s bucket — feeders drain well inside
+        one 10s ring slot). `samples` is an iterable of
+        (status, duration_s)."""
+        bucket_id = int(now) // BUCKET_S
+        b = self.ring[bucket_id % _RING_SLOTS]
+        threshold = self.objective.latency_threshold_s
+        with self.lock:
+            if b.bucket_id != bucket_id:
+                b.bucket_id = bucket_id
+                b.total = b.bad_avail = b.good_total = b.bad_latency = 0
+            for status, duration_s in samples:
+                b.total += 1
+                if status >= 500 or status in _SHED_STATUSES:
+                    b.bad_avail += 1
+                else:
+                    b.good_total += 1
+                    if duration_s > threshold:
+                        b.bad_latency += 1
+
     def window_sums(self, window_s: int, now: float) -> Tuple[int, int, int, int]:
         newest = int(now) // BUCKET_S
         oldest = newest - window_s // BUCKET_S + 1
@@ -151,6 +173,14 @@ def observe(server: str, route: str, status: int, duration_s: float) -> None:
     t = _trackers.get((server, route))
     if t is not None:
         t.observe(status, duration_s, time.time())
+
+
+def observe_many(server: str, route: str, samples) -> None:
+    """Batch feed of (status, duration_s) pairs under one tracker lock;
+    no-op for routes without an objective."""
+    t = _trackers.get((server, route))
+    if t is not None:
+        t.observe_many(samples, time.time())
 
 
 def refresh(now: Optional[float] = None) -> None:

@@ -54,6 +54,14 @@ control endpoint — <code>/status.json</code> on the port announced as
 random sample of the rest) — newest first, full JSON at
 <a href="/debug/requests.json">/debug/requests.json</a>.</p>
 {flight}
+<h2>Experiments</h2>
+<p>Experimentation plane: per-variant routed traffic by outcome, the
+sliding-window traffic share, and each arm's Beta reward posterior
+(mean climbs as <code>$reward</code> events credit it; in bandit mode
+the share follows the posterior). Per-arm error budgets appear above as
+<code>/queries.json@&lt;variant&gt;</code> routes. Raw families:
+<code>experiment_*</code> on <a href="/metrics">/metrics</a>.</p>
+{experiment}
 <h2>HTTP hot path</h2>
 <p>Event-loop transport health: parked keep-alive connections, requests
 amortized per connection, and the encode-side caches (encoder envelope
@@ -251,6 +259,34 @@ def _hotpath_table(registry=REGISTRY) -> str:
     return "".join(out)
 
 
+def _experiment_table(registry=REGISTRY) -> str:
+    rows = []
+    for name in ("experiment_requests_total", "experiment_traffic_share",
+                 "experiment_posterior_mean", "experiment_rewards_total"):
+        m = registry.get(name)
+        if m is None:
+            continue
+        for key, value in sorted(m.collect()):
+            if name == "experiment_traffic_share":
+                shown = f"{value:.1%}"
+            elif name == "experiment_posterior_mean":
+                shown = f"{value:.4f}"
+            else:
+                shown = f"{value:g}"
+            rows.append((name, _label_str(m.labelnames, key), shown))
+    if not rows:
+        return ("<p>No experiment routed in this process (set "
+                "<code>PIO_EXPERIMENT_VARIANTS</code> on the prediction "
+                "server — see <code>docs/experimentation.md</code>).</p>")
+    out = ["<table><tr><th>Metric</th><th>Labels</th><th>Value</th></tr>"]
+    for name, labels, value in rows:
+        out.append(f"<tr><td>{html.escape(name)}</td>"
+                   f"<td>{html.escape(labels)}</td>"
+                   f"<td>{html.escape(value)}</td></tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -301,6 +337,7 @@ class Dashboard(HttpService):
                     slo=_slo_table(),
                     supervisor=_supervisor_table(),
                     flight=_flight_table(),
+                    experiment=_experiment_table(),
                     hotpath=_hotpath_table(),
                     telemetry=_telemetry_table(),
                 ))

@@ -105,7 +105,7 @@ class CoreWorkflow:
                    f"rank {p_rank} persists)",
                 status="WORKER_DONE", start_time=_now(), end_time=_now(),
                 engine_id=variant.id, engine_version=engine_version,
-                engine_variant=variant.id,
+                engine_variant=variant.variant,
                 engine_factory=variant.engine_factory, batch=ctx.batch,
                 env={}, **engine_params_to_json(engine_params),
             )
@@ -118,7 +118,7 @@ class CoreWorkflow:
             end_time=_now(),
             engine_id=variant.id,
             engine_version=engine_version,
-            engine_variant=variant.id,
+            engine_variant=variant.variant,
             engine_factory=variant.engine_factory,
             batch=ctx.batch,
             env={},

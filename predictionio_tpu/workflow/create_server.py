@@ -24,6 +24,11 @@ import os
 import threading
 from typing import Any, Optional
 
+from predictionio_tpu.experiment import (
+    ExperimentConfig,
+    RewardTailer,
+    VariantRouter,
+)
 from predictionio_tpu.plugins import PluginRejection
 from predictionio_tpu.serving import (
     DeadlineExceeded,
@@ -104,6 +109,7 @@ def variant_from_instance(instance: EngineInstance) -> EngineVariant:
     SURVEY.md §3.2)."""
     return EngineVariant.from_dict({
         "id": instance.engine_id,
+        "variant": instance.engine_variant,
         "engineFactory": instance.engine_factory,
         "datasource": _row_block(instance.data_source_params),
         "preparator": _row_block(instance.preparator_params),
@@ -149,7 +155,8 @@ class PredictionServer(HttpService):
     def __init__(self, config: ServerConfig, storage: Optional[Storage] = None,
                  plugins=None, reuse_port: bool = False,
                  supervisor_pid: Optional[int] = None,
-                 serving_config: Optional[ServingConfig] = None):
+                 serving_config: Optional[ServingConfig] = None,
+                 experiment: Optional[ExperimentConfig] = None):
         from predictionio_tpu.plugins import load_plugins_from_env
 
         self.config = config
@@ -157,33 +164,67 @@ class PredictionServer(HttpService):
         self.plugins = (plugins if plugins is not None
                         else load_plugins_from_env())
         self.supervisor_pid = supervisor_pid
-        self._state = load_served_state(self.storage, config)
         self._state_lock = threading.Lock()
+
+        # Experiment posture rides PIO_EXPERIMENT_* (like PIO_SERVING_*)
+        # so every pre-fork pool worker resolves the same variant set.
+        self.experiment = (experiment if experiment is not None
+                           else ExperimentConfig.from_env())
+        self._variants = (tuple(self.experiment.variants)
+                          if self.experiment is not None
+                          else (config.engine_variant,))
+        self._primary_variant = self._variants[0]
+        self._variant_header_cache = {v: {"X-PIO-Variant": v}
+                                      for v in self._variants}
+        self._states = {v: load_served_state(self.storage,
+                                             self._config_for(v))
+                        for v in self._variants}
         worker_pid = os.getpid()
         server = self
 
-        # The serving plane (admission + micro-batching) outlives reloads:
-        # its dispatch reads server._state at dispatch time, so a batch
-        # coalesced across a /reload simply scores on whichever state is
-        # current — same snapshot semantics the single-query path had.
-        def _dispatch(queries):
-            state = server._state
-            with spans.span("predictionserver.predict"), \
-                    PREDICT_SECONDS.time():
-                return state.engine.predict_batch(
-                    state.engine_params, state.models, queries,
+        # The serving planes (admission + micro-batching) outlive
+        # reloads: each variant's dispatch reads server._states at
+        # dispatch time, so a batch coalesced across a /reload simply
+        # scores on whichever state is current — same snapshot semantics
+        # the single-query path had.
+        def _make_dispatch(v):
+            def _dispatch(queries):
+                state = server._states[v]
+                with spans.span("predictionserver.predict"), \
+                        PREDICT_SECONDS.time():
+                    return state.engine.predict_batch(
+                        state.engine_params, state.models, queries,
+                        components=state.components)
+            return _dispatch
+
+        def _make_degraded(v):
+            def _degraded(query):
+                state = server._states[v]
+                return state.engine.degraded_predict(
+                    state.engine_params, state.models, query,
                     components=state.components)
+            return _degraded
 
-        def _degraded(query):
-            state = server._state
-            return state.engine.degraded_predict(
-                state.engine_params, state.models, query,
-                components=state.components)
-
-        self.serving = ServingPlane(
-            _dispatch, degraded_fn=_degraded,
-            config=serving_config or ServingConfig.from_env(),
-            name="predictionserver")
+        serving_cfg = serving_config or ServingConfig.from_env()
+        self._planes = {
+            v: ServingPlane(
+                _make_dispatch(v), degraded_fn=_make_degraded(v),
+                config=serving_cfg, name="predictionserver", variant=v)
+            for v in self._variants
+        }
+        self._tailer: Optional[RewardTailer] = None
+        if self.experiment is not None:
+            # one router in the ServingPlane-shaped slot: same
+            # handle_query contract, per-variant planes behind it
+            self.serving = VariantRouter(self._planes, self.experiment)
+            if self.serving.bandit is not None:
+                self._tailer = RewardTailer(
+                    self.storage, self.serving.bandit,
+                    app_id=self.experiment.app_id,
+                    interval_s=self.experiment.tail_interval_s)
+                self._tailer.start()
+        else:
+            self.serving = self._planes[self._primary_variant]
         self._worker_pid = worker_pid
 
         # Route dispatch table, registered once at construction. The
@@ -200,10 +241,23 @@ class PredictionServer(HttpService):
                              reuse_port=reuse_port,
                              server_name="predictionserver")
 
+    def _config_for(self, variant: str) -> ServerConfig:
+        return ServerConfig(
+            ip=self.config.ip, port=self.config.port,
+            engine_id=self.config.engine_id,
+            engine_version=self.config.engine_version,
+            engine_variant=variant)
+
+    @property
+    def _state(self) -> _ServedState:
+        """Primary variant's served state (the only one outside
+        experiment mode)."""
+        return self._states[self._primary_variant]
+
     # -- route handlers ------------------------------------------------------
     def _handle_status(self, req: Request) -> Response:
         state = self._state
-        return Response.json(200, {
+        payload = {
             "status": "alive",
             "engineId": self.config.engine_id,
             "engineVersion": self.config.engine_version,
@@ -214,7 +268,28 @@ class PredictionServer(HttpService):
             # which pool worker answered — the observable receipt that
             # SO_REUSEPORT is really balancing
             "workerPid": self._worker_pid,
-        })
+        }
+        if self.experiment is not None:
+            payload["experiment"] = dict(
+                self.serving.snapshot(),
+                instances={v: s.instance.id
+                           for v, s in self._states.items()})
+        return Response.json(200, payload)
+
+    def _variant_headers(self, extra: Optional[dict] = None) -> Optional[dict]:
+        """X-PIO-Variant on every experiment-mode response (200 and
+        shed/deadline alike) — the client-observable assignment, and
+        what the sticky-determinism drills read back. The no-extra case
+        (every plain 200) reuses one shared dict per variant."""
+        if self.experiment is not None:
+            chosen = self.serving.last_variant
+            if chosen:
+                if not extra:
+                    return self._variant_header_cache.get(chosen)
+                headers = dict(extra)
+                headers["X-PIO-Variant"] = chosen
+                return headers
+        return extra or None
 
     def _handle_query(self, req: Request) -> Response:
         retry_after = self.serving.config.admission.retry_after_s
@@ -222,20 +297,24 @@ class PredictionServer(HttpService):
             query = fastjson.loads(req.body or b"{}")
             result, degraded = self.serving.handle_query(
                 query, req.headers)
+            state = self._state
+            if self.experiment is not None:
+                # credit the prediction to the instance that produced it
+                state = self._states.get(self.serving.last_variant, state)
             result = self.plugins.on_prediction(
-                query, result, self._state.instance.id)
+                query, result, state.instance.id)
         except ShedLoad as e:
             # saturated and no degraded answer: an explicit, immediate
             # 429 beats queueing into collapse
             QUERIES_FAILED.inc()
             return Response.message(
-                429, str(e),
-                headers={"Retry-After": f"{e.retry_after_s:g}"})
+                429, str(e), headers=self._variant_headers(
+                    {"Retry-After": f"{e.retry_after_s:g}"}))
         except DeadlineExceeded as e:
             QUERIES_FAILED.inc()
             return Response.message(
-                503, str(e),
-                headers={"Retry-After": f"{retry_after:g}"})
+                503, str(e), headers=self._variant_headers(
+                    {"Retry-After": f"{retry_after:g}"}))
         except PluginRejection as e:
             QUERIES_FAILED.inc()
             return Response.message(403, str(e))
@@ -249,9 +328,16 @@ class PredictionServer(HttpService):
             QUERIES_FAILED.inc()
             log.warning("Query failed: %s", e)
             return Response.message(400, str(e))
+        if degraded:
+            headers = self._variant_headers({"X-PIO-Degraded": "1"})
+        elif self.experiment is not None:
+            headers = self._variant_header_cache.get(
+                self.serving.last_variant)
+        else:
+            headers = None
         return Response(
             200, payload=result, encoder=fastjson.prediction_response,
-            headers={"X-PIO-Degraded": "1"} if degraded else None)
+            headers=headers)
 
     def _handle_reload(self, req: Request) -> Response:
         if self.supervisor_pid is not None:
@@ -287,18 +373,39 @@ class PredictionServer(HttpService):
         return resp
 
     def reload(self) -> None:
-        """Swap to the newest COMPLETED instance (idempotent, atomic).
-        Called from the /reload handler and, in pool mode, from the
-        worker's SIGHUP handler."""
+        """Swap every variant to its newest COMPLETED instance
+        (idempotent, atomic per variant). Called from the /reload
+        handler and, in pool mode, from the worker's SIGHUP handler.
+        A variant whose reload fails keeps serving its current state —
+        a half-trained challenger must not take down the champion."""
+        errors = []
         with self._state_lock:
-            self._state = load_served_state(self.storage, self.config)
-        log.info("Reloaded engine instance %s", self._state.instance.id)
+            for v in self._variants:
+                try:
+                    self._states[v] = load_served_state(
+                        self.storage, self._config_for(v))
+                except Exception as e:  # noqa: BLE001
+                    log.exception("Reload failed for variant %s; keeping "
+                                  "its current instance", v)
+                    errors.append(e)
+                    continue
+                plane = self._planes.get(v)
+                if plane is not None and plane.result_cache is not None:
+                    # answers cached against the outgoing instance are
+                    # stale the moment the swap lands
+                    plane.result_cache.invalidate_variant(v)
+                log.info("Reloaded engine instance %s (variant %s)",
+                         self._states[v].instance.id, v)
+        if errors and len(errors) == len(self._variants):
+            raise errors[0]
 
     def shutdown(self) -> None:
         """Graceful drain: the HTTP server stops accepting and finishes
         in-flight handlers first (their queued queries still dispatch),
         then the batcher's dispatcher thread is joined."""
         super().shutdown()
+        if self._tailer is not None:
+            self._tailer.stop()
         self.serving.close()
 
     def health_check(self) -> bool:
@@ -306,7 +413,7 @@ class PredictionServer(HttpService):
         the SO_REUSEPORT group only if it is actually able to serve —
         a served state is loaded and the `/metrics` exposition renders
         (the supervisor runbook's probe)."""
-        if self._state is None:
+        if not self._states:
             return False
         from predictionio_tpu.telemetry import slo as _slo
 

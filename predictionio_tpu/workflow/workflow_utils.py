@@ -45,6 +45,11 @@ class EngineVariant:
     algorithms: list[dict[str, Any]]
     serving: dict[str, Any]
     raw: dict[str, Any]
+    # Deployed-variant name, defaulting to `id`. A separate "variant"
+    # key lets several trainings of ONE engine coexist as distinct
+    # servable arms (engine_id stays shared, engine_variant differs) —
+    # what the experiment plane deploys side by side.
+    variant: str = "default"
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "EngineVariant":
@@ -52,6 +57,7 @@ class EngineVariant:
             raise ValueError("engine.json is missing required key 'engineFactory'")
         return cls(
             id=d.get("id", "default"),
+            variant=d.get("variant", d.get("id", "default")),
             description=d.get("description", ""),
             engine_factory=d["engineFactory"],
             datasource=d.get("datasource") or {},

@@ -50,6 +50,15 @@ def main():
                         "ingest write fails to invalidate the per-user "
                         "serving result cache before the ack "
                         "(read-your-writes drill)")
+    p.add_argument("--experiment-gate", action="store_true",
+                   help="run the experimentation-plane CI gate (no jax, no "
+                        "data): fails unless the sticky user→variant "
+                        "mapping is identical across interpreters with "
+                        "different PYTHONHASHSEEDs, the result cache "
+                        "isolates variants, the Thompson bandit fed "
+                        "$reward events through the real ingest funnel "
+                        "converges ≥80% of traffic onto the better arm, "
+                        "and the experiment_* telemetry renders")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -88,6 +97,11 @@ def main():
 
     if args.hotpath_gate:
         from predictionio_tpu.utils.hotpath_gate import run_gate
+
+        return run_gate()
+
+    if args.experiment_gate:
+        from predictionio_tpu.experiment.gate import run_gate
 
         return run_gate()
 

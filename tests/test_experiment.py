@@ -1,0 +1,502 @@
+"""Experimentation plane (round 8): sticky splits, the Thompson bandit,
+$reward through the ingest funnel, variant-scoped result caching, and
+two live arms behind one /queries.json.
+
+The unit half pins the routing math (deterministic digest, posterior
+updates, config resolution) and the funnel contract ($reward validation,
+SDK verb, variant-scoped invalidation). The e2e half deploys a real
+two-variant PredictionServer in-process and asserts the contracts the
+drills in experiment/gate.py enforce operationally: sticky receipts over
+HTTP, both arms reachable, bandit routing fed by tailed rewards, and a
+mid-traffic hot swap answering nothing but 200s."""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+from predictionio_tpu.experiment import ExperimentConfig, RewardTailer
+from predictionio_tpu.experiment.bandit import (
+    ThompsonBandit,
+    sticky_buckets,
+    sticky_variant,
+)
+from predictionio_tpu.ingest.invalidation import BUS, InvalidationBus
+from predictionio_tpu.serving.result_cache import MISS, ResultCache
+from predictionio_tpu.workflow.create_server import (
+    PredictionServer,
+    ServerConfig,
+)
+from predictionio_tpu.workflow.workflow_utils import (
+    EngineVariant,
+    extract_engine_params,
+    get_engine,
+)
+from tests.test_recommendation_template import ingest_ratings, variant_dict
+
+USERS = [f"u{i}" for i in range(200)]
+
+
+def train_variant(storage, variant_name=None, iters=10, seed=1):
+    """Train one servable arm of the rec-test engine. `variant_name`
+    None trains the default arm; a name trains a second arm under the
+    SAME engine id (the experiment deployment shape)."""
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+
+    d = variant_dict(iters=iters)
+    d["algorithms"][0]["params"]["seed"] = seed
+    if variant_name is not None:
+        d["variant"] = variant_name
+    variant = EngineVariant.from_dict(d)
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    return CoreWorkflow.run_train(engine, ep, variant,
+                                  WorkflowContext(storage=storage, seed=1))
+
+
+class TestStickyAssignment:
+    def test_deterministic_and_order_independent(self):
+        first = {u: sticky_variant(u, ["champ", "challenger"])
+                 for u in USERS}
+        again = {u: sticky_variant(u, ["challenger", "champ"])
+                 for u in USERS}
+        assert first == again  # declaration order must not matter
+        assert set(first.values()) == {"champ", "challenger"}
+
+    def test_weights_shift_the_split(self):
+        heavy = [sticky_variant(u, ["a", "b"], [0.9, 0.1]) for u in USERS]
+        share_a = heavy.count("a") / len(heavy)
+        assert share_a > 0.75, f"0.9 weight got share {share_a}"
+        # all-to-one pinning (the bench's router-isolation trick)
+        assert {sticky_variant(u, ["a", "b"], [1, 0]) for u in USERS} == {"a"}
+
+    def test_weight_validation(self):
+        with pytest.raises(ValueError, match="weights"):
+            sticky_buckets(["a", "b"], [1.0])
+        with pytest.raises(ValueError, match="positive"):
+            sticky_buckets(["a", "b"], [0.0, 0.0])
+        with pytest.raises(ValueError, match="at least one"):
+            sticky_buckets([])
+
+    def test_mapping_identical_across_interpreters(self):
+        """The property builtin hash() would break: a restarted worker
+        (fresh PYTHONHASHSEED) must assign every user the same arm."""
+        prog = ("import json, sys; "
+                "from predictionio_tpu.experiment.bandit import "
+                "sticky_variant; "
+                "print(json.dumps({u: sticky_variant(u, ['champ', "
+                "'challenger'], [0.7, 0.3]) for u in "
+                "[f'u{i}' for i in range(64)]}))")
+        outs = []
+        for hashseed in ("0", "31337"):
+            p = subprocess.run(
+                [sys.executable, "-c", prog], text=True, capture_output=True,
+                env={"PYTHONHASHSEED": hashseed, "JAX_PLATFORMS": "cpu",
+                     "PATH": "/usr/bin:/bin",
+                     "PYTHONPATH": ":".join(sys.path)},
+                timeout=120)
+            assert p.returncode == 0, p.stderr
+            outs.append(json.loads(p.stdout))
+        assert outs[0] == outs[1]
+        # and both match this process's mapping
+        assert outs[0] == {u: sticky_variant(u, ["champ", "challenger"],
+                                             [0.7, 0.3])
+                           for u in [f"u{i}" for i in range(64)]}
+
+
+class TestThompsonBandit:
+    def test_posterior_updates(self):
+        b = ThompsonBandit(["a", "b"])
+        assert b.posterior_mean("a") == 0.5  # Beta(1, 1) prior
+        assert b.reward("a", 1.0)
+        assert b.reward("a", 0.25)  # fractional update: α += r, β += 1−r
+        snap = b.snapshot()["a"]
+        assert snap["alpha"] == pytest.approx(2.25)
+        assert snap["beta"] == pytest.approx(1.75)
+        assert snap["rewards"] == 2
+        assert b.reward("a", 7.0)  # clamped to [0, 1]
+        assert b.snapshot()["a"]["alpha"] == pytest.approx(3.25)
+
+    def test_unknown_variant_is_a_noop(self):
+        b = ThompsonBandit(["a"])
+        assert not b.reward("retired-arm", 1.0)
+        assert b.posterior_mean("a") == 0.5
+
+    def test_converges_to_better_arm(self):
+        b = ThompsonBandit(["good", "bad"], seed=99)
+        import random
+        rng = random.Random(7)
+        window = []
+        for _ in range(600):
+            v = b.choose()
+            window.append(v)
+            p = 0.9 if v == "good" else 0.1
+            b.reward(v, 1.0 if rng.random() < p else 0.0)
+        share = window[-200:].count("good") / 200
+        assert share >= 0.8, f"bandit split only {share} to the better arm"
+
+
+class TestExperimentConfig:
+    def test_off_when_unset_or_single(self, monkeypatch):
+        monkeypatch.delenv("PIO_EXPERIMENT_VARIANTS", raising=False)
+        assert ExperimentConfig.from_env() is None
+        monkeypatch.setenv("PIO_EXPERIMENT_VARIANTS", "only-one")
+        assert ExperimentConfig.from_env() is None
+
+    def test_env_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("PIO_EXPERIMENT_VARIANTS", "champ, challenger")
+        monkeypatch.setenv("PIO_EXPERIMENT_MODE", "bandit")
+        monkeypatch.setenv("PIO_EXPERIMENT_SEED", "42")
+        monkeypatch.setenv("PIO_EXPERIMENT_APP_ID", "3")
+        cfg = ExperimentConfig.from_env()
+        assert cfg.variants == ("champ", "challenger")
+        assert cfg.mode == "bandit" and cfg.seed == 42 and cfg.app_id == 3
+
+    def test_bad_configs_rejected(self, monkeypatch):
+        with pytest.raises(ValueError, match="mode"):
+            ExperimentConfig(variants=("a", "b"), mode="roulette")
+        with pytest.raises(ValueError, match="duplicate"):
+            ExperimentConfig(variants=("a", "a"))
+        monkeypatch.setenv("PIO_EXPERIMENT_VARIANTS", "a,b")
+        monkeypatch.setenv("PIO_EXPERIMENT_WEIGHTS", "1.0")
+        with pytest.raises(ValueError, match="WEIGHTS"):
+            ExperimentConfig.from_env()
+
+
+class TestRewardValidation:
+    def mk(self, props):
+        return Event(event="$reward", entity_type="user", entity_id="u1",
+                     properties=DataMap(props))
+
+    def test_well_formed_ok(self):
+        validate_event(self.mk({"variant": "champ", "reward": 0.5}))
+        validate_event(self.mk({"variant": "champ", "reward": 1}))
+
+    def test_missing_or_bad_fields_rejected(self):
+        for props in ({"reward": 0.5},                 # no variant
+                      {"variant": "", "reward": 0.5},  # empty variant
+                      {"variant": "c"},                # no reward
+                      {"variant": "c", "reward": "hi"},
+                      {"variant": "c", "reward": True},
+                      {"variant": "c", "reward": 1.5},
+                      {"variant": "c", "reward": -0.1}):
+            with pytest.raises(EventValidationError):
+                validate_event(self.mk(props))
+
+
+@pytest.fixture()
+def event_client(memory_storage):
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.sdk import EventClient
+    from predictionio_tpu.storage.base import AccessKey, App
+
+    app_id = memory_storage.meta_apps().insert(App(id=0, name="ExpApp"))
+    key = AccessKey.generate(app_id)
+    memory_storage.meta_access_keys().insert(key)
+    srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                      memory_storage)
+    srv.start()
+    yield EventClient(access_key=key.key,
+                      url=f"http://127.0.0.1:{srv.port}"), memory_storage, app_id
+    srv.shutdown()
+
+
+class TestRewardFunnel:
+    def test_sdk_create_reward_roundtrip(self, event_client):
+        client, storage, app_id = event_client
+        eid = client.create_reward("u7", "challenger", 0.75)
+        got = client.get_event(eid)
+        assert got["event"] == "$reward" and got["entityId"] == "u7"
+        assert got["properties"] == {"variant": "challenger", "reward": 0.75}
+        # a caller-pinned id is the idempotency key: replaying it is a
+        # DETECTED duplicate (the first send committed), not a new row
+        from predictionio_tpu.sdk import PredictionIOError
+        with pytest.raises(PredictionIOError, match="duplicate eventId"):
+            client.create_reward("u7", "challenger", 0.75, event_id=eid)
+        assert len(client.find_events(event="$reward")) == 1
+
+    def test_sdk_create_reward_validates_server_side(self, event_client):
+        from predictionio_tpu.sdk import PredictionIOError
+
+        client, _, _ = event_client
+        with pytest.raises(PredictionIOError, match="reward"):
+            client.create_reward("u7", "challenger", 1.5)
+
+    def test_reward_publishes_variant_scoped_invalidation(self, event_client):
+        """$reward credits ONE arm, so its commit notification must be
+        variant-scoped (other arms' cached answers were untouched);
+        a plain data event stays unscoped (any arm may depend on it)."""
+        client, _, _ = event_client
+        calls = []
+
+        def recorder(entity_ids, variant=None):
+            calls.append((sorted(entity_ids), variant))
+
+        BUS.subscribe(recorder)
+        try:
+            client.create_reward("u9", "challenger", 1.0)
+            client.create_event(event="rate", entity_type="user",
+                                entity_id="u9", target_entity_type="item",
+                                target_entity_id="i1",
+                                properties={"rating": 4})
+        finally:
+            BUS.unsubscribe(recorder)
+        assert (["u9"], "challenger") in calls
+        assert (["u9"], None) in calls
+
+    def test_bus_serves_variant_blind_subscribers(self):
+        """Pre-variant one-argument subscribers keep working: the bus
+        detects the arity at subscribe time."""
+        bus = InvalidationBus()
+        old_style, new_style = [], []
+        bus.subscribe(lambda ids: old_style.append(list(ids)))
+        bus.subscribe(lambda ids, variant: new_style.append(
+            (list(ids), variant)))
+        bus.publish(["e1"], variant="champ")
+        bus.publish(["e2"])
+        assert old_style == [["e1"], ["e2"]]
+        assert new_style == [(["e1"], "champ"), (["e2"], None)]
+
+
+class TestResultCacheVariantIsolation:
+    def test_variants_never_share_entries(self):
+        cache = ResultCache(max_entries=16, ttl_s=60)
+        q = {"user": "u1", "num": 3}
+        cache.put(q, {"from": "a"}, variant="a")
+        assert cache.get(q, variant="a") == {"from": "a"}
+        assert cache.get(q, variant="b") is MISS
+        cache.put(q, {"from": "b"}, variant="b")
+        assert cache.get(q, variant="a") == {"from": "a"}  # b's put, a's key
+
+    def test_invalidate_variant_drops_exactly_one_arm(self):
+        cache = ResultCache(max_entries=16, ttl_s=60)
+        q = {"user": "u1", "num": 3}
+        cache.put(q, "A", variant="a")
+        cache.put(q, "B", variant="b")
+        cache.invalidate_variant("a")
+        assert cache.get(q, variant="a") is MISS
+        assert cache.get(q, variant="b") == "B"
+
+    def test_variant_scoped_entity_invalidation(self):
+        """The bus-message shape: a $reward for variant b must not cost
+        variant a its cached answer for the same user."""
+        cache = ResultCache(max_entries=16, ttl_s=60)
+        q = {"user": "u1", "num": 3}
+        cache.put(q, "A", variant="a")
+        cache.put(q, "B", variant="b")
+        cache.invalidate_entities(["u1"], variant="b")
+        assert cache.get(q, variant="a") == "A"
+        assert cache.get(q, variant="b") is MISS
+        cache.invalidate_entities(["u1"])  # unscoped drops the rest
+        assert cache.get(q, variant="a") is MISS
+
+
+class TestRewardTailer:
+    def _insert_reward(self, storage, app_id, user, variant, reward, t):
+        storage.l_events().insert(
+            Event(event="$reward", entity_type="user", entity_id=user,
+                  properties=DataMap({"variant": variant, "reward": reward}),
+                  event_time=t),
+            app_id)
+
+    def test_tail_applies_once_and_survives_junk(self, memory_storage):
+        from predictionio_tpu.storage.base import App
+
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="TailApp"))
+        bandit = ThompsonBandit(["a", "b"])
+        tailer = RewardTailer(memory_storage, bandit, app_id=app_id)
+        t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        self._insert_reward(memory_storage, app_id, "u1", "a", 1.0, t0)
+        self._insert_reward(memory_storage, app_id, "u2", "b", 0.0,
+                            t0 + timedelta(seconds=1))
+        # a hand-inserted malformed row must not wedge the loop
+        self._insert_reward(memory_storage, app_id, "u3", "a", "junk",
+                            t0 + timedelta(seconds=2))
+        # a reward for an arm this deployment doesn't route is skipped
+        self._insert_reward(memory_storage, app_id, "u4", "retired", 1.0,
+                            t0 + timedelta(seconds=3))
+        assert tailer.poll_once() == 2
+        assert bandit.snapshot()["a"]["alpha"] == pytest.approx(2.0)
+        assert bandit.snapshot()["b"]["beta"] == pytest.approx(2.0)
+        # overlap re-reads must not double-apply
+        assert tailer.poll_once() == 0
+        assert bandit.snapshot()["a"]["alpha"] == pytest.approx(2.0)
+        # only rows past the watermark apply on the next pass
+        self._insert_reward(memory_storage, app_id, "u5", "b", 1.0,
+                            t0 + timedelta(seconds=4))
+        assert tailer.poll_once() == 1
+        assert bandit.reward_count("b") == 2
+
+
+def call(port, method, path, body=None):
+    """HTTP helper that also returns headers (the variant receipt)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), dict(e.headers)
+
+
+def start_two_variant_server(storage, mode="sticky", seed=None, app_id=1):
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id="rec-test",
+                          engine_variant="rec-test")
+    exp = ExperimentConfig(variants=("rec-test", "rec-test-b"), mode=mode,
+                           seed=seed, app_id=app_id,
+                           tail_interval_s=0.1)
+    server = PredictionServer(config, storage, experiment=exp)
+    server.start()
+    return server
+
+
+@pytest.fixture()
+def two_variants(memory_storage):
+    ingest_ratings(memory_storage)
+    train_variant(memory_storage)                       # the champion
+    train_variant(memory_storage, "rec-test-b", seed=2)  # the challenger
+    return memory_storage
+
+
+@pytest.mark.e2e
+class TestTwoVariantServing:
+    def test_sticky_receipts_cover_both_arms_and_stick(self, two_variants):
+        server = start_two_variant_server(two_variants)
+        try:
+            seen = {}
+            for u in range(64):
+                for _ in range(2):  # the repeat must not move
+                    status, body, headers = call(
+                        server.port, "POST", "/queries.json",
+                        {"user": f"u{u}", "num": 2})
+                    assert status == 200 and "itemScores" in body
+                    v = headers.get("X-PIO-Variant")
+                    assert v in ("rec-test", "rec-test-b")
+                    assert seen.setdefault(u, v) == v, f"user u{u} moved"
+            assert set(seen.values()) == {"rec-test", "rec-test-b"}
+            # ... and the mapping is the routing math, observed over HTTP
+            for u, v in seen.items():
+                assert sticky_variant(
+                    f"u{u}", ["rec-test", "rec-test-b"]) == v
+            # restartability: a FRESH server over the same store agrees
+            server.shutdown()
+            server = start_two_variant_server(two_variants)
+            for u in (0, 7, 31, 63):
+                _, _, headers = call(server.port, "POST", "/queries.json",
+                                     {"user": f"u{u}", "num": 2})
+                assert headers.get("X-PIO-Variant") == seen[u]
+        finally:
+            server.shutdown()
+
+    def test_status_page_reports_experiment(self, two_variants):
+        server = start_two_variant_server(two_variants)
+        try:
+            call(server.port, "POST", "/queries.json", {"user": "u0", "num": 2})
+            status, body, _ = call(server.port, "GET", "/")
+            assert status == 200
+            exp = body["experiment"]
+            assert exp["mode"] == "sticky"
+            assert set(exp["instances"]) == {"rec-test", "rec-test-b"}
+            assert exp["instances"]["rec-test"] != exp["instances"]["rec-test-b"]
+        finally:
+            server.shutdown()
+
+    def test_bandit_routes_by_tailed_rewards(self, two_variants):
+        t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        server = start_two_variant_server(two_variants, mode="bandit",
+                                          seed=1234)
+        try:
+            # durable rewards through the store: the challenger wins big
+            le = two_variants.l_events()
+            for i in range(40):
+                le.insert(Event(event="$reward", entity_type="user",
+                                entity_id=f"u{i}",
+                                properties=DataMap({"variant": "rec-test-b",
+                                                    "reward": 1.0}),
+                                event_time=t0 + timedelta(seconds=i)), 1)
+                le.insert(Event(event="$reward", entity_type="user",
+                                entity_id=f"u{i}",
+                                properties=DataMap({"variant": "rec-test",
+                                                    "reward": 0.0}),
+                                event_time=t0 + timedelta(seconds=i)), 1)
+            assert server._tailer is not None
+            server._tailer.poll_once()  # deterministic, no sleep-wait
+            assert server.serving.bandit.posterior_mean("rec-test-b") > 0.9
+            hits = []
+            for i in range(100):
+                _, _, headers = call(server.port, "POST", "/queries.json",
+                                     {"user": f"u{i % 12}", "num": 2})
+                hits.append(headers.get("X-PIO-Variant"))
+            share = hits.count("rec-test-b") / len(hits)
+            assert share >= 0.8, f"bandit sent only {share} to the winner"
+        finally:
+            server.shutdown()
+
+    def test_hot_swap_mid_traffic_answers_only_200(self, two_variants):
+        """The acceptance drill: retrain the challenger, /reload while 6
+        clients hammer /queries.json — zero non-200, and the challenger
+        ends up serving the NEW instance while the champion's stays."""
+        server = start_two_variant_server(two_variants)
+        try:
+            _, before, _ = call(server.port, "GET", "/")
+            old = before["experiment"]["instances"]
+            new_b = train_variant(two_variants, "rec-test-b", iters=12,
+                                  seed=3)
+            stop = threading.Event()
+            results = [{"n": 0, "bad": []} for _ in range(6)]
+
+            def client(rec, i):
+                while not stop.is_set():
+                    status, _, headers = call(
+                        server.port, "POST", "/queries.json",
+                        {"user": f"u{i}", "num": 2})
+                    if status != 200:
+                        rec["bad"].append(status)
+                    rec["n"] += 1
+
+            threads = [threading.Thread(target=client, args=(rec, i))
+                       for i, rec in enumerate(results)]
+            for t in threads:
+                t.start()
+            try:
+                status, _, _ = call(server.port, "POST", "/reload")
+                assert status == 200
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+            assert not any(r["bad"] for r in results), results
+            assert all(r["n"] > 0 for r in results)
+            _, after, _ = call(server.port, "GET", "/")
+            now = after["experiment"]["instances"]
+            assert now["rec-test-b"] == new_b.id != old["rec-test-b"]
+        finally:
+            server.shutdown()
+
+    def test_traffic_share_and_snapshot(self, two_variants):
+        server = start_two_variant_server(two_variants)
+        try:
+            for u in range(32):
+                call(server.port, "POST", "/queries.json",
+                     {"user": f"u{u}", "num": 2})
+            shares = server.serving.traffic_share()
+            assert set(shares) == {"rec-test", "rec-test-b"}
+            assert sum(shares.values()) == pytest.approx(1.0)
+            assert all(s > 0 for s in shares.values())
+        finally:
+            server.shutdown()

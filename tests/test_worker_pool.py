@@ -317,6 +317,82 @@ class TestWorkerPool:
         finally:
             _teardown(proc)
 
+    def test_sticky_mapping_survives_resize_and_rolling_reload(self, tmp_path):
+        """Experiment plane × pool (round 8): the user→variant sticky
+        mapping must be a pure function of (id bytes, variant set) —
+        identical from every worker, across pool SIZES (1 → 4 → 2: the
+        kernel hashes fresh connections onto different workers each
+        time, so one pass already compares workers), across pool
+        RESTARTS (each deploy is a new supervisor + fresh
+        PYTHONHASHSEED), and through a mid-experiment rolling /reload."""
+        from tests.test_distributed_multihost import _train_env
+        from tests.test_experiment import train_variant
+        from tests.test_recommendation_template import ingest_ratings
+
+        db = tmp_path / "exp.db"
+        storage = _sqlite_storage(db)
+        try:
+            ingest_ratings(storage)
+            train_variant(storage)                       # champion arm
+            train_variant(storage, "rec-test-b", seed=2)  # challenger arm
+        finally:
+            storage.close()
+        env = _train_env(db, tmp_path, 2, PIO_LOG_LEVEL="INFO",
+                         PIO_SUPERVISOR_DRAIN_DEADLINE_S="1",
+                         PIO_EXPERIMENT_VARIANTS="rec-test,rec-test-b")
+        users = [f"u{i}" for i in range(32)]
+
+        def mapping(port):
+            out = {}
+            for u in users:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                try:
+                    conn.request("POST", "/queries.json",
+                                 json.dumps({"user": u, "num": 2}).encode(),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    r.read()
+                    assert r.status == 200
+                    variant = r.getheader("X-PIO-Variant")
+                finally:
+                    conn.close()
+                assert variant in ("rec-test", "rec-test-b"), variant
+                out[u] = variant
+            return out
+
+        baseline = None
+        for workers in (1, 4, 2):
+            proc = subprocess.Popen(
+                [PIO, "deploy", "--ip", "127.0.0.1", "--port", "0",
+                 "--workers", str(workers), "--engine-id", "rec-test",
+                 "--engine-variant", "rec-test"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            try:
+                # --workers 1 deploys a plain single server (no
+                # "(workers: N)" suffix on the ready line)
+                port = _read_ready_port(
+                    proc, 120,
+                    want_workers=workers if workers > 1 else None)
+                assert port, f"{workers}-worker experiment pool never ready"
+                m = mapping(port)
+                assert set(m.values()) == {"rec-test", "rec-test-b"}
+                if baseline is None:
+                    baseline = m
+                else:
+                    assert m == baseline, (
+                        f"user→variant mapping moved at {workers} workers")
+                if workers == 2:
+                    # a rolling deploy mid-experiment must not reshuffle
+                    # a single assignment (zero-downtime contract keeps
+                    # every probe answering 200 throughout)
+                    status, body = _post(port, "/reload")
+                    assert status == 200 and "all workers" in body["message"]
+                    assert mapping(port) == baseline
+            finally:
+                _teardown(proc)
+
     def test_startup_failure_fails_pool_fast(self, tmp_path):
         from tests.test_distributed_multihost import _train_env
 
