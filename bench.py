@@ -86,7 +86,9 @@ def _make_source(storage_spec: str, tmpdir):
 def _scrape_metrics(port: int) -> dict:
     """GET /metrics and keep the serving-relevant families, so future perf
     rounds carry the server-side latency histogram in the BENCH json.
-    (Pool mode caveat: the kernel routes the scrape to ONE worker.)"""
+    (Pool mode caveat: the kernel routes a shared-port scrape to ONE
+    worker — scrape the supervisor control endpoint's /metrics for the
+    merged fleet view; docs/observability.md.)"""
     import http.client
 
     from predictionio_tpu.telemetry.registry import parse_prometheus
@@ -105,6 +107,45 @@ def _scrape_metrics(port: int) -> dict:
             "slo_", "flight_", "jit_compile")
     return {name: series for name, series in parsed.items()
             if name.startswith(keep)}
+
+
+def _scrape_history(port: int, window_s: float = 60.0) -> dict:
+    """GET /debug/history.json and fold the last-minute http_*/serving_*
+    series into per-second rates (endpoint delta over the sampled span),
+    so the BENCH record carries the load's trend, not just the final
+    counter values. Histogram families contribute their count rate."""
+    import http.client
+
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", f"/debug/history.json?window={window_s:g}")
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        if r.status != 200:
+            return {"error": f"/debug/history.json answered {r.status}"}
+        payload = json.loads(body)
+    except (OSError, ValueError) as e:
+        return {"error": str(e)}
+    rates = {}
+    for name, fam in payload.get("families", {}).items():
+        if not name.startswith(("http_", "serving_")):
+            continue
+        if fam.get("type") == "gauge":
+            continue  # rates are for flows; gauges are points
+        for labels, pts in fam.get("series", {}).items():
+            if len(pts) < 2:
+                continue
+            (t0, v0), (t1, v1) = (pts[0][0], pts[0][1]), (pts[-1][0],
+                                                          pts[-1][1])
+            if t1 <= t0:
+                continue
+            rates[f"{name}{labels}"] = round(
+                max(0.0, (v1 - v0) / (t1 - t0)), 3)
+    return {"interval_s": payload.get("interval_s"),
+            "span_s": payload.get("span_s"),
+            "samples": payload.get("samples"),
+            "rate_per_s": rates}
 
 
 def _span_breakdown(port: int, path: str = None, payloads=None,
@@ -633,6 +674,9 @@ def bench_serving_qps(emit: bool = True, ladder=None,
                                           "n_requests": n}
         span_breakdown = _span_breakdown(server.port, "/queries.json",
                                          payloads)
+        # 1m-rate view of the ladder run from the in-process history
+        # store — the record shows the sustained rates, not one endpoint
+        history_rates = _scrape_history(server.port)
     finally:
         server.shutdown()
     missing = [s for s in ("http.parse", "http.dispatch", "http.encode")
@@ -720,6 +764,8 @@ def bench_serving_qps(emit: bool = True, ladder=None,
         # flight-recorder per-stage view: http.parse / http.dispatch /
         # http.encode (plus the plane's own spans) — the attribution leg
         "span_breakdown": span_breakdown,
+        # metrics-history 1m rates over the ladder run (http_*/serving_*)
+        "metrics_history": history_rates,
         # optional per-user result cache, informational only
         "result_cache_on": cache_rung,
         "parity_checked": len(parity["loop"]),

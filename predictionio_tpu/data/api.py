@@ -31,6 +31,7 @@ transaction and stays on its direct path.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 from urllib.parse import parse_qs
@@ -436,6 +437,21 @@ class EventServer(HttpService):
 
         self.routes = _EventRoutes(self.storage, self.stats, self.plugins,
                                    self.ingest)
+
+        # Alert watchdog (opt-in, PIO_ALERTS=1): $alert edges ride the
+        # server's own write plane — alerting dogfoods the ingest funnel
+        # it watches.
+        from predictionio_tpu.telemetry import alerts
+        from predictionio_tpu.telemetry import history as metrics_history
+        self.watchdog = alerts.AlertWatchdog.from_env(
+            metrics_history.ensure_started(),
+            emit=alerts.ingest_emitter(
+                self.ingest,
+                app_id=int(os.environ.get("PIO_ALERT_APP_ID", "0"))),
+            source="eventserver")
+        if self.watchdog is not None:
+            self.watchdog.start()
+
         super().__init__(config.ip, config.port,
                          router=self.routes.router(),
                          server_name="eventserver")
@@ -444,6 +460,8 @@ class EventServer(HttpService):
         # stop accepting first, then drain the write plane: in-flight
         # handlers finish their submits before the committer joins
         super().shutdown()
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.ingest.close()
 
 

@@ -20,7 +20,8 @@ class EventValidationError(ValueError):
     """Raised when an event violates the reserved-event / naming rules."""
 
 
-SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete", "$reward"})
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete", "$reward",
+                            "$alert"})
 
 
 def _now() -> datetime:
@@ -141,6 +142,9 @@ def validate_event(e: Event) -> None:
     - ``$reward`` must carry a non-empty string ``variant`` and a
       numeric ``reward`` in [0, 1] in its properties (the experiment
       plane's bandit-feedback event — docs/experimentation.md);
+    - ``$alert`` must carry a non-empty string ``rule``, a ``status``
+      of ``firing`` or ``resolved``, and a numeric ``value`` (the alert
+      watchdog's dogfooded event — docs/observability.md);
     - ``pio_``-prefixed entity types / property names are reserved.
     """
     if e.event.startswith("$") and e.event not in SPECIAL_EVENTS:
@@ -177,4 +181,20 @@ def validate_event(e: Event) -> None:
             if not 0.0 <= float(reward) <= 1.0:
                 raise EventValidationError(
                     f"$reward 'reward' must be in [0, 1], got {reward!r}."
+                )
+        if e.event == "$alert":
+            props = e.properties.to_dict()
+            rule = props.get("rule")
+            if not isinstance(rule, str) or not rule:
+                raise EventValidationError(
+                    "$alert must carry a non-empty string 'rule' property."
+                )
+            if props.get("status") not in ("firing", "resolved"):
+                raise EventValidationError(
+                    "$alert 'status' must be 'firing' or 'resolved'."
+                )
+            value = props.get("value")
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise EventValidationError(
+                    "$alert must carry a numeric 'value' property."
                 )

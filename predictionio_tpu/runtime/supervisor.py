@@ -59,6 +59,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from predictionio_tpu.telemetry import aggregate
+from predictionio_tpu.telemetry import history as metrics_history
 from predictionio_tpu.telemetry import middleware as telemetry_middleware
 from predictionio_tpu.telemetry import slo
 from predictionio_tpu.telemetry.registry import REGISTRY
@@ -76,7 +78,7 @@ log = logging.getLogger(__name__)
 MSG_FMT = "!iiqqqq"  # (kind, pid, a, b, c, d)
 MSG_SIZE = struct.calcsize(MSG_FMT)
 
-MSG_READY = 1      # a = server port
+MSG_READY = 1      # a = server port, b = metrics-snapshot port
 MSG_HEARTBEAT = 2  # a = in-flight, b = completed, c = bad, d = burn×1000
 MSG_RELOADED = 3   # a = drain ms, b = 1 healthy / 0 failed
 MSG_DRAINED = 4    # a = drain ms (scale-down drain finished, exiting)
@@ -130,6 +132,15 @@ SUP_BREAKER_STATE = REGISTRY.gauge(
 SUP_ROLLING = REGISTRY.counter(
     "supervisor_rolling_reloads_total",
     "Rolling (worker-by-worker drain-then-reload) deploys started")
+# Instantaneous autoscaler inputs, published every tick so the metrics
+# history store can smooth them — the autoscaler reads the 1m/5m means
+# back instead of acting on a single tick's point read.
+SUP_POOL_UTIL = REGISTRY.gauge(
+    "supervisor_pool_utilization",
+    "Mean ready-worker in-flight / per-worker queue budget")
+SUP_POOL_BURN = REGISTRY.gauge(
+    "supervisor_pool_burn_avg",
+    "Mean ready-worker 5m SLO burn rate")
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +176,8 @@ class SupervisorConfig:
     scale_up_burn: float = 6.0         # avg 5m burn that triggers scale-up
     scale_stable_ticks: int = 2        # consecutive ticks before scaling up
     scale_down_stable_s: float = 30.0  # sustained idleness before scale-down
+    scale_up_window_s: float = 60.0    # smoothing window for scale-up signals
+    scale_down_window_s: float = 300.0  # smoothing window for scale-down
     error_restart_ratio: float = 0.5   # bad/total over the error window
     error_min_requests: int = 8        # min window traffic for ratio/burn rules
     error_window_s: float = 5.0
@@ -344,10 +357,17 @@ def _worker_main(config, supervisor_pid: int, ctl_fd: int,
     SIGUSR1 → drain-then-reload in place (rolling deploy leg);
     SIGUSR2 → drain-then-exit (scale-down)."""
     ctl = _CtlChannel(ctl_fd)
+    # The fork copied the parent's registry: zero inherited counters so
+    # this worker's series (and the fleet merge summing them) reflect
+    # only its own life, and re-label pio_worker for this slot.
+    aggregate.reset_inherited_counters()
+    aggregate.refresh_worker_info()
+    snapshot_srv: Optional[aggregate.SnapshotServer] = None
     try:
         faults.inject("worker.startup")  # crash-loop / breaker drills
         factory, is_default = _resolve_factory()
         server = factory(config, supervisor_pid)
+        snapshot_srv = aggregate.SnapshotServer()
     except Exception as e:
         print(f"Deploy failed in worker {os.getpid()}: {e}", file=sys.stderr)
         sys.stderr.flush()
@@ -444,11 +464,12 @@ def _worker_main(config, supervisor_pid: int, ctl_fd: int,
                      int(burn * 1000))
             stop.wait(cfg.heartbeat_interval_s)
 
-    ctl.send(MSG_READY, server.port)
+    ctl.send(MSG_READY, server.port, snapshot_srv.port)
     server.start()
     threading.Thread(target=_heartbeat_loop, daemon=True,
                      name="supervisor-heartbeat").start()
     stop.wait()
+    snapshot_srv.close()
     server.shutdown()
     if is_default:
         from predictionio_tpu.storage.registry import Storage
@@ -471,6 +492,7 @@ class _Slot:
         self.spawn_index = -1
         self.ready = False
         self.port = 0
+        self.snapshot_port = 0  # worker's loopback metrics-snapshot socket
         self.spawned_at = 0.0
         self.ready_at = 0.0
         self.next_spawn_at: Optional[float] = 0.0  # None = no spawn pending
@@ -493,6 +515,7 @@ class _Slot:
         self.pid = None
         self.ready = False
         self.port = 0
+        self.snapshot_port = 0
         self.rolling = False
         self.kill_at = None
         self.in_flight = 0
@@ -539,6 +562,9 @@ class Supervisor:
         self._read_fd = -1
         self._write_fd = -1
         self._control: Optional[HttpService] = None
+        # set in run(): smoothed series for the autoscaler; until then
+        # _autoscale falls back to instantaneous heartbeat readings
+        self._history = None
         # per-worker serving queue budget, for the utilization signal
         try:
             self._queue_budget = max(
@@ -581,6 +607,14 @@ class Supervisor:
         signal.signal(signal.SIGINT, self._on_term)
         signal.signal(signal.SIGHUP, self._on_hup)
 
+        # smoothed autoscaling signals + /debug/history.json on the
+        # control endpoint; None when PIO_METRICS_HISTORY=0
+        self._history = metrics_history.ensure_started()
+        # the control endpoint's /metrics serves the merged FLEET view,
+        # not the supervisor's own registry
+        telemetry_middleware.set_metrics_renderer(
+            "supervisor", self._render_fleet_metrics)
+
         if self.cfg.control_port is not None:
             try:
                 self._control = HttpService(
@@ -619,6 +653,7 @@ class Supervisor:
                 except OSError:
                     pass
             self._reservation.close()
+            telemetry_middleware.set_metrics_renderer("supervisor", None)
             if self._control is not None:
                 try:
                     self._control.shutdown()
@@ -678,6 +713,9 @@ class Supervisor:
                 signal.signal(sig, signal.SIG_IGN)
             if fault_spec is not None:
                 os.environ["PIO_FAULTS"] = fault_spec
+            # stable fleet identity: metric series merge under slot<N>,
+            # not the pid that changes on every respawn
+            os.environ["PIO_METRICS_WORKER_LABEL"] = f"slot{slot.idx}"
             os.close(self._read_fd)
             self._reservation.close()
             if self._control is not None:
@@ -789,6 +827,7 @@ class Supervisor:
             if kind == MSG_READY:
                 slot.ready = True
                 slot.port = a
+                slot.snapshot_port = b
                 slot.ready_at = now
                 slot.last_hb = now
                 slot.progress_at = now
@@ -969,10 +1008,20 @@ class Supervisor:
         util = (sum(s.in_flight for s in ready) / len(ready)
                 / self._queue_budget)
         avg_burn = sum(s.burn for s in ready) / len(ready)
+        # publish the instantaneous signals so the history sampler can
+        # record them; decisions below read the SMOOTHED series back, so
+        # one heartbeat spike (or one idle beat) no longer whipsaws the
+        # pool. Falls back to the point reads until history warms up.
+        SUP_POOL_UTIL.set(util)
+        SUP_POOL_BURN.set(avg_burn)
+        up_util, up_burn = self._smoothed(cfg.scale_up_window_s,
+                                          util, avg_burn)
+        down_util, down_burn = self._smoothed(cfg.scale_down_window_s,
+                                              util, avg_burn)
 
         if (len(slots) < cfg.max_workers
-                and (util >= cfg.scale_up_util
-                     or avg_burn >= cfg.scale_up_burn)):
+                and (up_util >= cfg.scale_up_util
+                     or up_burn >= cfg.scale_up_burn)):
             self._up_ticks += 1
             if self._up_ticks >= cfg.scale_stable_ticks:
                 self._up_ticks = 0
@@ -980,13 +1029,14 @@ class Supervisor:
                 slot.next_spawn_at = now
                 SUP_SCALE_EVENTS.labels(direction="up").inc()
                 print(f"supervisor: scale up → {len(slots) + 1} slots "
-                      f"(util={util:.2f} burn={avg_burn:.1f})", flush=True)
+                      f"(util={up_util:.2f} burn={up_burn:.1f})", flush=True)
         else:
             self._up_ticks = 0
 
         can_shrink = (len([s for s in slots if not s.draining_out])
                       > cfg.min_workers)
-        if (can_shrink and util <= cfg.scale_down_util and avg_burn < 1.0):
+        if (can_shrink and down_util <= cfg.scale_down_util
+                and down_burn < 1.0):
             if self._down_since is None:
                 self._down_since = now
             elif now - self._down_since >= cfg.scale_down_stable_s:
@@ -1000,6 +1050,66 @@ class Supervisor:
                 self._kill(victim.pid, signal.SIGUSR2)
         else:
             self._down_since = None
+
+    def _smoothed(self, window_s: float, util_now: float,
+                  burn_now: float) -> Tuple[float, float]:
+        """Windowed means of the pool signals from the metrics history;
+        the instantaneous readings stand in until the sampler has data
+        (or when history is disabled)."""
+        hist = self._history
+        if hist is None:
+            return util_now, burn_now
+        util = hist.mean("supervisor_pool_utilization", window_s=window_s)
+        burn = hist.mean("supervisor_pool_burn_avg", window_s=window_s)
+        return (util_now if util is None else util,
+                burn_now if burn is None else burn)
+
+    # -- fleet metrics -----------------------------------------------------
+
+    def _worker_snapshots(self) -> List[dict]:
+        """Registry snapshots from every ready worker's loopback socket.
+        A worker that dies mid-fetch is simply absent from this round's
+        merge — the fleet view degrades, never errors."""
+        with self._lock:
+            targets = [(f"slot{s.idx}", s.snapshot_port) for s in self._slots
+                       if s.ready and s.pid is not None and s.snapshot_port]
+        snaps = []
+        for label, port in targets:
+            try:
+                snaps.append(aggregate.fetch_snapshot(port))
+            except (OSError, ValueError):
+                log.debug("metrics snapshot from %s (port %d) failed",
+                          label, port)
+        return snaps
+
+    def _render_fleet_metrics(self) -> str:
+        """The supervisor control endpoint's /metrics body: this process's
+        registry merged with every ready worker's — counters sum exactly,
+        gauges stay per-worker."""
+        snaps = [aggregate.snapshot_registry(worker="supervisor")]
+        snaps.extend(self._worker_snapshots())
+        return aggregate.render_merged(aggregate.merge_snapshots(snaps))
+
+    def fleet_summary(self) -> dict:
+        """Per-worker and fleet-total request counters for /status.json —
+        the cross-check that the merged scrape is sum-exact."""
+        snaps = self._worker_snapshots()
+        per_worker = [{
+            "worker": s.get("worker"),
+            "pid": s.get("pid"),
+            "httpRequests": aggregate.counter_totals(
+                s, "http_requests_total"),
+            "queries": aggregate.counter_totals(
+                s, "http_requests_total",
+                where={"route": "/queries.json"}),
+        } for s in snaps]
+        return {
+            "workers": per_worker,
+            "totals": {
+                "httpRequests": sum(w["httpRequests"] for w in per_worker),
+                "queries": sum(w["queries"] for w in per_worker),
+            },
+        }
 
     # -- exit policy -------------------------------------------------------
 
@@ -1060,6 +1170,7 @@ class Supervisor:
                 "pid": s.pid,
                 "ready": s.ready,
                 "port": s.port,
+                "metricsSnapshotPort": s.snapshot_port or None,
                 "inFlight": s.in_flight,
                 "completed": s.completed,
                 "bad": s.bad,
@@ -1082,8 +1193,12 @@ class Supervisor:
             server_version = "pio-tpu-supervisor/0.1"
 
             def do_GET(self):
-                if self.path in ("/", "/status.json"):
-                    return self.send_json(200, sup.status())
+                path, _, query = self.path.partition("?")
+                if path in ("/", "/status.json"):
+                    payload = sup.status()
+                    if "fleet=1" in query.split("&"):
+                        payload["fleet"] = sup.fleet_summary()
+                    return self.send_json(200, payload)
                 return self.send_json(404, {"message": "Not Found"})
 
         return ControlHandler

@@ -1,0 +1,324 @@
+"""Cross-worker metric aggregation for SO_REUSEPORT pools.
+
+The kernel balances *connections* across a pool's workers, so a
+`/metrics` scrape of the shared port answers from ONE arbitrary worker
+and under-reports every fleet counter N-fold. This module closes that
+hole:
+
+- Each worker runs a :class:`SnapshotServer` — a loopback socket that
+  answers every connection with a JSON snapshot of the process registry
+  and closes. The port rides to the supervisor in the worker's READY
+  control message.
+- The supervisor calls :func:`fetch_snapshot` per worker, merges with
+  :func:`merge_snapshots` (counters and histogram buckets are summed
+  exactly; gauges get a ``worker`` label so per-process points stay
+  distinguishable), and serves the fleet view from its control
+  endpoint's `/metrics` via :func:`render_merged`.
+
+Worker identity comes from ``PIO_METRICS_WORKER_LABEL`` (the supervisor
+sets ``slot<N>`` per child; standalone processes may set their own —
+default ``pid<pid>``). Every process also exposes
+``pio_worker{worker="…"} 1`` so even a direct scrape of the shared port
+tells you *which* worker answered — the single-worker scrape is then at
+least attributable for non-pool consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from predictionio_tpu.telemetry.registry import (
+    REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    _escape_help,
+    _format_value,
+    _render_labels,
+)
+
+SNAPSHOT_TIMEOUT_S = 2.0
+
+
+def worker_label() -> str:
+    """This process's identity in merged output (env override or pid)."""
+    return os.environ.get("PIO_METRICS_WORKER_LABEL") or f"pid{os.getpid()}"
+
+
+WORKER_INFO = REGISTRY.gauge(
+    "pio_worker", "Identity of the process that answered this scrape",
+    labelnames=("worker",))
+
+
+def refresh_worker_info() -> None:
+    """(Re)point the pio_worker info gauge at the current identity —
+    called at import and after fork, when the pid (and the supervisor's
+    per-slot label) change."""
+    with WORKER_INFO._lock:
+        WORKER_INFO._children.clear()
+    WORKER_INFO.labels(worker=worker_label()).set(1)
+
+
+def snapshot_registry(registry: MetricsRegistry = REGISTRY,
+                      worker: Optional[str] = None,
+                      refresh: bool = True) -> Dict:
+    """JSON-serialisable snapshot of every family in the registry.
+
+    ``refresh`` recomputes scrape-time gauges (SLO windows) first, same
+    as the `/metrics` route does, so a merged view is as current as a
+    direct scrape."""
+    if refresh:
+        from predictionio_tpu.telemetry import slo
+        slo.refresh()
+    families = []
+    for m in registry.families():
+        fam: Dict = {
+            "name": m.name, "help": m.help, "type": m.type,
+            "labelnames": list(m.labelnames),
+        }
+        if isinstance(m, Histogram):
+            fam["buckets"] = list(m.buckets)
+            fam["children"] = [[list(k), [counts, total, count]]
+                               for k, (counts, total, count) in m.collect()]
+            ex = m.collect_exemplars()
+            if ex:
+                fam["exemplars"] = [[list(k), slots] for k, slots in ex]
+        else:
+            fam["children"] = [[list(k), v] for k, v in m.collect()]
+        families.append(fam)
+    return {"worker": worker or worker_label(), "pid": os.getpid(),
+            "ts": time.time(), "families": families}
+
+
+class SnapshotServer:
+    """Loopback one-shot snapshot socket: connect → receive the JSON
+    registry snapshot → EOF. Not HTTP — this is a private supervisor↔
+    worker channel; the public `/metrics` stays on the shared port."""
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY,
+                 host: str = "127.0.0.1"):
+        self._registry = registry
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(8)
+        self.port: int = self._sock.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve, name="pio-metrics-snapshot", daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            try:
+                conn.settimeout(SNAPSHOT_TIMEOUT_S)
+                payload = json.dumps(
+                    snapshot_registry(self._registry)).encode("utf-8")
+                conn.sendall(payload)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def fetch_snapshot(port: int,
+                   timeout_s: float = SNAPSHOT_TIMEOUT_S) -> Dict:
+    """Pull one worker's snapshot off its loopback snapshot port."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout_s) as s:
+        s.settimeout(timeout_s)
+        chunks: List[bytes] = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks.append(b)
+    return json.loads(b"".join(chunks).decode("utf-8"))
+
+
+def merge_snapshots(snapshots: Iterable[Dict]) -> Dict:
+    """Merge per-worker registry snapshots into one fleet view.
+
+    Counters and histograms are summed per label set — the merged total
+    is exactly the sum of the per-worker registries. Gauges are
+    *points*, not flows: each series gains a ``worker`` label (unless
+    the family already carries one) so nothing is averaged away.
+    Histogram exemplars keep the newest exemplar per bucket fleet-wide.
+    """
+    merged: Dict[str, Dict] = {}
+    workers: List[str] = []
+    for snap in snapshots:
+        wlabel = str(snap.get("worker", "?"))
+        workers.append(wlabel)
+        for fam in snap.get("families", ()):
+            name = fam["name"]
+            out = merged.get(name)
+            if out is None:
+                out = merged[name] = {
+                    "name": name, "help": fam.get("help", ""),
+                    "type": fam["type"],
+                    "labelnames": tuple(fam.get("labelnames", ())),
+                    "buckets": tuple(fam.get("buckets", ())),
+                    "children": {}, "exemplars": {},
+                }
+                if (out["type"] == "gauge"
+                        and "worker" not in out["labelnames"]):
+                    out["labelnames"] = out["labelnames"] + ("worker",)
+                    out["per_worker"] = True
+                else:
+                    out["per_worker"] = False
+            elif (out["type"] != fam["type"]
+                  or (not out["per_worker"]
+                      and out["labelnames"] != tuple(
+                          fam.get("labelnames", ())))):
+                continue  # shape clash across workers: first shape wins
+            children = out["children"]
+            for rawkey, value in fam.get("children", ()):
+                key = tuple(str(k) for k in rawkey)
+                if out["per_worker"]:
+                    children[key + (wlabel,)] = value
+                elif out["type"] == "histogram":
+                    counts, total, count = value
+                    prev = children.get(key)
+                    if prev is None:
+                        children[key] = [list(counts), float(total),
+                                         int(count)]
+                    else:
+                        for i, n in enumerate(counts):
+                            prev[0][i] += n
+                        prev[1] += total
+                        prev[2] += count
+                elif out["type"] == "counter":
+                    children[key] = children.get(key, 0.0) + float(value)
+                else:  # gauge that already carries a worker label
+                    children[key] = float(value)
+            for rawkey, slots in fam.get("exemplars", ()):
+                key = tuple(str(k) for k in rawkey)
+                prev = out["exemplars"].get(key)
+                if prev is None:
+                    out["exemplars"][key] = [tuple(e) if e else None
+                                             for e in slots]
+                else:
+                    for i, e in enumerate(slots):
+                        if e and (prev[i] is None or e[2] > prev[i][2]):
+                            prev[i] = tuple(e)
+    return {"workers": workers, "families": merged}
+
+
+def render_merged(merged: Dict) -> str:
+    """Prometheus text exposition of a merge_snapshots() result."""
+    lines: List[str] = []
+    for name in sorted(merged["families"]):
+        fam = merged["families"][name]
+        lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        labelnames = fam["labelnames"]
+        if fam["type"] == "histogram":
+            buckets = fam["buckets"]
+            for key in sorted(fam["children"]):
+                counts, total, count = fam["children"][key]
+                slots = fam["exemplars"].get(key)
+                cum = 0
+                for i, (bound, n) in enumerate(zip(buckets, counts)):
+                    cum += n
+                    labels = _render_labels(
+                        labelnames, key,
+                        extra=[("le", _format_value(bound))])
+                    lines.append(f"{name}_bucket{labels} {cum}"
+                                 f"{_exemplar_suffix(slots, i)}")
+                inf_labels = _render_labels(labelnames, key,
+                                            extra=[("le", "+Inf")])
+                lines.append(f"{name}_bucket{inf_labels} {count}"
+                             f"{_exemplar_suffix(slots, len(buckets))}")
+                labels = _render_labels(labelnames, key)
+                lines.append(f"{name}_sum{labels} {_format_value(total)}")
+                lines.append(f"{name}_count{labels} {count}")
+        else:
+            for key in sorted(fam["children"]):
+                labels = _render_labels(labelnames, key)
+                value = fam["children"][key]
+                lines.append(f"{name}{labels} {_format_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _exemplar_suffix(slots, i: int) -> str:
+    from predictionio_tpu.telemetry.registry import _render_exemplar
+    return _render_exemplar(slots, i) if slots else ""
+
+
+def counter_totals(snapshot: Dict, name: str,
+                   where: Optional[Dict[str, str]] = None) -> float:
+    """Sum of one counter family's children in a single snapshot,
+    optionally restricted to children matching the ``where`` labels."""
+    for fam in snapshot.get("families", ()):
+        if fam["name"] == name and fam["type"] == "counter":
+            labelnames = fam.get("labelnames", ())
+            total = 0.0
+            for k, v in fam.get("children", ()):
+                if where:
+                    kv = dict(zip(labelnames, k))
+                    if any(kv.get(lk) != lv for lk, lv in where.items()):
+                        continue
+                total += float(v)
+            return total
+    return 0.0
+
+
+def reset_inherited_counters(
+        registry: MetricsRegistry = REGISTRY,
+        drop_prefixes: tuple = ("supervisor_", "worker_pool_")) -> None:
+    """Zero counter/histogram children in a freshly forked pool worker.
+
+    fork() copies the parent's registry, so without this a respawned
+    worker would re-report every request the supervisor (or the worker
+    it was forked from) already counted — and the fleet merge would sum
+    that inherited history twice. Control-plane families are dropped
+    outright (a worker has no pool view); gauges are left alone — they
+    are points the worker immediately re-owns."""
+    for m in registry.families():
+        if m.name.startswith(drop_prefixes):
+            with m._lock:
+                m._children.clear()
+            continue
+        if m.type == "counter":
+            with m._lock:
+                for c in m._children.values():
+                    c._value = 0.0
+        elif m.type == "histogram":
+            with m._lock:
+                for c in m._children.values():
+                    c.counts = [0] * len(c.counts)
+                    c.sum = 0.0
+                    c.count = 0
+                    if c.exemplars is not None:
+                        c.exemplars = [None] * len(c.exemplars)
+
+
+def _reinit_after_fork() -> None:
+    # Runs after registry._reinit_locks_after_fork (registration order):
+    # the child is a new worker — re-label its info gauge.
+    refresh_worker_info()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)
+
+refresh_worker_info()

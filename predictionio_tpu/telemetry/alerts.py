@@ -1,0 +1,360 @@
+"""Alert watchdog: rules evaluated against the metrics history store.
+
+Rules come in three kinds, all reading `telemetry/history.py` series —
+never instantaneous gauges, so a one-sample blip can't page:
+
+- ``threshold`` — a windowed statistic of one family (counter ``rate``,
+  gauge ``mean``/``max``, histogram ``p50``/``p95``/``p99``) compared
+  against a bound.
+- ``burn_rate`` — sugar over threshold on the
+  ``slo_error_budget_burn_rate`` gauge (max across matching routes).
+- ``zscore`` — the latest sample scored against the window's mean/std;
+  fires when ``|z|`` exceeds the bound, catching drifts that absolute
+  thresholds would need per-deploy tuning for.
+
+A rule may require the breach to *sustain* (``for_s``) before firing.
+On the firing edge the watchdog emits a ``$alert`` event through the
+normal group-commit ingest funnel — alerts are ordinary queryable
+events (dogfooding), with ``rule``/``status``/``value``/``threshold``
+properties — and keeps ``alert_*`` metric families for dashboards:
+``alert_active``, ``alert_fired_total``, ``alert_resolved_total``,
+``alert_last_value``, ``alert_evaluations_total``.
+
+Rule syntax (``PIO_ALERT_RULES``): a JSON list of rule objects, e.g.::
+
+    [{"name": "queries-p95", "kind": "threshold",
+      "metric": "http_request_duration_seconds", "stat": "p95",
+      "labels": {"route": "/queries.json"},
+      "op": ">", "value": 0.5, "window_s": 60, "for_s": 0,
+      "severity": "page"},
+     {"name": "burn-5m", "kind": "burn_rate", "value": 14.4,
+      "window": "5m", "severity": "page"},
+     {"name": "rate-drift", "kind": "zscore",
+      "metric": "http_requests_total", "stat": "rate",
+      "value": 4.0, "window_s": 300}]
+
+``AlertWatchdog.from_env`` wires the default rule set (the two classic
+multi-window burn pages) when ``PIO_ALERTS`` is truthy and no explicit
+rules are given.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.telemetry.history import MetricsHistory
+
+logger = logging.getLogger(__name__)
+
+ALERT_RULES = REGISTRY.gauge(
+    "alert_rules", "Loaded alert rules (1 per rule)",
+    labelnames=("rule", "kind", "severity"))
+ALERT_ACTIVE = REGISTRY.gauge(
+    "alert_active", "1 while the rule is firing",
+    labelnames=("rule",))
+ALERT_LAST_VALUE = REGISTRY.gauge(
+    "alert_last_value", "Latest evaluated value per rule",
+    labelnames=("rule",))
+ALERT_FIRED = REGISTRY.counter(
+    "alert_fired_total", "Firing transitions",
+    labelnames=("rule", "severity"))
+ALERT_RESOLVED = REGISTRY.counter(
+    "alert_resolved_total", "Resolve transitions",
+    labelnames=("rule",))
+ALERT_EVALS = REGISTRY.counter(
+    "alert_evaluations_total", "Rule evaluation passes")
+
+_QUANTILES = {"p50": 0.50, "p90": 0.90, "p95": 0.95, "p99": 0.99}
+
+
+class AlertRule:
+    """One declarative rule; see the module docstring for the syntax."""
+
+    __slots__ = ("name", "kind", "metric", "labels", "stat", "op",
+                 "value", "window_s", "for_s", "severity")
+
+    def __init__(self, name: str, kind: str = "threshold",
+                 metric: str = "", labels: Optional[Dict] = None,
+                 stat: str = "mean", op: str = ">", value: float = 0.0,
+                 window_s: float = 60.0, for_s: float = 0.0,
+                 severity: str = "page"):
+        if kind not in ("threshold", "burn_rate", "zscore"):
+            raise ValueError(f"unknown alert rule kind {kind!r}")
+        if op not in (">", "<"):
+            raise ValueError(f"unknown alert rule op {op!r}")
+        self.name = name
+        self.kind = kind
+        self.metric = metric
+        self.labels = dict(labels or {})
+        self.stat = stat
+        self.op = op
+        self.value = float(value)
+        self.window_s = float(window_s)
+        self.for_s = float(for_s)
+        self.severity = severity
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "AlertRule":
+        d = dict(d)
+        name = d.pop("name", None)
+        if not name:
+            raise ValueError("alert rule needs a 'name'")
+        kind = d.pop("kind", "threshold")
+        if kind == "burn_rate":
+            labels = dict(d.pop("labels", {}))
+            labels.setdefault("window", d.pop("window", "5m"))
+            d.setdefault("metric", "slo_error_budget_burn_rate")
+            d.setdefault("stat", "max")
+            d["labels"] = labels
+        known = {"metric", "labels", "stat", "op", "value", "window_s",
+                 "for_s", "severity"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"alert rule {name!r}: unknown keys "
+                             f"{sorted(unknown)}")
+        return cls(name=name, kind=kind, **d)
+
+    def measure(self, history: MetricsHistory) -> Optional[float]:
+        """The rule's current statistic, or None while underfed."""
+        if self.kind == "zscore":
+            if self.stat == "rate":
+                # z over the per-sample rate is noisy; score the latest
+                # short-rate against the long window's sample spread
+                short = history.rate(self.metric, self.labels,
+                                     window_s=max(10.0,
+                                                  self.window_s / 10))
+                stats = _rate_stats(history, self.metric, self.labels,
+                                    self.window_s)
+                if short is None or stats is None:
+                    return None
+                mean, std = stats
+            else:
+                st = history.stats(self.metric, self.labels,
+                                   window_s=self.window_s)
+                if st is None:
+                    return None
+                mean, std, short, _n = st
+            if std <= 1e-12:
+                return 0.0
+            return abs(short - mean) / std
+        if self.stat == "rate":
+            return history.rate(self.metric, self.labels,
+                                window_s=self.window_s)
+        if self.stat in _QUANTILES:
+            return history.quantile(self.metric, _QUANTILES[self.stat],
+                                    self.labels, window_s=self.window_s)
+        if self.stat == "max":
+            return _series_max(history, self.metric, self.labels,
+                               self.window_s)
+        return history.mean(self.metric, self.labels,
+                            window_s=self.window_s)
+
+    def breached(self, measured: float) -> bool:
+        if self.kind == "zscore":
+            return measured > self.value
+        return (measured > self.value if self.op == ">"
+                else measured < self.value)
+
+
+def _series_max(history, metric, labels, window_s) -> Optional[float]:
+    pts = history.series(metric, labels, window_s, agg="max")
+    if not pts:
+        return None
+    return max(v for _t, v in pts)
+
+
+def _rate_stats(history, metric, labels, window_s):
+    """Mean/std of per-interval rates over the window (for zscore+rate)."""
+    pts = history.series(metric, labels, window_s)
+    if len(pts) < 4:
+        return None
+    rates = []
+    for (t0, v0), (t1, v1) in zip(pts, pts[1:]):
+        if t1 > t0:
+            rates.append(max(0.0, (v1 - v0) / (t1 - t0)))
+    if len(rates) < 3:
+        return None
+    mean = sum(rates) / len(rates)
+    var = sum((r - mean) ** 2 for r in rates) / len(rates)
+    return mean, var ** 0.5
+
+
+def parse_rules(raw: Optional[str]) -> List[AlertRule]:
+    """PIO_ALERT_RULES (JSON list) → rules; raises ValueError on junk."""
+    if not raw or not raw.strip():
+        return []
+    data = json.loads(raw)
+    if not isinstance(data, list):
+        raise ValueError("PIO_ALERT_RULES must be a JSON list")
+    return [AlertRule.from_dict(d) for d in data]
+
+
+def default_rules() -> List[AlertRule]:
+    """The SRE-workbook multi-window burn pages (docs/operations.md)."""
+    return [
+        AlertRule(name="slo-burn-5m", kind="burn_rate",
+                  metric="slo_error_budget_burn_rate",
+                  labels={"window": "5m"}, stat="max",
+                  value=14.4, window_s=60.0, severity="page"),
+        AlertRule(name="slo-burn-1h", kind="burn_rate",
+                  metric="slo_error_budget_burn_rate",
+                  labels={"window": "1h"}, stat="max",
+                  value=6.0, window_s=300.0, severity="ticket"),
+    ]
+
+
+def ingest_emitter(writer, app_id: int,
+                   channel_id=None) -> Callable:
+    """Adapter: $alert events → the group-commit ingest funnel.
+
+    `writer` is a GroupCommitWriter (or anything with its submit
+    signature); returns emit(event) -> event_id."""
+    def emit(event) -> str:
+        return writer.submit(event, app_id, channel_id)
+    return emit
+
+
+class AlertWatchdog:
+    """Evaluates rules on an interval; emits $alert events on edges."""
+
+    def __init__(self, history: MetricsHistory, rules: List[AlertRule],
+                 emit: Optional[Callable] = None,
+                 interval_s: float = 5.0, source: str = "watchdog"):
+        self.history = history
+        self.rules = list(rules)
+        self.emit = emit
+        self.interval_s = max(0.05, float(interval_s))
+        self.source = source
+        self._active: Dict[str, bool] = {}
+        self._breach_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        for r in self.rules:
+            ALERT_RULES.labels(rule=r.name, kind=r.kind,
+                               severity=r.severity).set(1)
+            ALERT_ACTIVE.labels(rule=r.name).set(0)
+
+    @classmethod
+    def from_env(cls, history: Optional[MetricsHistory], emit=None,
+                 source: str = "watchdog") -> Optional["AlertWatchdog"]:
+        enabled = os.environ.get("PIO_ALERTS", "")
+        if history is None or enabled in ("", "0", "false", "off", "no"):
+            return None
+        try:
+            rules = parse_rules(os.environ.get("PIO_ALERT_RULES"))
+        except (ValueError, json.JSONDecodeError) as e:
+            logger.warning("alerts: bad PIO_ALERT_RULES (%s); "
+                           "using defaults", e)
+            rules = []
+        if not rules:
+            rules = default_rules()
+        interval = float(os.environ.get("PIO_ALERT_INTERVAL_S", "5"))
+        return cls(history, rules, emit=emit, interval_s=interval,
+                   source=source)
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate_once(self, now: Optional[float] = None) -> List[Dict]:
+        """One pass over all rules; returns the edge transitions
+        ([{rule, status, value}…]) it produced."""
+        if now is None:
+            now = time.time()
+        ALERT_EVALS.inc()
+        transitions: List[Dict] = []
+        for rule in self.rules:
+            try:
+                measured = rule.measure(self.history)
+            except Exception:  # noqa: BLE001 — one bad rule ≠ dead watchdog
+                logger.exception("alerts: rule %s evaluation failed",
+                                 rule.name)
+                continue
+            if measured is None:
+                continue
+            ALERT_LAST_VALUE.labels(rule=rule.name).set(measured)
+            breached = rule.breached(measured)
+            was_active = self._active.get(rule.name, False)
+            if breached:
+                since = self._breach_since.setdefault(rule.name, now)
+                if not was_active and now - since >= rule.for_s:
+                    self._active[rule.name] = True
+                    ALERT_ACTIVE.labels(rule=rule.name).set(1)
+                    ALERT_FIRED.labels(rule=rule.name,
+                                       severity=rule.severity).inc()
+                    transitions.append(self._transition(
+                        rule, "firing", measured))
+            else:
+                self._breach_since.pop(rule.name, None)
+                if was_active:
+                    self._active[rule.name] = False
+                    ALERT_ACTIVE.labels(rule=rule.name).set(0)
+                    ALERT_RESOLVED.labels(rule=rule.name).inc()
+                    transitions.append(self._transition(
+                        rule, "resolved", measured))
+        for t in transitions:
+            self._emit_event(t)
+        return transitions
+
+    def _transition(self, rule: AlertRule, status: str,
+                    measured: float) -> Dict:
+        return {"rule": rule.name, "status": status,
+                "value": round(float(measured), 6),
+                "threshold": rule.value, "kind": rule.kind,
+                "metric": rule.metric, "window_s": rule.window_s,
+                "severity": rule.severity, "source": self.source}
+
+    def _emit_event(self, transition: Dict) -> None:
+        if self.emit is None:
+            return
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.events import Event
+        event = Event(event="$alert", entity_type="alert",
+                      entity_id=transition["rule"],
+                      properties=DataMap(dict(transition)))
+        try:
+            self.emit(event)
+        except Exception:  # noqa: BLE001 — never let ingest kill alerting
+            logger.exception("alerts: failed to emit $alert for %s",
+                             transition["rule"])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("alerts: evaluation pass crashed")
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-alert-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def snapshot(self) -> List[Dict]:
+        """Dashboard rows: one per rule with its live state."""
+        rows = []
+        for rule in self.rules:
+            rows.append({
+                "rule": rule.name, "kind": rule.kind,
+                "metric": rule.metric, "stat": rule.stat,
+                "op": rule.op, "threshold": rule.value,
+                "window_s": rule.window_s, "severity": rule.severity,
+                "active": self._active.get(rule.name, False),
+            })
+        return rows

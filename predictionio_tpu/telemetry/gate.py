@@ -1,6 +1,6 @@
 """Telemetry gate — CI check that no HTTP surface escapes the middleware.
 
-Run via `python quality.py --telemetry-gate`. Two layers:
+Run via `python quality.py --telemetry-gate`. Five layers:
 
 1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
    every HTTP server must go through `utils/http.py`'s HttpService —
@@ -11,9 +11,10 @@ Run via `python quality.py --telemetry-gate`. Two layers:
 
 2. Runtime check: construct an HttpService on an ephemeral port, verify
    every `do_*` route handler carries the middleware's wrapped marker,
-   and that one served request makes `GET /metrics` expose the required
+   that one served request makes `GET /metrics` expose the required
    `http_requests_total` / `http_request_duration_seconds` /
-   `http_in_flight` families.
+   `http_in_flight` families, and that `GET /debug/history.json`
+   answers with the metrics-history payload.
 
 3. Span-coverage drill (runtime, no jax, no data files): drive one
    admitted `/events.json` request through a real EventServer on memory
@@ -23,6 +24,17 @@ Run via `python quality.py --telemetry-gate`. Two layers:
    `/debug/requests/<trace_id>.json` and assert the admission and
    dispatch/commit spans are present — the flight recorder's coverage
    contract, checked end to end rather than by AST.
+
+4. Alerts coverage: an AlertWatchdog over a live history store must
+   register every `alert_*` family on `/metrics` and count its
+   evaluation passes.
+
+5. Fleet-aggregation drill: a 4-worker SO_REUSEPORT pool (stub factory,
+   no jax) under sustained load; the supervisor's merged `/metrics`
+   counter totals must EXACTLY equal the sum of the per-worker
+   registries read over the snapshot sockets, `/debug/history.json` on
+   the control endpoint must carry sampled `supervisor_*` series, and
+   every process's history sampling tick must cost ≤5% of its interval.
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
@@ -137,6 +149,18 @@ def _runtime_check() -> list[str]:
         if 'server="gateprobe"' not in text:
             problems.append("runtime: served request did not reach "
                             "http_requests_total")
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+        conn.request("GET", "/debug/history.json")
+        r = conn.getresponse()
+        hist_body = r.read()
+        conn.close()
+        if r.status != 200:
+            problems.append(
+                f"runtime: /debug/history.json answered {r.status} "
+                f"(history store not serving)")
+        elif "families" not in json.loads(hist_body):
+            problems.append(
+                "runtime: /debug/history.json payload has no families")
     finally:
         svc.shutdown()
     return problems
@@ -263,6 +287,156 @@ def _span_coverage_check() -> list[str]:
     return problems
 
 
+def _alerts_coverage_check() -> list[str]:
+    """Every alert_* family must be registered and rendered once a
+    watchdog exists, and an evaluation pass must be countable."""
+    from predictionio_tpu.telemetry import alerts, slo
+    from predictionio_tpu.telemetry.history import MetricsHistory
+    from predictionio_tpu.telemetry.registry import REGISTRY, parse_prometheus
+
+    problems = []
+    hist = MetricsHistory(interval_s=0.1, window_s=30.0)
+    hist.sample_now()
+    watchdog = alerts.AlertWatchdog(hist, alerts.default_rules())
+    before = sum(parse_prometheus(REGISTRY.render()).get(
+        "alert_evaluations_total", {}).values())
+    watchdog.evaluate_once()
+    slo.refresh()
+    text = REGISTRY.render()
+    for family in ("alert_rules", "alert_active", "alert_last_value",
+                   "alert_fired_total", "alert_resolved_total",
+                   "alert_evaluations_total"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"alerts: /metrics is missing {family}")
+    after = sum(parse_prometheus(text).get(
+        "alert_evaluations_total", {}).values())
+    if after <= before:
+        problems.append("alerts: an evaluation pass did not count in "
+                        "alert_evaluations_total")
+    return problems
+
+
+def _fleet_drill() -> list[str]:
+    """4-worker pool under load: the supervisor's merged scrape must be
+    sum-exact against the per-worker registries, with history running
+    everywhere under the 5% sampling-overhead bar."""
+    import time
+
+    from predictionio_tpu.runtime.gate import (
+        _get_json, _Load, _parse_port, _Pool,
+    )
+    from predictionio_tpu.telemetry import aggregate
+    from predictionio_tpu.telemetry.registry import parse_prometheus
+
+    problems = []
+    interval_s = 0.25
+    env = {
+        "PIO_SUPERVISOR_FACTORY":
+            "predictionio_tpu.runtime.gate:stub_factory",
+        "PIO_SUPERVISOR_POLL_INTERVAL_S": "0.2",
+        "PIO_SUPERVISOR_HEARTBEAT_INTERVAL_S": "0.2",
+        "PIO_METRICS_HISTORY_INTERVAL_S": str(interval_s),
+        "PIO_METRICS_HISTORY_WINDOW_S": "60",
+    }
+    pool = _Pool(4, env)
+    load = None
+    try:
+        line = pool.wait_line("Engine instance deployed on", 30.0)
+        ctl_line = pool.wait_line("Supervisor control endpoint on", 10.0)
+        if line is None or ctl_line is None:
+            return ["fleet: pool never became ready"]
+        port, ctl_port = _parse_port(line), _parse_port(ctl_line)
+
+        # all four workers ready with snapshot sockets announced
+        deadline = time.monotonic() + 20.0
+        workers = []
+        while time.monotonic() < deadline:
+            status = _get_json(ctl_port, "/status.json")
+            workers = [w for w in status["workers"]
+                       if w["ready"] and w.get("metricsSnapshotPort")]
+            if len(workers) >= 4:
+                break
+            time.sleep(0.2)
+        if len(workers) < 4:
+            return [f"fleet: only {len(workers)}/4 workers announced "
+                    f"snapshot sockets"]
+
+        load = _Load(port)
+        time.sleep(2.5)
+        load.stop()
+        served = load.mark()
+        if served < 100:
+            problems.append(f"fleet: load produced only {served} responses")
+        time.sleep(0.6)  # let the last in-flight bookkeeping land
+
+        # -- sum-exactness: merged scrape vs direct per-worker snapshots
+        snaps = [aggregate.fetch_snapshot(w["metricsSnapshotPort"])
+                 for w in workers]
+        per_worker_total = sum(
+            aggregate.counter_totals(s, "http_requests_total")
+            for s in snaps)
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl_port}/metrics", timeout=5) as r:
+            merged_text = r.read().decode()
+        merged = parse_prometheus(merged_text)
+        merged_total = sum(
+            v for labels, v in merged.get("http_requests_total", {}).items()
+            if 'server="supervisor"' not in labels)
+        if merged_total != per_worker_total:
+            problems.append(
+                f"fleet: merged http_requests_total {merged_total} != "
+                f"sum of per-worker registries {per_worker_total}")
+        if per_worker_total < served:
+            problems.append(
+                f"fleet: workers counted {per_worker_total} requests but "
+                f"the load saw {served} responses")
+        if sum(1 for s in snaps
+               if aggregate.counter_totals(s, "http_requests_total") > 0) < 2:
+            problems.append("fleet: SO_REUSEPORT balanced the load onto "
+                            "fewer than 2 workers — merge untestable")
+
+        # -- worker attribution on the merged gauge series
+        if 'worker="slot' not in merged_text:
+            problems.append("fleet: merged gauges carry no worker= label")
+
+        # -- history on the control endpoint: sampled supervisor series
+        hist = _get_json(ctl_port, "/debug/history.json")
+        if hist.get("samples", 0) < 3:
+            problems.append(
+                f"fleet: supervisor history has {hist.get('samples')} "
+                f"samples after the drill")
+        if not any(n.startswith("supervisor_")
+                   for n in hist.get("families", {})):
+            problems.append("fleet: no supervisor_* series in the "
+                            "control endpoint's history")
+
+        # -- sampling overhead: every pool process's last tick ≤5% of
+        # its interval (supervisor included, via the merged gauge)
+        budget = 0.05 * interval_s
+        for s in snaps:
+            for fam in s.get("families", ()):
+                if fam["name"] != "metrics_history_sample_seconds":
+                    continue
+                for _k, v in fam.get("children", ()):
+                    if float(v) > budget:
+                        problems.append(
+                            f"fleet: history sampling tick took {v:.4f}s "
+                            f"on {s.get('worker')} — over the 5% bar "
+                            f"({budget:.4f}s of {interval_s}s)")
+        for labels, v in merged.get(
+                "metrics_history_sample_seconds", {}).items():
+            if 'worker="supervisor"' in labels and v > budget:
+                problems.append(
+                    f"fleet: supervisor history sampling tick took "
+                    f"{v:.4f}s — over the 5% bar ({budget:.4f}s)")
+    finally:
+        if load is not None:
+            load.stop_evt.set()
+        pool.stop()
+    return problems
+
+
 def run_gate() -> int:
     problems = _static_scan()
     try:
@@ -273,6 +447,14 @@ def run_gate() -> int:
         problems += _span_coverage_check()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
         problems.append(f"span-coverage check crashed: {e!r}")
+    try:
+        problems += _alerts_coverage_check()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"alerts coverage check crashed: {e!r}")
+    try:
+        problems += _fleet_drill()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"fleet drill crashed: {e!r}")
     for p in problems:
         print(p, file=sys.stderr)
     print(f"telemetry gate: {'FAIL' if problems else 'OK'} "

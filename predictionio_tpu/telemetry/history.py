@@ -1,0 +1,356 @@
+"""In-process metrics history: a bounded ring-buffer time-series store.
+
+Every registry family is a *point* at scrape time; operators (and the
+supervisor's autoscaler) need trends — "what was the 1m rate", "is p95
+drifting", "is the burn gauge sustained or a blip". A background sampler
+copies the matching families every ``interval_s`` into a ring of
+timestamped snapshots (counters stay cumulative so queries are
+delta-aware and restart-tolerant; histograms keep per-bucket counts so
+windowed quantiles interpolate from bucket *deltas*, not lifetime
+totals). The ring is bounded: ``window_s / interval_s`` samples, a few
+hundred KB at the defaults — cost independent of traffic.
+
+Knobs (read once at first start):
+
+- ``PIO_METRICS_HISTORY``            enable (default 1)
+- ``PIO_METRICS_HISTORY_INTERVAL_S`` sample period (default 1.0)
+- ``PIO_METRICS_HISTORY_WINDOW_S``   retention (default 600)
+- ``PIO_METRICS_HISTORY_FAMILIES``   comma list of name prefixes
+  (default ``http_,serving_,slo_,supervisor_,alert_,ingest_,engine_,
+  experiment_``)
+
+Served at ``GET /debug/history.json`` on every instrumented HttpService;
+queried by `telemetry/alerts.py` rules and `runtime/supervisor.py`'s
+smoothed autoscaler. The sampler runs OFF the request path — the only
+hot-path cost is the per-family locks it shares with request bookkeeping
+for microseconds per tick.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.telemetry.registry import (
+    REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    _render_labels,
+)
+
+DEFAULT_PREFIXES: Tuple[str, ...] = (
+    "http_", "serving_", "slo_", "supervisor_", "alert_", "ingest_",
+    "engine_", "experiment_",
+)
+
+SAMPLE_SECONDS = REGISTRY.gauge(
+    "metrics_history_sample_seconds",
+    "Wall time of the last history sampling tick")
+SAMPLES_TOTAL = REGISTRY.counter(
+    "metrics_history_samples_total", "History sampling ticks taken")
+
+
+def _truthy(v: Optional[str], default: bool = True) -> bool:
+    if v is None:
+        return default
+    return v not in ("0", "false", "off", "no", "")
+
+
+class MetricsHistory:
+    """Ring-buffer store of registry samples with windowed queries."""
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY,
+                 interval_s: float = 1.0, window_s: float = 600.0,
+                 prefixes: Sequence[str] = DEFAULT_PREFIXES):
+        self.registry = registry
+        self.interval_s = max(0.05, float(interval_s))
+        self.window_s = max(self.interval_s, float(window_s))
+        self.prefixes = tuple(prefixes)
+        maxlen = int(self.window_s / self.interval_s) + 2
+        # each entry: (ts, {name: {labelkey_tuple: float | [counts,sum,cnt]}})
+        self._samples: deque = deque(maxlen=maxlen)
+        # family metadata as of the latest sample that saw it
+        self._meta: Dict[str, Tuple[str, Tuple[str, ...], Tuple[float, ...]]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls, registry: MetricsRegistry = REGISTRY
+                 ) -> "MetricsHistory":
+        prefixes = DEFAULT_PREFIXES
+        raw = os.environ.get("PIO_METRICS_HISTORY_FAMILIES")
+        if raw:
+            prefixes = tuple(p.strip() for p in raw.split(",") if p.strip())
+        return cls(
+            registry,
+            interval_s=float(
+                os.environ.get("PIO_METRICS_HISTORY_INTERVAL_S", "1.0")),
+            window_s=float(
+                os.environ.get("PIO_METRICS_HISTORY_WINDOW_S", "600")),
+            prefixes=prefixes)
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_now(self, now: Optional[float] = None) -> None:
+        """Take one sample (the background thread's tick; tests call it
+        directly with synthetic timestamps)."""
+        if now is None:
+            now = time.time()
+        t0 = time.perf_counter()
+        # slo_* gauges are normally recomputed at scrape; the history
+        # store is its own consumer, so refresh before copying.
+        from predictionio_tpu.telemetry import slo
+        slo.refresh(now)
+        data: Dict[str, Dict[Tuple[str, ...], object]] = {}
+        for m in self.registry.families():
+            name = m.name
+            if not name.startswith(self.prefixes):
+                continue
+            if isinstance(m, Histogram):
+                children = {k: [list(c), s, n]
+                            for k, (c, s, n) in m.collect()}
+                self._meta[name] = ("histogram", m.labelnames, m.buckets)
+            else:
+                children = dict(m.collect())
+                self._meta[name] = (m.type, m.labelnames, ())
+            data[name] = children
+        with self._lock:
+            self._samples.append((now, data))
+        SAMPLE_SECONDS.set(time.perf_counter() - t0)
+        SAMPLES_TOTAL.inc()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_now()
+            except Exception:  # noqa: BLE001 — sampler must not die
+                pass
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-metrics-history", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+    # -- queries -----------------------------------------------------------
+
+    def _window(self, window_s: Optional[float]
+                ) -> List[Tuple[float, Dict]]:
+        with self._lock:
+            samples = list(self._samples)
+        if not samples or window_s is None:
+            return samples
+        cutoff = samples[-1][0] - float(window_s)
+        return [s for s in samples if s[0] >= cutoff]
+
+    @staticmethod
+    def _match(key: Tuple[str, ...], labelnames: Tuple[str, ...],
+               labels: Optional[Dict[str, str]]) -> bool:
+        if not labels:
+            return True
+        kv = dict(zip(labelnames, key))
+        return all(kv.get(k) == str(v) for k, v in labels.items())
+
+    def series(self, name: str, labels: Optional[Dict[str, str]] = None,
+               window_s: Optional[float] = None, agg: str = "sum"
+               ) -> List[Tuple[float, float]]:
+        """[(ts, value)] for a counter/gauge family, matching children
+        aggregated per sample (``agg``: sum | max | mean)."""
+        meta = self._meta.get(name)
+        if meta is None or meta[0] == "histogram":
+            return []
+        _type, labelnames, _ = meta
+        out: List[Tuple[float, float]] = []
+        for ts, data in self._window(window_s):
+            children = data.get(name)
+            if children is None:
+                continue
+            vals = [float(v) for k, v in children.items()
+                    if self._match(k, labelnames, labels)]
+            if not vals:
+                continue
+            if agg == "max":
+                out.append((ts, max(vals)))
+            elif agg == "mean":
+                out.append((ts, sum(vals) / len(vals)))
+            else:
+                out.append((ts, sum(vals)))
+        return out
+
+    def rate(self, name: str, labels: Optional[Dict[str, str]] = None,
+             window_s: float = 60.0) -> Optional[float]:
+        """Per-second rate of a (summed) counter over the window.
+        Delta-aware: a process restart (value drop) clamps to 0 rather
+        than reporting a negative rate. None until 2 samples exist."""
+        pts = self.series(name, labels, window_s)
+        if len(pts) < 2:
+            return None
+        (t0, v0), (t1, v1) = pts[0], pts[-1]
+        if t1 <= t0:
+            return None
+        return max(0.0, (v1 - v0) / (t1 - t0))
+
+    def mean(self, name: str, labels: Optional[Dict[str, str]] = None,
+             window_s: float = 60.0, agg: str = "max") -> Optional[float]:
+        """Time-mean of a gauge over the window (children reduced with
+        ``agg`` per sample — max by default: gauges are points and the
+        hottest child is usually the signal)."""
+        pts = self.series(name, labels, window_s, agg=agg)
+        if not pts:
+            return None
+        return sum(v for _t, v in pts) / len(pts)
+
+    def stats(self, name: str, labels: Optional[Dict[str, str]] = None,
+              window_s: float = 300.0, agg: str = "max"
+              ) -> Optional[Tuple[float, float, float, int]]:
+        """(mean, std, latest, n) of the agg'd series over the window."""
+        pts = self.series(name, labels, window_s, agg=agg)
+        if not pts:
+            return None
+        vals = [v for _t, v in pts]
+        n = len(vals)
+        mean = sum(vals) / n
+        var = sum((v - mean) ** 2 for v in vals) / n
+        return mean, var ** 0.5, vals[-1], n
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[Dict[str, str]] = None,
+                 window_s: float = 60.0) -> Optional[float]:
+        """Windowed histogram quantile from bucket deltas (matching
+        children summed), linear interpolation inside the bucket — the
+        `histogram_quantile()` estimate, but over the window only."""
+        meta = self._meta.get(name)
+        if meta is None or meta[0] != "histogram":
+            return None
+        _type, labelnames, buckets = meta
+        samples = self._window(window_s)
+        if len(samples) < 2:
+            return None
+
+        def _totals(data) -> Optional[List[float]]:
+            children = data.get(name)
+            if children is None:
+                return None
+            acc = [0.0] * (len(buckets) + 1)  # finite buckets + Inf
+            seen = False
+            for k, (counts, _s, count) in children.items():
+                if not self._match(k, labelnames, labels):
+                    continue
+                seen = True
+                for i, c in enumerate(counts):
+                    acc[i] += c
+                acc[-1] += count - sum(counts)  # +Inf overflow
+            return acc if seen else None
+
+        first = _totals(samples[0][1])
+        last = _totals(samples[-1][1])
+        if last is None:
+            return None
+        if first is None:
+            first = [0.0] * len(last)
+        deltas = [max(0.0, b - a) for a, b in zip(first, last)]
+        total = sum(deltas)
+        if total <= 0:
+            return None
+        target = q * total
+        cum = 0.0
+        lower = 0.0
+        for bound, d in zip(buckets, deltas):
+            if cum + d >= target and d > 0:
+                frac = (target - cum) / d
+                return lower + (bound - lower) * frac
+            cum += d
+            lower = bound
+        return buckets[-1]  # target landed in +Inf: clamp to last bound
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot_json(self, window_s: Optional[float] = None) -> Dict:
+        """Payload for GET /debug/history.json: every stored family's
+        series (label-string keyed), plus meta for the axes."""
+        samples = self._window(window_s)
+        series: Dict[str, Dict[str, List]] = {}
+        for ts, data in samples:
+            for name, children in data.items():
+                meta = self._meta.get(name)
+                if meta is None:
+                    continue
+                _type, labelnames, _buckets = meta
+                fam = series.setdefault(name, {})
+                for key, value in children.items():
+                    label_str = _render_labels(labelnames, key)
+                    if _type == "histogram":
+                        counts, total, count = value
+                        point = [round(ts, 3), count, total]
+                    else:
+                        point = [round(ts, 3), value]
+                    fam.setdefault(label_str, []).append(point)
+        return {
+            "interval_s": self.interval_s,
+            "window_s": self.window_s,
+            "samples": len(samples),
+            "span_s": round(samples[-1][0] - samples[0][0], 3)
+            if len(samples) >= 2 else 0.0,
+            "families": {
+                name: {"type": self._meta[name][0],
+                       "series": fam}
+                for name, fam in series.items()},
+        }
+
+
+# Process-wide store. Built from env on first ensure_started(); servers
+# call ensure_started() when they come up, so every instrumented process
+# has trends without any per-callsite wiring.
+HISTORY: Optional[MetricsHistory] = None
+_history_lock = threading.Lock()
+
+
+def get_history() -> Optional[MetricsHistory]:
+    return HISTORY
+
+
+def ensure_started() -> Optional[MetricsHistory]:
+    """Start (or restart, e.g. in a freshly forked worker) the sampler.
+    Returns None when disabled via PIO_METRICS_HISTORY=0."""
+    global HISTORY
+    if not _truthy(os.environ.get("PIO_METRICS_HISTORY"), default=True):
+        return None
+    with _history_lock:
+        if HISTORY is None:
+            HISTORY = MetricsHistory.from_env()
+        HISTORY.start()
+        return HISTORY
+
+
+def _reinit_after_fork() -> None:
+    # The sampler thread does not survive fork; inherited samples predate
+    # the child's own traffic. Start clean — the worker's server startup
+    # calls ensure_started() again.
+    global _history_lock
+    _history_lock = threading.Lock()
+    if HISTORY is not None:
+        HISTORY._lock = threading.Lock()
+        HISTORY._stop = threading.Event()
+        HISTORY._thread = None
+        HISTORY.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)

@@ -30,7 +30,7 @@ import time
 from typing import Type
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.telemetry import slo, spans, tracing
+from predictionio_tpu.telemetry import history, slo, spans, tracing
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -44,13 +44,17 @@ DEBUG_HEADER = "X-PIO-Debug"
 
 _DEBUG_LIST_ROUTE = "/debug/requests.json"
 _DEBUG_ONE_ROUTE = "/debug/requests/<trace_id>.json"
+_HISTORY_ROUTE = "/debug/history.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
     labelnames=("server", "method", "route", "status"))
+# Exemplared: each latency bucket keeps the trace id of the last request
+# that landed in it, so a regressed bucket on /metrics links straight to
+# /debug/requests/<trace_id>.json.
 HTTP_DURATION = REGISTRY.histogram(
     "http_request_duration_seconds", "HTTP request latency in seconds",
-    labelnames=("server", "route"))
+    labelnames=("server", "route"), exemplars=True)
 HTTP_IN_FLIGHT = REGISTRY.gauge(
     "http_in_flight", "Requests currently being handled",
     labelnames=("server",))
@@ -61,7 +65,7 @@ HTTP_ERRORS = REGISTRY.counter(
 # Template routes across all four servers: exact paths first, then prefix
 # templates. Anything else (scanner noise, typos) collapses to "<other>".
 _EXACT_ROUTES = frozenset({
-    "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE,
+    "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -122,11 +126,37 @@ def _in_flight(server: str):
     return child
 
 
-def serve_metrics(handler) -> None:
+# Per-server /metrics overrides: the supervisor's control endpoint swaps
+# in its fleet-merged renderer here, keeping every other server on the
+# default process-local exposition.
+_METRICS_RENDERERS: dict = {}
+
+
+def set_metrics_renderer(server_name: str, renderer) -> None:
+    """Install (renderer() -> str) for one server's /metrics; None clears."""
+    if renderer is None:
+        _METRICS_RENDERERS.pop(server_name, None)
+    else:
+        _METRICS_RENDERERS[server_name] = renderer
+
+
+def render_metrics(server_name: str = "") -> str:
+    renderer = _METRICS_RENDERERS.get(server_name)
+    if renderer is not None:
+        try:
+            return renderer()
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "metrics renderer for %s failed; serving process-local "
+                "view", server_name, exc_info=True)
     # slo_* gauges are windowed views; recompute at scrape so the rendered
     # burn rates always reflect the current 5m/1h windows.
     slo.refresh()
-    body = REGISTRY.render().encode()
+    return REGISTRY.render()
+
+
+def serve_metrics(handler) -> None:
+    body = render_metrics(getattr(handler, "pio_server_name", "")).encode()
     handler.send_response(200)
     handler.send_header("Content-Type", METRICS_CONTENT_TYPE)
     handler.send_header("Content-Length", str(len(body)))
@@ -174,6 +204,28 @@ def _debug_request_by_id_payload(path: str) -> tuple:
     return 200, entry
 
 
+def _history_payload(raw_target: str) -> tuple:
+    """GET /debug/history.json?window= — the metrics-history store."""
+    hist = history.get_history()
+    if hist is None:
+        return 503, {"error": "metrics history disabled "
+                              "(PIO_METRICS_HISTORY=0)"}
+    params = parse_qs(urlparse(raw_target).query)
+    window_s = None
+    vals = params.get("window")
+    if vals:
+        try:
+            window_s = float(vals[0])
+        except ValueError:
+            return 400, {"error": "window must be seconds"}
+    return 200, hist.snapshot_json(window_s)
+
+
+def serve_debug_history(handler, raw_path: str) -> None:
+    status, obj = _history_payload(raw_path)
+    _serve_json(handler, obj, status=status)
+
+
 def serve_debug_requests(handler, raw_path: str) -> None:
     status, obj = _debug_requests_payload(raw_path)
     _serve_json(handler, obj, status=status)
@@ -194,7 +246,7 @@ def _run_instrumented(self, http_method: str, orig) -> None:
     self._pio_status = None
     # Introspection routes are not themselves flight-recorded: a scrape
     # loop would otherwise flush the sampled ring with its own traffic.
-    introspect = path == "/metrics" or path.startswith("/debug/requests")
+    introspect = path == "/metrics" or path.startswith("/debug/")
     tl = tl_token = None
     if not introspect:
         tl, tl_token = spans.begin(server, route, http_method, ctx.trace_id)
@@ -209,6 +261,8 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_metrics(self)
         elif http_method == "GET" and path == _DEBUG_LIST_ROUTE:
             serve_debug_requests(self, self.path)
+        elif http_method == "GET" and path == _HISTORY_ROUTE:
+            serve_debug_history(self, self.path)
         elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
             serve_debug_request_by_id(self, path)
         elif "jax" in sys.modules:
@@ -263,6 +317,7 @@ def _run_instrumented(self, http_method: str, orig) -> None:
 
 def instrument(handler_cls: Type, server_name: str) -> Type:
     """Build an instrumented subclass of a BaseHTTPRequestHandler class."""
+    history.ensure_started()
 
     def make_wrapper(method_name: str, orig):
         http_method = method_name[3:]
@@ -359,7 +414,7 @@ def run_route(server: str, req, route, instrument: bool = True) -> tuple:
     route_tmpl = route.template
     ctx, inbound = tracing.context_from_headers(req.headers)
     token = tracing.activate(ctx)
-    introspect = path == "/metrics" or path.startswith("/debug/requests")
+    introspect = path == "/metrics" or path.startswith("/debug/")
     tl = tl_token = None
     if not introspect:
         tl, tl_token = spans.begin(server, route_tmpl, req.method,
@@ -451,8 +506,7 @@ def record_parse_layer(server: str, verb: str, status: int) -> str:
 def _metrics_route(req):
     from predictionio_tpu.utils import routing
 
-    slo.refresh()
-    return routing.Response(200, body=REGISTRY.render().encode(),
+    return routing.Response(200, body=render_metrics().encode(),
                             content_type=METRICS_CONTENT_TYPE)
 
 
@@ -470,10 +524,20 @@ def _debug_one_route(req):
     return routing.Response.json(status, obj)
 
 
+def _history_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _history_payload(req.target)
+    return routing.Response.json(status, obj)
+
+
 def register_builtin_routes(router) -> None:
-    """Every routed service exposes /metrics and the flight-recorder
-    debug routes, same as instrument() guarantees for handler classes."""
+    """Every routed service exposes /metrics, the flight-recorder debug
+    routes, and the metrics-history dump, same as instrument()
+    guarantees for handler classes."""
+    history.ensure_started()
     router.get("/metrics", _metrics_route)
     router.get(_DEBUG_LIST_ROUTE, _debug_list_route)
+    router.get(_HISTORY_ROUTE, _history_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
                       template=_DEBUG_ONE_ROUTE)

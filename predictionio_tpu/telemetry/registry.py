@@ -28,6 +28,24 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 
 _INF = float("inf")
 
+# Exemplar capture is opt-in per family (`exemplars=True`) and can be
+# globally vetoed; resolved once at family creation so observe() pays
+# nothing for the knob.
+_EXEMPLARS_ENABLED = os.environ.get("PIO_METRICS_EXEMPLARS", "1") not in (
+    "0", "false", "off", "no")
+
+_current_trace_id = None
+
+
+def _exemplar_trace_id() -> Optional[str]:
+    # Lazy import: registry must stay importable before the telemetry
+    # package finishes initialising (tracing itself is dependency-free).
+    global _current_trace_id
+    if _current_trace_id is None:
+        from predictionio_tpu.telemetry.tracing import current_trace_id
+        _current_trace_id = current_trace_id
+    return _current_trace_id()
+
 
 def _format_value(v: float) -> str:
     if v == _INF:
@@ -80,27 +98,45 @@ class _Child:
 
 
 class _HistogramChild:
-    """One labelled histogram series: cumulative bucket counts + sum."""
+    """One labelled histogram series: cumulative bucket counts + sum.
 
-    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+    With `with_exemplars`, each bucket (the implicit +Inf one included)
+    keeps the last (trace_id, value, unix_ts) that landed in it, rendered
+    in OpenMetrics exemplar syntax so a regressed bucket links straight
+    to a captured trace in `/debug/requests/<trace_id>.json`."""
 
-    def __init__(self, lock: threading.Lock, buckets: Tuple[float, ...]):
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "exemplars")
+
+    def __init__(self, lock: threading.Lock, buckets: Tuple[float, ...],
+                 with_exemplars: bool = False):
         self._lock = lock
         self.buckets = buckets
         self.counts = [0] * len(buckets)  # per-bucket (non-cumulative) counts
         self.sum = 0.0
         self.count = 0
+        # one slot per bucket plus the +Inf slot; None until exemplared
+        self.exemplars = ([None] * (len(buckets) + 1)
+                          if with_exemplars else None)
 
     def observe(self, value: float) -> None:
+        exemplar = None
+        if self.exemplars is not None:
+            trace_id = _exemplar_trace_id()
+            if trace_id is not None:
+                exemplar = (trace_id, value, time.time())
         with self._lock:
             self.sum += value
             self.count += 1
+            slot = len(self.buckets)  # +Inf unless a finite bound catches it
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self.counts[i] += 1
+                    slot = i
                     break
             # above the last finite bound → only the implicit +Inf bucket,
             # which is rendered as `count` (always cumulative-total)
+            if exemplar is not None:
+                self.exemplars[slot] = exemplar
 
 
 class _MetricFamily:
@@ -171,12 +207,14 @@ class Histogram(_MetricFamily):
     """Histogram family with fixed bucket boundaries (seconds by default)."""
 
     def __init__(self, name: str, help: str, labelnames: Sequence[str] = (),
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 exemplars: bool = False):
         super().__init__(name, help, labelnames, "histogram")
         bl = tuple(sorted(float(b) for b in buckets))
         if not bl:
             raise ValueError("histogram needs at least one bucket")
         self.buckets = bl
+        self.exemplars = bool(exemplars) and _EXEMPLARS_ENABLED
 
     def labels(self, **labelkw: str) -> _HistogramChild:
         key = self._key(labelkw)
@@ -184,7 +222,7 @@ class Histogram(_MetricFamily):
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = _HistogramChild(
-                    self._lock, self.buckets)
+                    self._lock, self.buckets, with_exemplars=self.exemplars)
         return child
 
     def observe(self, value: float) -> None:
@@ -201,6 +239,13 @@ class Histogram(_MetricFamily):
         with self._lock:
             return [(k, (list(c.counts), c.sum, c.count))
                     for k, c in self._children.items()]
+
+    def collect_exemplars(self):
+        """[(labelvalues, [exemplar-or-None per bucket, +Inf last])] for
+        children that have recorded at least one exemplar."""
+        with self._lock:
+            return [(k, list(c.exemplars)) for k, c in self._children.items()
+                    if c.exemplars is not None and any(c.exemplars)]
 
 
 class _Timer:
@@ -250,34 +295,44 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  exemplars: bool = False) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                                   buckets=buckets, exemplars=exemplars)
 
     def get(self, name: str) -> Optional[_MetricFamily]:
         with self._lock:
             return self._metrics.get(name)
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
-        lines: list[str] = []
+    def families(self) -> list:
+        """All registered families, name-sorted (stable scrape order)."""
         with self._lock:
-            families = sorted(self._metrics.values(), key=lambda m: m.name)
-        for m in families:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 (bucket lines may carry
+        OpenMetrics `# {trace_id="…"} value ts` exemplar suffixes)."""
+        lines: list[str] = []
+        for m in self.families():
             lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.type}")
             if isinstance(m, Histogram):
+                exemplars = (dict(m.collect_exemplars())
+                             if m.exemplars else {})
                 for key, (counts, total, count) in sorted(m.collect()):
+                    child_ex = exemplars.get(key)
                     cum = 0
-                    for bound, n in zip(m.buckets, counts):
+                    for i, (bound, n) in enumerate(zip(m.buckets, counts)):
                         cum += n
                         labels = _render_labels(
                             m.labelnames, key,
                             extra=[("le", _format_value(bound))])
-                        lines.append(f"{m.name}_bucket{labels} {cum}")
+                        suffix = _render_exemplar(child_ex, i)
+                        lines.append(f"{m.name}_bucket{labels} {cum}{suffix}")
                     inf_labels = _render_labels(m.labelnames, key,
                                                 extra=[("le", "+Inf")])
-                    lines.append(f"{m.name}_bucket{inf_labels} {count}")
+                    suffix = _render_exemplar(child_ex, len(m.buckets))
+                    lines.append(f"{m.name}_bucket{inf_labels} {count}{suffix}")
                     labels = _render_labels(m.labelnames, key)
                     lines.append(f"{m.name}_sum{labels} {_format_value(total)}")
                     lines.append(f"{m.name}_count{labels} {count}")
@@ -288,30 +343,136 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def _render_exemplar(child_exemplars, slot: int) -> str:
+    if not child_exemplars:
+        return ""
+    ex = child_exemplars[slot]
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{_escape_label_value(str(trace_id))}"}} '
+            f"{_format_value(value)} {ts:.3f}")
+
+
+def _scan_label_block(s: str, start: int) -> int:
+    """Index just past the `}` matching the `{` at `start`, honouring
+    quoted label values with backslash escapes; -1 when unterminated."""
+    i = start + 1
+    in_quotes = False
+    while i < len(s):
+        c = s[i]
+        if in_quotes:
+            if c == "\\":
+                i += 1
+            elif c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == "}":
+            return i + 1
+        i += 1
+    return -1
+
+
+def _split_series_line(line: str) -> Optional[Tuple[str, str, str]]:
+    """One sample line → (name, raw_label_block, rest-after-labels).
+
+    The label block is scanned quote-aware, so escaped quotes, spaces,
+    and `#` inside label values don't confuse the split."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        end = _scan_label_block(line, brace)
+        if end < 0:
+            return None
+        return line[:brace], line[brace:end], line[end:].lstrip()
+    name, _, rest = line.partition(" ")
+    return name, "", rest.lstrip()
+
+
+def _parse_label_pairs(block: str) -> Dict[str, str]:
+    """`{k="v",…}` → {k: v} with `\\"`/`\\n`/`\\\\` unescaped."""
+    out: Dict[str, str] = {}
+    i = 1  # past "{"
+    while i < len(block) - 1:
+        eq = block.find('="', i)
+        if eq < 0:
+            break
+        key = block[i:eq].lstrip(",").strip()
+        j = eq + 2
+        chars: list[str] = []
+        while j < len(block):
+            c = block[j]
+            if c == "\\" and j + 1 < len(block):
+                nxt = block[j + 1]
+                chars.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            chars.append(c)
+            j += 1
+        out[key] = "".join(chars)
+        i = j + 1
+    return out
+
+
 def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
     """Parse exposition text into {metric_name: {label_string: value}}.
 
-    Minimal inverse of render() for tests and bench snapshots — handles
-    the subset render() emits (no escapes inside parsed label values
-    beyond the literal text)."""
+    Minimal inverse of render() for tests and bench snapshots: histogram
+    series appear under their `_bucket`/`_sum`/`_count` names, escaped
+    label values survive verbatim in the label string, and OpenMetrics
+    exemplar suffixes (`… # {trace_id="…"} v ts`) are ignored here (use
+    `parse_exemplars` to read them)."""
     out: Dict[str, Dict[str, float]] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        name_part, _, value_part = line.rpartition(" ")
-        if not name_part:
+        split = _split_series_line(line)
+        if split is None:
             continue
-        if "{" in name_part:
-            name, rest = name_part.split("{", 1)
-            labels = "{" + rest
-        else:
-            name, labels = name_part, ""
+        name, labels, rest = split
+        if not name or not rest:
+            continue
         try:
-            value = float(value_part)
+            value = float(rest.split(" ", 1)[0])
         except ValueError:
             continue
         out.setdefault(name, {})[labels] = value
+    return out
+
+
+def parse_exemplars(text: str) -> Dict[str, Dict[str, object]]:
+    """Exemplars from exposition text: {series (name+labels):
+    {"labels": {…}, "value": float, "timestamp": float|None}}."""
+    out: Dict[str, Dict[str, object]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        split = _split_series_line(line)
+        if split is None:
+            continue
+        name, labels, rest = split
+        _value, _, suffix = rest.partition(" # ")
+        suffix = suffix.strip()
+        if not suffix.startswith("{"):
+            continue
+        end = _scan_label_block(suffix, 0)
+        if end < 0:
+            continue
+        tail = suffix[end:].split()
+        if not tail:
+            continue
+        try:
+            ex_value = float(tail[0])
+            ex_ts = float(tail[1]) if len(tail) > 1 else None
+        except ValueError:
+            continue
+        out[name + labels] = {"labels": _parse_label_pairs(suffix[:end]),
+                              "value": ex_value, "timestamp": ex_ts}
     return out
 
 

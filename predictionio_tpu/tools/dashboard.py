@@ -13,6 +13,7 @@ import json
 from typing import Optional
 
 from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.telemetry import history as metrics_history
 from predictionio_tpu.telemetry import slo
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY, Histogram
@@ -42,6 +43,17 @@ budget exactly at the rate that exhausts it; &gt;14 on the 5m window is
 page-now territory). Raw families: <code>slo_*</code> on
 <a href="/metrics">/metrics</a>.</p>
 {slo}
+<h2>Alerts</h2>
+<p>Watchdog rules evaluated against the metrics history (enable with
+<code>PIO_ALERTS=1</code>; rule syntax in
+<code>docs/observability.md</code>). Firing/resolve edges are written to
+the event store as <code>$alert</code> events; raw families:
+<code>alert_*</code> on <a href="/metrics">/metrics</a>.</p>
+{alerts}
+<h2>Metrics history</h2>
+<p>Last ~2 minutes of the in-process ring-buffer store (full series at
+<a href="/debug/history.json">/debug/history.json</a>).</p>
+{history}
 <h2>Supervisor</h2>
 <p>Worker-pool control plane: restarts by cause, autoscaler decisions,
 rolling-deploy drains and per-slot circuit breakers. The live per-worker
@@ -140,6 +152,102 @@ def _slo_table() -> str:
             f"<td>{r['error_ratio']:.5f}</td>"
             f"<td style='color:{color}'>{burn:.2f}</td></tr>"
         )
+    out.append("</table>")
+    return "".join(out)
+
+
+def _alerts_table(registry=REGISTRY) -> str:
+    """One row per loaded alert rule with its live state, assembled from
+    the alert_* families (the same data a scrape sees)."""
+    rules = registry.get("alert_rules")
+    if rules is None or not list(rules.collect()):
+        return ("<p>No alert rules loaded (start a server with "
+                "<code>PIO_ALERTS=1</code>).</p>")
+
+    def _by_rule(name):
+        m = registry.get(name)
+        out = {}
+        if m is not None:
+            for key, value in m.collect():
+                out[dict(zip(m.labelnames, key)).get("rule", "")] = value
+        return out
+
+    active = _by_rule("alert_active")
+    last = _by_rule("alert_last_value")
+    fired = _by_rule("alert_fired_total")
+    resolved = _by_rule("alert_resolved_total")
+    out = ["<table><tr><th>Rule</th><th>Kind</th><th>Severity</th>"
+           "<th>State</th><th>Last value</th><th>Fired</th>"
+           "<th>Resolved</th></tr>"]
+    for key, _v in sorted(rules.collect()):
+        kv = dict(zip(rules.labelnames, key))
+        rule = kv.get("rule", "")
+        is_active = active.get(rule, 0) >= 1
+        state = ("<span style='color:#ba000d'>FIRING</span>" if is_active
+                 else "<span style='color:#087f23'>ok</span>")
+        lv = last.get(rule)
+        out.append(
+            f"<tr><td>{html.escape(rule)}</td>"
+            f"<td>{html.escape(kv.get('kind', ''))}</td>"
+            f"<td>{html.escape(kv.get('severity', ''))}</td>"
+            f"<td>{state}</td>"
+            f"<td>{'—' if lv is None else f'{lv:.4g}'}</td>"
+            f"<td>{fired.get(rule, 0):g}</td>"
+            f"<td>{resolved.get(rule, 0):g}</td></tr>"
+        )
+    out.append("</table>")
+    return "".join(out)
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(values)
+    return "".join(_SPARK_CHARS[min(7, int((v - lo) / span * 8))]
+                   for v in values)
+
+
+def _history_section() -> str:
+    """Unicode sparklines over the history store's recent window —
+    counters as per-interval rates, gauges as raw points."""
+    hist = metrics_history.get_history()
+    if hist is None:
+        return ("<p>Metrics history not running in this process "
+                "(<code>PIO_METRICS_HISTORY=0</code>, or no instrumented "
+                "server started).</p>")
+    specs = [
+        ("http requests /s", "http_requests_total", "counter", None),
+        ("serving queries /s", "http_requests_total", "counter",
+         {"route": "/queries.json"}),
+        ("SLO burn (hottest window)", "slo_error_budget_burn_rate",
+         "gauge", None),
+        ("http in-flight", "http_in_flight", "gauge", None),
+    ]
+    rows = []
+    for label, name, kind, labels in specs:
+        agg = "sum" if kind == "counter" else "max"
+        pts = hist.series(name, labels=labels, window_s=120.0, agg=agg)
+        if len(pts) < 2:
+            continue
+        if kind == "counter":
+            vals = [max(0.0, (v1 - v0) / (t1 - t0))
+                    for (t0, v0), (t1, v1) in zip(pts, pts[1:]) if t1 > t0]
+        else:
+            vals = [v for _t, v in pts]
+        vals = vals[-60:]
+        if vals:
+            rows.append((label, _sparkline(vals), vals[-1]))
+    if not rows:
+        return "<p>No sampled series yet.</p>"
+    out = ["<table><tr><th>Series</th><th>Trend</th><th>Latest</th></tr>"]
+    for label, spark, latest in rows:
+        out.append(f"<tr><td>{html.escape(label)}</td>"
+                   f"<td><code>{spark}</code></td>"
+                   f"<td>{latest:.3g}</td></tr>")
     out.append("</table>")
     return "".join(out)
 
@@ -335,6 +443,8 @@ class Dashboard(HttpService):
                     evals=_eval_table(evals),
                     instances=_instance_table(instances),
                     slo=_slo_table(),
+                    alerts=_alerts_table(),
+                    history=_history_section(),
                     supervisor=_supervisor_table(),
                     flight=_flight_table(),
                     experiment=_experiment_table(),

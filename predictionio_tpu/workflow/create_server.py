@@ -227,6 +227,25 @@ class PredictionServer(HttpService):
             self.serving = self._planes[self._primary_variant]
         self._worker_pid = worker_pid
 
+        # Alert watchdog (opt-in, PIO_ALERTS=1): rules run against the
+        # metrics history; firing/resolve edges become $alert events
+        # through a dedicated group-commit writer into the event store.
+        from predictionio_tpu.ingest import GroupCommitWriter, IngestConfig
+        from predictionio_tpu.telemetry import alerts
+        from predictionio_tpu.telemetry import history as metrics_history
+        self._alert_writer: Optional[GroupCommitWriter] = None
+        self.watchdog = alerts.AlertWatchdog.from_env(
+            metrics_history.ensure_started(), source="predictionserver")
+        if self.watchdog is not None:
+            le = self.storage.l_events()
+            self._alert_writer = GroupCommitWriter(
+                insert_fn=le.insert, grouped_fn=le.insert_grouped,
+                config=IngestConfig.from_env(), name="alerts")
+            self.watchdog.emit = alerts.ingest_emitter(
+                self._alert_writer,
+                app_id=int(os.environ.get("PIO_ALERT_APP_ID", "0")))
+            self.watchdog.start()
+
         # Route dispatch table, registered once at construction. The
         # query/reload/stop handlers block (device dispatch, storage
         # load), so the event loop runs them on its worker pool.
@@ -406,6 +425,10 @@ class PredictionServer(HttpService):
         super().shutdown()
         if self._tailer is not None:
             self._tailer.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self._alert_writer is not None:
+            self._alert_writer.close()
         self.serving.close()
 
     def health_check(self) -> bool:

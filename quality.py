@@ -22,10 +22,15 @@ def main():
     p.add_argument("--telemetry-gate", action="store_true",
                    help="run the observability CI gate (no jax, no data): "
                         "fails if any in-package HTTP surface bypasses the "
-                        "telemetry middleware, or if an admitted "
+                        "telemetry middleware, if an admitted "
                         "/queries.json or /events.json request produces a "
                         "flight-recorder timeline without its admission "
-                        "and dispatch/commit spans (runtime drill)")
+                        "and dispatch/commit spans, if the alert_* "
+                        "families fail to render under a watchdog, or if "
+                        "a 4-worker pool drill's supervisor /metrics "
+                        "counter totals differ from the sum of the "
+                        "per-worker registries (fleet-aggregation drill, "
+                        "history sampling held under the 5% overhead bar)")
     p.add_argument("--serving-gate", action="store_true",
                    help="run the serving CI gate (no jax, no data): fails "
                         "if any predict route bypasses admission control / "

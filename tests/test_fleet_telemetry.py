@@ -1,0 +1,670 @@
+"""Fleet-true telemetry (ISSUE 9): the metrics-history ring store,
+cross-worker snapshot/merge aggregation, exemplar render/parse round
+trips, the alert watchdog (rules, $alert events through the real ingest
+funnel), the supervisor's smoothed autoscaler, and the acceptance
+scenario — an induced latency fault firing an alert whose exemplar
+trace id resolves to a flight-recorder timeline. The live 4-worker pool
+drill runs under `-m slow` (the telemetry gate runs it in CI)."""
+
+import http.client
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from predictionio_tpu.telemetry import aggregate, alerts, tracing
+from predictionio_tpu.telemetry import registry as registry_mod
+from predictionio_tpu.telemetry.history import MetricsHistory
+from predictionio_tpu.telemetry.registry import (
+    REGISTRY,
+    MetricsRegistry,
+    parse_exemplars,
+    parse_prometheus,
+)
+from predictionio_tpu.utils import faults
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    yield
+    monkeypatch.delenv("PIO_FAULTS", raising=False)
+    faults._parse()
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+# -- metrics history ---------------------------------------------------------
+
+class TestMetricsHistory:
+    def test_counter_series_and_rate(self):
+        reg = MetricsRegistry()
+        c = reg.counter("http_requests_total", "t")
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=120)
+        for t in range(6):
+            c.inc(10)
+            hist.sample_now(now=1000.0 + t)
+        pts = hist.series("http_requests_total")
+        assert len(pts) == 6
+        assert pts[0] == (1000.0, 10.0) and pts[-1] == (1005.0, 60.0)
+        # 50 increments over 5 seconds
+        assert hist.rate("http_requests_total", window_s=60) == \
+            pytest.approx(10.0)
+
+    def test_rate_clamps_restart_to_zero(self):
+        reg = MetricsRegistry()
+        c = reg.counter("http_requests_total", "t")
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=120)
+        c.inc(100)
+        hist.sample_now(now=1000.0)
+        # simulate a worker restart: the cumulative value drops
+        with c._lock:
+            for child in c._children.values():
+                child._value = 0.0
+        c.inc(5)
+        hist.sample_now(now=1001.0)
+        assert hist.rate("http_requests_total", window_s=60) == 0.0
+
+    def test_gauge_mean_and_stats(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("serving_queue_depth", "t")
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=120)
+        for t, v in enumerate((2.0, 4.0, 6.0)):
+            g.set(v)
+            hist.sample_now(now=1000.0 + t)
+        assert hist.mean("serving_queue_depth", window_s=60) == \
+            pytest.approx(4.0)
+        mean, std, latest, n = hist.stats("serving_queue_depth",
+                                          window_s=60)
+        assert (mean, latest, n) == (pytest.approx(4.0), 6.0, 3)
+        assert std == pytest.approx((8 / 3) ** 0.5)
+        assert hist.mean("serving_queue_depth", window_s=60,
+                         labels={"no": "match"}) is None
+
+    def test_histogram_windowed_quantile(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("http_request_duration_seconds", "t",
+                          buckets=(0.1, 1.0))
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=120)
+        # 100 old observations that must NOT leak into the window
+        for _ in range(100):
+            h.observe(0.99)
+        hist.sample_now(now=1000.0)
+        for _ in range(10):
+            h.observe(0.05)
+        hist.sample_now(now=1001.0)
+        # only the 10 in-window deltas count: all ≤0.1, p50 interpolates
+        # to the middle of the first bucket
+        assert hist.quantile("http_request_duration_seconds", 0.5,
+                             window_s=60) == pytest.approx(0.05)
+        assert hist.quantile("http_request_duration_seconds", 0.5,
+                             window_s=0.5) is None  # <2 samples in window
+
+    def test_prefix_filter_and_ring_bound(self):
+        reg = MetricsRegistry()
+        reg.counter("http_requests_total", "t").inc()
+        reg.counter("unrelated_total", "t").inc()
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=5)
+        for t in range(20):
+            hist.sample_now(now=1000.0 + t)
+        assert hist.series("unrelated_total") == []
+        # ring bounded at window_s / interval_s (+2 slack), not 20
+        assert len(hist.series("http_requests_total")) <= 7
+
+    def test_snapshot_json_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("http_requests_total", "t",
+                    labelnames=("route",)).labels(route="/q").inc(3)
+        h = reg.histogram("http_request_duration_seconds", "t",
+                          buckets=(0.1,))
+        h.observe(0.05)
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=60)
+        hist.sample_now(now=1000.0)
+        hist.sample_now(now=1001.0)
+        snap = hist.snapshot_json()
+        assert snap["samples"] == 2 and snap["span_s"] == 1.0
+        fams = snap["families"]
+        ctr = fams["http_requests_total"]
+        assert ctr["type"] == "counter"
+        assert ctr["series"]['{route="/q"}'] == [[1000.0, 3.0],
+                                                 [1001.0, 3.0]]
+        # histogram points are [ts, count, sum]
+        hpts = fams["http_request_duration_seconds"]["series"][""]
+        assert hpts == [[1000.0, 1, 0.05], [1001.0, 1, 0.05]]
+
+
+# -- snapshot / merge aggregation --------------------------------------------
+
+def _snap(reg, worker):
+    return aggregate.snapshot_registry(reg, worker=worker, refresh=False)
+
+
+class TestAggregation:
+    def test_counters_sum_exactly(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        for reg, n in ((r1, 3), (r2, 4)):
+            reg.counter("http_requests_total", "t",
+                        labelnames=("route",)).labels(route="/q").inc(n)
+        merged = aggregate.merge_snapshots(
+            [_snap(r1, "w1"), _snap(r2, "w2")])
+        fam = merged["families"]["http_requests_total"]
+        assert fam["children"] == {("/q",): 7.0}
+        parsed = parse_prometheus(aggregate.render_merged(merged))
+        assert parsed["http_requests_total"]['{route="/q"}'] == 7.0
+
+    def test_gauges_get_worker_label(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.gauge("serving_queue_depth", "t").set(2)
+        r2.gauge("serving_queue_depth", "t").set(5)
+        merged = aggregate.merge_snapshots(
+            [_snap(r1, "w1"), _snap(r2, "w2")])
+        fam = merged["families"]["serving_queue_depth"]
+        assert fam["labelnames"] == ("worker",)
+        assert fam["children"] == {("w1",): 2.0, ("w2",): 5.0}
+        text = aggregate.render_merged(merged)
+        assert 'serving_queue_depth{worker="w1"} 2' in text
+        assert 'serving_queue_depth{worker="w2"} 5' in text
+
+    def test_histogram_buckets_merge(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        for reg, vals in ((r1, (0.05, 0.5)), (r2, (0.05, 5.0))):
+            h = reg.histogram("lat_seconds", "t", buckets=(0.1, 1.0))
+            for v in vals:
+                h.observe(v)
+        merged = aggregate.merge_snapshots(
+            [_snap(r1, "w1"), _snap(r2, "w2")])
+        counts, total, count = \
+            merged["families"]["lat_seconds"]["children"][()]
+        assert counts == [2, 1] and count == 4
+        assert total == pytest.approx(5.6)
+        parsed = parse_prometheus(aggregate.render_merged(merged))
+        assert parsed["lat_seconds_bucket"]['{le="0.1"}'] == 2.0
+        assert parsed["lat_seconds_bucket"]['{le="1"}'] == 3.0
+        assert parsed["lat_seconds_bucket"]['{le="+Inf"}'] == 4.0
+        assert parsed["lat_seconds_count"][""] == 4.0
+
+    def test_exemplar_merge_keeps_newest(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        for reg, tid in ((r1, "traceold0001"), (r2, "tracenew0001")):
+            h = reg.histogram("lat_seconds", "t", buckets=(1.0,),
+                              exemplars=True)
+            with tracing.trace(tid):
+                h.observe(0.5)
+            time.sleep(0.01)  # distinct exemplar timestamps
+        merged = aggregate.merge_snapshots(
+            [_snap(r1, "w1"), _snap(r2, "w2")])
+        ex = parse_exemplars(aggregate.render_merged(merged))
+        assert ex['lat_seconds_bucket{le="1"}']["labels"] == \
+            {"trace_id": "tracenew0001"}
+
+    def test_reset_inherited_counters(self):
+        reg = MetricsRegistry()
+        reg.counter("http_requests_total", "t").inc(9)
+        h = reg.histogram("lat_seconds", "t", buckets=(1.0,),
+                          exemplars=True)
+        with tracing.trace("tracegone001"):
+            h.observe(0.5)
+        reg.gauge("serving_queue_depth", "t").set(7)
+        reg.counter("supervisor_restarts_total", "t").inc(3)
+        aggregate.reset_inherited_counters(reg)
+        text = reg.render()
+        assert "http_requests_total 0" in text
+        assert "lat_seconds_count 0" in text
+        assert "tracegone001" not in text
+        assert "serving_queue_depth 7" in text  # gauges survive the fork
+        assert "supervisor_restarts_total 3" not in text  # dropped outright
+
+    def test_snapshot_server_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("http_requests_total", "t").inc(5)
+        srv = aggregate.SnapshotServer(reg)
+        try:
+            snap = aggregate.fetch_snapshot(srv.port)
+        finally:
+            srv.close()
+        assert aggregate.counter_totals(snap, "http_requests_total") == 5.0
+        assert snap["pid"] > 0 and snap["worker"]
+
+    def test_counter_totals_label_filter(self):
+        reg = MetricsRegistry()
+        c = reg.counter("http_requests_total", "t",
+                        labelnames=("route", "status"))
+        c.labels(route="/queries.json", status="200").inc(7)
+        c.labels(route="/queries.json", status="503").inc(2)
+        c.labels(route="/events.json", status="201").inc(5)
+        snap = _snap(reg, "w1")
+        assert aggregate.counter_totals(snap, "http_requests_total") == 14.0
+        assert aggregate.counter_totals(
+            snap, "http_requests_total",
+            where={"route": "/queries.json"}) == 9.0
+        assert aggregate.counter_totals(
+            snap, "http_requests_total", where={"route": "/nope"}) == 0.0
+
+    def test_worker_label_from_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_METRICS_WORKER_LABEL", "slot7")
+        try:
+            assert aggregate.worker_label() == "slot7"
+            aggregate.refresh_worker_info()
+            assert [k for k, _v in aggregate.WORKER_INFO.collect()] == \
+                [("slot7",)]
+        finally:
+            monkeypatch.undo()
+            aggregate.refresh_worker_info()
+        assert aggregate.worker_label().startswith("pid")
+
+
+# -- exposition round trips (satellite: parse_prometheus) --------------------
+
+class TestExpositionRoundTrip:
+    def test_histogram_family_roundtrip(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "t", buckets=(0.1, 1.0),
+                          labelnames=("route",))
+        for v in (0.05, 0.5, 5.0):
+            h.labels(route="/q").observe(v)
+        parsed = parse_prometheus(reg.render())
+        assert parsed["lat_seconds_bucket"]['{route="/q",le="0.1"}'] == 1.0
+        assert parsed["lat_seconds_bucket"]['{route="/q",le="1"}'] == 2.0
+        assert parsed["lat_seconds_bucket"]['{route="/q",le="+Inf"}'] == 3.0
+        assert parsed["lat_seconds_sum"]['{route="/q"}'] == \
+            pytest.approx(5.55)
+        assert parsed["lat_seconds_count"]['{route="/q"}'] == 3.0
+
+    def test_escaped_label_values_roundtrip(self):
+        reg = MetricsRegistry()
+        hostile = 'a"b\\c\nd,e={}'
+        reg.counter("esc_total", "t",
+                    labelnames=("p",)).labels(p=hostile).inc(2)
+        parsed = parse_prometheus(reg.render())
+        # the quote/backslash/newline-laden value must neither split the
+        # line nor shadow other children
+        (labels, value), = parsed["esc_total"].items()
+        assert value == 2.0
+        assert labels == '{p="a\\"b\\\\c\\nd,e={}"}'
+
+    def test_exemplar_render_and_parse(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "t", buckets=(1.0,),
+                          exemplars=True)
+        before = time.time()
+        with tracing.trace("traceabc0001"):
+            h.observe(0.5)
+        text = reg.render()
+        assert '# {trace_id="traceabc0001"} 0.5' in text
+        # the exemplar suffix must not confuse the value parser...
+        parsed = parse_prometheus(text)
+        assert parsed["lat_seconds_bucket"]['{le="1"}'] == 1.0
+        # ...and parse_exemplars reads it back, timestamp included
+        ex = parse_exemplars(text)['lat_seconds_bucket{le="1"}']
+        assert ex["labels"] == {"trace_id": "traceabc0001"}
+        assert ex["value"] == 0.5
+        # the timestamp renders at millisecond precision — allow the round
+        assert before - 0.001 <= ex["timestamp"] <= time.time() + 0.001
+
+    def test_no_exemplar_without_trace_or_optin(self, monkeypatch):
+        reg = MetricsRegistry()
+        h = reg.histogram("plain_seconds", "t", buckets=(1.0,))
+        with tracing.trace("tracenope001"):
+            h.observe(0.5)  # family did not opt in
+        hx = reg.histogram("traced_seconds", "t", buckets=(1.0,),
+                           exemplars=True)
+        hx.observe(0.5)  # no active trace
+        assert " # {" not in reg.render()
+        # the global veto wins over the per-family opt-in
+        monkeypatch.setattr(registry_mod, "_EXEMPLARS_ENABLED", False)
+        reg2 = MetricsRegistry()
+        hv = reg2.histogram("vetoed_seconds", "t", buckets=(1.0,),
+                            exemplars=True)
+        with tracing.trace("tracevetoed1"):
+            hv.observe(0.5)
+        assert " # {" not in reg2.render()
+
+
+# -- alert watchdog ----------------------------------------------------------
+
+def _depth_history(values, name="serving_queue_depth"):
+    """A history whose gauge series is exactly `values`, 1s apart."""
+    reg = MetricsRegistry()
+    g = reg.gauge(name, "t")
+    hist = MetricsHistory(reg, interval_s=1.0, window_s=600)
+    for t, v in enumerate(values):
+        g.set(v)
+        hist.sample_now(now=1000.0 + t)
+    return reg, g, hist
+
+
+class TestAlertWatchdog:
+    def test_threshold_fires_then_resolves(self):
+        reg, g, hist = _depth_history([10.0, 10.0, 10.0])
+        rule = alerts.AlertRule(name="depth-high",
+                                metric="serving_queue_depth",
+                                stat="mean", op=">", value=5.0,
+                                window_s=60.0)
+        dog = alerts.AlertWatchdog(hist, [rule], interval_s=0.1)
+        fired = dog.evaluate_once(now=2000.0)
+        assert [(t["rule"], t["status"]) for t in fired] == \
+            [("depth-high", "firing")]
+        assert fired[0]["value"] == pytest.approx(10.0)
+        assert dog.evaluate_once(now=2001.0) == []  # no edge re-fire
+        hist.clear()
+        g.set(0.0)
+        hist.sample_now(now=2002.0)
+        resolved = dog.evaluate_once(now=2003.0)
+        assert [(t["rule"], t["status"]) for t in resolved] == \
+            [("depth-high", "resolved")]
+        assert alerts.ALERT_ACTIVE.labels(rule="depth-high").value == 0
+
+    def test_for_s_requires_sustained_breach(self):
+        _reg, _g, hist = _depth_history([10.0, 10.0, 10.0])
+        rule = alerts.AlertRule(name="depth-sustained",
+                                metric="serving_queue_depth",
+                                stat="mean", op=">", value=5.0,
+                                window_s=60.0, for_s=10.0)
+        dog = alerts.AlertWatchdog(hist, [rule], interval_s=0.1)
+        assert dog.evaluate_once(now=2000.0) == []  # breach just started
+        assert dog.evaluate_once(now=2005.0) == []  # 5s < for_s
+        fired = dog.evaluate_once(now=2011.0)
+        assert [t["status"] for t in fired] == ["firing"]
+
+    def test_underfed_rule_stays_silent(self):
+        reg = MetricsRegistry()
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=60)
+        rule = alerts.AlertRule(name="no-data",
+                                metric="serving_queue_depth",
+                                stat="mean", op=">", value=5.0)
+        dog = alerts.AlertWatchdog(hist, [rule], interval_s=0.1)
+        assert dog.evaluate_once(now=2000.0) == []
+
+    def test_burn_rate_sugar(self):
+        rule = alerts.AlertRule.from_dict(
+            {"name": "burn-5m", "kind": "burn_rate", "value": 14.4,
+             "window": "5m"})
+        assert rule.metric == "slo_error_budget_burn_rate"
+        assert rule.stat == "max"
+        assert rule.labels == {"window": "5m"}
+        reg = MetricsRegistry()
+        g = reg.gauge("slo_error_budget_burn_rate", "t",
+                      labelnames=("window",))
+        hist = MetricsHistory(reg, interval_s=1.0, window_s=600)
+        g.labels(window="5m").set(20.0)
+        g.labels(window="1h").set(0.0)
+        hist.sample_now(now=1000.0)
+        assert rule.measure(hist) == pytest.approx(20.0)
+        assert rule.breached(20.0)
+
+    def test_zscore_catches_drift(self):
+        values = [10.0] * 30 + [100.0]
+        _reg, _g, hist = _depth_history(values)
+        rule = alerts.AlertRule(name="depth-drift", kind="zscore",
+                                metric="serving_queue_depth",
+                                stat="mean", value=4.0, window_s=600.0)
+        z = rule.measure(hist)
+        assert z is not None and z > 4.0
+        assert rule.breached(z)
+        # a flat series never z-fires, whatever its level
+        _reg2, _g2, flat = _depth_history([10.0] * 30)
+        assert rule.measure(flat) == 0.0
+
+    def test_parse_rules_rejects_junk(self):
+        with pytest.raises(ValueError):
+            alerts.parse_rules('{"not": "a list"}')
+        with pytest.raises(ValueError):
+            alerts.parse_rules('[{"kind": "threshold"}]')  # no name
+        with pytest.raises(ValueError):
+            alerts.parse_rules('[{"name": "x", "bogus_key": 1}]')
+        assert alerts.parse_rules("") == []
+
+    def test_alert_event_validation(self):
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.events import (
+            Event, EventValidationError, validate_event)
+
+        def ev(props):
+            return Event(event="$alert", entity_type="alert",
+                         entity_id="r1", properties=DataMap(props))
+
+        validate_event(ev({"rule": "r1", "status": "firing", "value": 2.5}))
+        for bad in ({"status": "firing", "value": 1},
+                    {"rule": "r1", "status": "paging", "value": 1},
+                    {"rule": "r1", "status": "firing", "value": True},
+                    {"rule": "r1", "status": "firing"}):
+            with pytest.raises(EventValidationError):
+                validate_event(ev(bad))
+
+    def test_alert_rides_the_ingest_funnel(self, memory_storage):
+        from predictionio_tpu.ingest.writer import (
+            GroupCommitWriter, IngestConfig)
+        from predictionio_tpu.storage.base import App
+
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="Alerts"))
+        le = memory_storage.l_events()
+        writer = GroupCommitWriter(insert_fn=le.insert,
+                                   grouped_fn=le.insert_grouped,
+                                   config=IngestConfig(), name="t-alerts")
+        _reg, _g, hist = _depth_history([10.0, 10.0])
+        rule = alerts.AlertRule(name="depth-ingest",
+                                metric="serving_queue_depth",
+                                stat="mean", op=">", value=5.0,
+                                severity="page")
+        dog = alerts.AlertWatchdog(
+            hist, [rule], emit=alerts.ingest_emitter(writer, app_id),
+            interval_s=0.1)
+        try:
+            fired = dog.evaluate_once(now=2000.0)
+        finally:
+            writer.close()
+        assert len(fired) == 1
+        # submit() returning means the commit happened: the alert is a
+        # durable, queryable event the moment the transition returns
+        stored = list(le.find(app_id=app_id, event_names=["$alert"]))
+        assert len(stored) == 1
+        props = stored[0].properties.to_dict()
+        assert props["rule"] == "depth-ingest"
+        assert props["status"] == "firing"
+        assert props["severity"] == "page"
+        assert props["value"] == pytest.approx(10.0)
+
+
+# -- smoothed autoscaler -----------------------------------------------------
+
+class _FakeHistory:
+    """mean() answers from a {metric: value} map (None = no data yet)."""
+
+    def __init__(self, means):
+        self.means = means
+        self.calls = []
+
+    def mean(self, name, labels=None, window_s=60.0, agg="max"):
+        self.calls.append((name, window_s))
+        return self.means.get(name)
+
+
+def _mk_supervisor(n_ready=1, in_flight=0):
+    from predictionio_tpu.runtime.supervisor import (
+        Supervisor, SupervisorConfig)
+
+    cfg = SupervisorConfig(min_workers=1, max_workers=4,
+                           scale_stable_ticks=1)
+    sup = Supervisor(SimpleNamespace(ip="127.0.0.1", port=0), 1, cfg)
+    for i in range(n_ready):
+        s = sup._add_slot()
+        s.pid = 40_000 + i
+        s.ready = True
+        s.in_flight = in_flight
+    return sup
+
+
+class TestSmoothedAutoscaler:
+    def test_scale_up_driven_by_smoothed_series(self):
+        # instantaneous util is ZERO — only the smoothed history says the
+        # pool is hot. The decision must come from the series.
+        sup = _mk_supervisor(n_ready=1, in_flight=0)
+        sup._history = _FakeHistory(
+            {"supervisor_pool_utilization": 0.9,
+             "supervisor_pool_burn_avg": 0.0})
+        sup._autoscale()
+        assert len(sup._slots) == 2
+        assert sup._slots[-1].next_spawn_at is not None
+        # the scale-up read used the short window, not the 5m one
+        assert ("supervisor_pool_utilization",
+                sup.cfg.scale_up_window_s) in sup._history.calls
+
+    def test_heartbeat_spike_is_suppressed(self):
+        # one hot heartbeat (instantaneous util >> 1) against a calm
+        # smoothed series must NOT grow the pool
+        sup = _mk_supervisor(n_ready=1, in_flight=10_000)
+        sup._history = _FakeHistory(
+            {"supervisor_pool_utilization": 0.0,
+             "supervisor_pool_burn_avg": 0.0})
+        sup._autoscale()
+        assert len(sup._slots) == 1
+
+    def test_instantaneous_fallback_without_history(self):
+        sup = _mk_supervisor(n_ready=1, in_flight=10_000)
+        sup._history = None
+        sup._autoscale()
+        assert len(sup._slots) == 2
+
+    def test_instantaneous_fallback_while_history_warms_up(self):
+        sup = _mk_supervisor(n_ready=1, in_flight=10_000)
+        sup._history = _FakeHistory({})  # mean() -> None: no samples yet
+        sup._autoscale()
+        assert len(sup._slots) == 2
+
+    def test_smoothed_burn_triggers_scale_up(self):
+        sup = _mk_supervisor(n_ready=1, in_flight=0)
+        sup._history = _FakeHistory(
+            {"supervisor_pool_utilization": 0.0,
+             "supervisor_pool_burn_avg": 20.0})
+        sup._autoscale()
+        assert len(sup._slots) == 2
+
+
+# -- /debug/history.json -----------------------------------------------------
+
+class _PingHandler(JsonRequestHandler):
+    def do_GET(self):
+        self.send_json(200, {"ok": True})
+
+
+class TestHistoryEndpoint:
+    def test_debug_history_route(self):
+        from predictionio_tpu.telemetry import history as history_mod
+
+        svc = HttpService("127.0.0.1", 0, _PingHandler,
+                          server_name="historyprobe")
+        svc.start()
+        try:
+            # building the service started the process-wide sampler;
+            # force two ticks so the payload has a span
+            hist = history_mod.get_history()
+            assert hist is not None
+            _get(svc.port, "/")
+            hist.sample_now()
+            hist.sample_now()
+            status, headers, body = _get(svc.port, "/debug/history.json")
+            assert status == 200
+            assert headers.get("Content-Type", "").startswith(
+                "application/json")
+            payload = json.loads(body)
+            assert payload["samples"] >= 2
+            assert "http_requests_total" in payload["families"]
+            # windowed view stays well-formed
+            status, _h, body = _get(svc.port,
+                                    "/debug/history.json?window=5")
+            assert status == 200
+            assert json.loads(body)["samples"] >= 1
+        finally:
+            svc.shutdown()
+
+
+# -- acceptance: latency fault → alert + resolvable exemplar -----------------
+
+class _SlowProbeHandler(JsonRequestHandler):
+    def do_GET(self):
+        faults.inject("alertprobe.request")
+        self.send_json(200, {"ok": True})
+
+
+class TestFaultDrivenAlert:
+    def test_latency_fault_fires_alert_with_resolvable_exemplar(
+            self, monkeypatch, memory_storage):
+        from predictionio_tpu.ingest.writer import (
+            GroupCommitWriter, IngestConfig)
+        from predictionio_tpu.storage.base import App
+
+        monkeypatch.setenv("PIO_FAULTS", "alertprobe.request=delay:120")
+        faults._parse()
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="Fault"))
+        le = memory_storage.l_events()
+        writer = GroupCommitWriter(insert_fn=le.insert,
+                                   grouped_fn=le.insert_grouped,
+                                   config=IngestConfig(), name="t-alerts")
+        svc = HttpService("127.0.0.1", 0, _SlowProbeHandler,
+                          server_name="alertprobe")
+        svc.start()
+        hist = MetricsHistory(REGISTRY, interval_s=0.2, window_s=60,
+                              prefixes=("http_",))
+        try:
+            hist.sample_now()
+            for _ in range(4):
+                status, _h, _b = _get(svc.port, "/",
+                                      headers={"X-PIO-Debug": "1"})
+                assert status == 200
+            hist.sample_now()
+
+            rule = alerts.AlertRule(
+                name="probe-p95", metric="http_request_duration_seconds",
+                labels={"server": "alertprobe"}, stat="p95", op=">",
+                value=0.05, window_s=60.0, severity="page")
+            dog = alerts.AlertWatchdog(
+                hist, [rule], emit=alerts.ingest_emitter(writer, app_id),
+                interval_s=0.1)
+            # ONE evaluation pass after the fault: the windowed p95 sees
+            # the injected 120ms and the edge fires immediately
+            fired = dog.evaluate_once()
+            assert [(t["rule"], t["status"]) for t in fired] == \
+                [("probe-p95", "firing")]
+            assert fired[0]["value"] > 0.05
+            stored = list(le.find(app_id=app_id, event_names=["$alert"]))
+            assert len(stored) == 1
+            assert stored[0].properties.to_dict()["rule"] == "probe-p95"
+
+            # the slow requests left exemplars on the duration histogram…
+            exemplars = parse_exemplars(REGISTRY.render())
+            probe_ex = [e for series, e in exemplars.items()
+                        if series.startswith(
+                            "http_request_duration_seconds_bucket")
+                        and 'server="alertprobe"' in series]
+            assert probe_ex, "no exemplar recorded for the slow route"
+            slow = max(probe_ex, key=lambda e: e["value"])
+            assert slow["value"] >= 0.12
+            trace_id = slow["labels"]["trace_id"]
+            # …and the exemplar's trace id resolves to a full timeline
+            status, _h, body = _get(
+                svc.port, f"/debug/requests/{trace_id}.json")
+            assert status == 200
+            timeline = json.loads(body)
+            assert timeline["trace_id"] == trace_id
+        finally:
+            svc.shutdown()
+            writer.close()
+
+
+# -- live pool drill (the telemetry gate's fleet check) ----------------------
+
+@pytest.mark.slow
+class TestFleetDrill:
+    def test_fleet_drill_sum_exact(self):
+        from predictionio_tpu.telemetry.gate import _fleet_drill
+
+        assert _fleet_drill() == []
