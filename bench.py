@@ -189,6 +189,41 @@ def _span_breakdown(port: int, path: str = None, payloads=None,
     return out
 
 
+def _profile_self_counts(port: int) -> dict:
+    """{leaf frame: self samples} folded from the server's live
+    collapsed-stack aggregate (GET /debug/profile.json). Empty on any
+    error — the profile annotation is attribution, never the bar."""
+    import http.client
+
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/debug/profile.json")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            return {}
+    except (OSError, ValueError) as e:
+        return {"error": str(e)}
+    out: dict = {}
+    for per in (body.get("stacks") or {}).values():
+        for collapsed, n in per.items():
+            leaf = collapsed.rsplit(";", 1)[-1]
+            out[leaf] = out.get(leaf, 0) + n
+    return out
+
+
+def _top_stack_delta(before: dict, after: dict, top_n: int = 5) -> list:
+    """Top self-time frames by samples gained between two
+    `_profile_self_counts` snapshots — "what this rung actually burned",
+    embedded per-rung in the BENCH record."""
+    deltas = {f: after.get(f, 0) - before.get(f, 0)
+              for f in set(after) | set(before) if f != "error"}
+    ranked = sorted(((f, d) for f, d in deltas.items() if d > 0),
+                    key=lambda kv: -kv[1])[:top_n]
+    return [{"frame": f, "samples": d} for f, d in ranked]
+
+
 def _run_http_load(port: int, path, payloads, n_threads,
                    duration_s, ok_status=(200,)):
     """N keep-alive client threads hammering one endpoint for
@@ -545,7 +580,11 @@ def bench_serving_qps(emit: bool = True, ladder=None,
        span p50/p95 so the win is attributed, not asserted; a bonus
        rung with PIO_HTTP_RESULT_CACHE=1 shows the optional cache's
        headroom (informational — never part of the bar);
-    4. saturation drill — a burst against a 2-slot admission budget must
+    4. profiler A/B — stack sampler on vs off at the acceptance rung,
+       interleaved best-of-3: the always-on profiler (which annotates
+       every ladder rung with per-rung top-stack deltas) must cost ≤5%
+       on p95;
+    5. saturation drill — a burst against a 2-slot admission budget must
        answer only 200/429/503 (explicit shed, never a hang or a 5xx
        storm) and the shed/deadline counters must show on /metrics.
 
@@ -662,16 +701,27 @@ def bench_serving_qps(emit: bool = True, ladder=None,
     try:
         warm(server.port)
         for n_clients in ladder:
+            # the always-on profiler annotates every rung with the
+            # frames whose self-time grew during that rung's window
+            prof_before = _profile_self_counts(server.port)
             if n_clients == accept_at:
-                ladder_out[str(n_clients)] = transports["loop"]
-                continue
-            qps, p50, p95, n = _run_http_load(
-                server.port, "/queries.json", payloads, n_clients,
-                duration_s=duration_s)
-            ladder_out[str(n_clients)] = {"qps": round(qps, 1),
-                                          "p50_ms": round(p50 * 1e3, 2),
-                                          "p95_ms": round(p95 * 1e3, 2),
-                                          "n_requests": n}
+                # numbers come from the best-of-3 A/B window above; a
+                # short re-load on this server gives the rung its own
+                # flame delta without re-measuring
+                _run_http_load(server.port, "/queries.json", payloads,
+                               n_clients, duration_s=min(duration_s, 1.0))
+                entry = dict(transports["loop"])
+            else:
+                qps, p50, p95, n = _run_http_load(
+                    server.port, "/queries.json", payloads, n_clients,
+                    duration_s=duration_s)
+                entry = {"qps": round(qps, 1),
+                         "p50_ms": round(p50 * 1e3, 2),
+                         "p95_ms": round(p95 * 1e3, 2),
+                         "n_requests": n}
+            entry["top_stacks"] = _top_stack_delta(
+                prof_before, _profile_self_counts(server.port))
+            ladder_out[str(n_clients)] = entry
         span_breakdown = _span_breakdown(server.port, "/queries.json",
                                          payloads)
         # 1m-rate view of the ladder run from the in-process history
@@ -696,6 +746,36 @@ def bench_serving_qps(emit: bool = True, ladder=None,
                       "n_requests": n}
     finally:
         server.shutdown()
+
+    # profiler overhead A/B: same loop plane, stack sampler on vs off,
+    # interleaved best-of-3 (the always-on sampler rode every rung
+    # above; this leg proves the ride costs ≤5% on the tail). stop()/
+    # ensure_started() flip the process-global sampler — the server is
+    # in-process, so the off leg is genuinely unsampled.
+    from predictionio_tpu.telemetry import profiler as _profiler
+    prof_ab: dict = {"on": None, "off": None}
+    server = serve(transport="loop")
+    try:
+        warm(server.port)
+        for rep in range(3):
+            for leg in ("on", "off"):
+                if leg == "on":
+                    _profiler.ensure_started()
+                else:
+                    _profiler.stop()
+                qps, p50, p95, n = _run_http_load(
+                    server.port, "/queries.json", payloads, accept_at,
+                    duration_s=min(duration_s, 2.0))
+                if (prof_ab[leg] is None
+                        or p95 * 1e3 < prof_ab[leg]["p95_ms"]):
+                    prof_ab[leg] = {"qps": round(qps, 1),
+                                    "p95_ms": round(p95 * 1e3, 2),
+                                    "n_requests": n}
+    finally:
+        _profiler.ensure_started()  # always-on is the production posture
+        server.shutdown()
+    profiler_ratio = (prof_ab["on"]["p95_ms"]
+                      / max(prof_ab["off"]["p95_ms"], 1e-9))
 
     # saturation drill: 2 admission slots, a burst of clients, plus a
     # lane of pre-expired deadlines — tally what the server answered
@@ -768,6 +848,11 @@ def bench_serving_qps(emit: bool = True, ladder=None,
         "metrics_history": history_rates,
         # optional per-user result cache, informational only
         "result_cache_on": cache_rung,
+        # stack-sampler overhead A/B at the acceptance rung (best-of-3,
+        # interleaved); the ladder rungs above carry per-rung top_stacks
+        # deltas from the same always-on sampler
+        "profiler": {"on": prof_ab["on"], "off": prof_ab["off"],
+                     "p95_ratio": round(profiler_ratio, 3)},
         "parity_checked": len(parity["loop"]),
         "saturation": {"statuses": {str(k): v for k, v in
                                     sorted(tally.items())},
@@ -785,7 +870,10 @@ def bench_serving_qps(emit: bool = True, ladder=None,
         "bar": {"qps_2x_r05_32": loop32["qps"]
                 >= 2 * R05_SERVING_QPS_32,
                 "p95_32_le_r05_p95_8": loop32["p95_best_ms"]
-                <= R05_SERVING_P95_8_MS},
+                <= R05_SERVING_P95_8_MS,
+                # ISSUE r10: the always-on sampler may cost at most 5%
+                # on the acceptance rung's tail
+                "profiler_p95_within_5pct": profiler_ratio <= 1.05},
     }
     if emit:
         print(json.dumps(record))

@@ -65,6 +65,17 @@ _RESTART_RE = re.compile(
 # ---------------------------------------------------------------------------
 # Stub worker factory (runs inside the pool's forked children)
 
+def _gate_cpu_burn(deadline_ms: float) -> int:
+    """Tight arithmetic spin so the stack sampler has a named frame to
+    find — the telemetry gate asserts this exact function tops the
+    fleet flamegraph's /queries.json self-time."""
+    t_end = time.perf_counter() + deadline_ms / 1e3
+    acc = 0
+    while time.perf_counter() < t_end:
+        acc += 1
+    return acc
+
+
 class StubPredictionServer(HttpService):
     """A PredictionServer body-double: /queries.json served through the
     REAL ServingPlane (admission control, micro-batching, and the
@@ -76,6 +87,14 @@ class StubPredictionServer(HttpService):
     def __init__(self, config, supervisor_pid: Optional[int] = None):
         self.supervisor_pid = supervisor_pid
         server = self
+        # Seeded CPU burn for the telemetry gate's profiler drill: spin
+        # this many ms per query ON THE REQUEST HANDLER THREAD (where the
+        # span timeline is active), so the burn frame must surface in the
+        # fleet flamegraph attributed to /queries.json. Off by default.
+        try:
+            self._burn_ms = float(os.environ.get("PIO_GATE_BURN_MS") or 0)
+        except ValueError:
+            self._burn_ms = 0.0
 
         def _dispatch(queries: List) -> List:
             return [{"stub": True} for _ in queries]
@@ -98,6 +117,8 @@ class StubPredictionServer(HttpService):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if self.path == "/queries.json":
+                    if server._burn_ms:
+                        _gate_cpu_burn(server._burn_ms)
                     try:
                         result, _degraded = server.serving.handle_query(
                             json.loads(body or b"{}"), self.headers)

@@ -614,6 +614,9 @@ class Supervisor:
         # not the supervisor's own registry
         telemetry_middleware.set_metrics_renderer(
             "supervisor", self._render_fleet_metrics)
+        # …and its /debug/profile.json serves the fleet-merged flamegraph
+        telemetry_middleware.set_profile_renderer(
+            "supervisor", self._render_fleet_profile)
 
         if self.cfg.control_port is not None:
             try:
@@ -654,6 +657,7 @@ class Supervisor:
                     pass
             self._reservation.close()
             telemetry_middleware.set_metrics_renderer("supervisor", None)
+            telemetry_middleware.set_profile_renderer("supervisor", None)
             if self._control is not None:
                 try:
                     self._control.shutdown()
@@ -1089,6 +1093,21 @@ class Supervisor:
         snaps = [aggregate.snapshot_registry(worker="supervisor")]
         snaps.extend(self._worker_snapshots())
         return aggregate.render_merged(aggregate.merge_snapshots(snaps))
+
+    def _render_fleet_profile(self, route=None) -> tuple:
+        """The control endpoint's /debug/profile.json: every worker's
+        collapsed-stack export (riding the same snapshot fetch as the
+        metric merge) plus the supervisor's own, summed exactly by
+        profiler.merge_profiles — per-worker sample counts and the fleet
+        total come from the SAME snapshot set, so
+        ``samples == sum(workers.values())`` is checkable from one
+        fetch."""
+        from predictionio_tpu.telemetry import profiler
+        parts = [("supervisor", profiler.export_state())]
+        for snap in self._worker_snapshots():
+            parts.append((str(snap.get("worker", "?")),
+                          snap.get("profile")))
+        return profiler.filter_merged(profiler.merge_profiles(parts), route)
 
     def fleet_summary(self) -> dict:
         """Per-worker and fleet-total request counters for /status.json —

@@ -90,8 +90,16 @@ def snapshot_registry(registry: MetricsRegistry = REGISTRY,
         else:
             fam["children"] = [[list(k), v] for k, v in m.collect()]
         families.append(fam)
+    # The profiler's collapsed-stack state rides the same channel: one
+    # fetch gives the supervisor both the metric merge and the fleet
+    # flamegraph inputs, with no second socket or race between them.
+    try:
+        from predictionio_tpu.telemetry import profiler
+        profile = profiler.export_state()
+    except Exception:  # noqa: BLE001 — snapshots must not break on this
+        profile = None
     return {"worker": worker or worker_label(), "pid": os.getpid(),
-            "ts": time.time(), "families": families}
+            "ts": time.time(), "families": families, "profile": profile}
 
 
 class SnapshotServer:

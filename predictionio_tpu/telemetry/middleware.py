@@ -30,7 +30,7 @@ import time
 from typing import Type
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.telemetry import history, slo, spans, tracing
+from predictionio_tpu.telemetry import history, profiler, slo, spans, tracing
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -45,6 +45,8 @@ DEBUG_HEADER = "X-PIO-Debug"
 _DEBUG_LIST_ROUTE = "/debug/requests.json"
 _DEBUG_ONE_ROUTE = "/debug/requests/<trace_id>.json"
 _HISTORY_ROUTE = "/debug/history.json"
+_PROFILE_ROUTE = "/debug/profile.json"
+_PROFILE_DEVICE_ROUTE = "/debug/profile/device.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
@@ -66,6 +68,7 @@ HTTP_ERRORS = REGISTRY.counter(
 # templates. Anything else (scanner noise, typos) collapses to "<other>".
 _EXACT_ROUTES = frozenset({
     "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
+    _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -173,22 +176,38 @@ def _serve_json(handler, obj, status: int = 200) -> None:
     handler.wfile.write(body)
 
 
+def error_payload(status: int, message: str, **extra) -> tuple:
+    """The one /debug/* error shape: every 4xx/5xx from an introspection
+    route is `{"status": N, "error": "...", ...context}` — a client (or
+    the dashboard) can branch on `status`/`error` without knowing which
+    route it hit. Returns the (status, body) pair the serve_* helpers
+    expect."""
+    body = {"status": int(status), "error": message}
+    body.update(extra)
+    return int(status), body
+
+
+def _query_params(raw_target: str) -> dict:
+    return parse_qs(urlparse(raw_target).query)
+
+
+def _one_param(params: dict, name: str):
+    vals = params.get(name)
+    return vals[0] if vals else None
+
+
 def _debug_requests_payload(raw_target: str) -> tuple:
     """GET /debug/requests.json?limit=&route=&kind= — ring dump."""
-    params = parse_qs(urlparse(raw_target).query)
-
-    def _one(name):
-        vals = params.get(name)
-        return vals[0] if vals else None
-
+    params = _query_params(raw_target)
     try:
-        limit = min(500, int(_one("limit") or 50))
+        limit = min(500, int(_one_param(params, "limit") or 50))
     except ValueError:
         limit = 50
-    kind = _one("kind")
+    kind = _one_param(params, "kind")
     if kind not in (None, "pinned", "sampled"):
-        return 400, {"error": "kind must be pinned|sampled"}
-    entries = RECORDER.snapshot(limit=limit, route=_one("route"), kind=kind)
+        return error_payload(400, "kind must be pinned|sampled", kind=kind)
+    entries = RECORDER.snapshot(limit=limit,
+                                route=_one_param(params, "route"), kind=kind)
     return 200, {"entries": entries, "sizes": RECORDER.sizes()}
 
 
@@ -196,11 +215,11 @@ def _debug_request_by_id_payload(path: str) -> tuple:
     """GET /debug/requests/<trace_id>.json — one timeline by trace id."""
     trace_id = path[len("/debug/requests/"):-len(".json")]
     if not tracing._SAFE_TRACE_ID.match(trace_id):
-        return 400, {"error": "bad trace id"}
+        return error_payload(400, "bad trace id")
     entry = RECORDER.get(trace_id)
     if entry is None:
-        return 404, {"error": "trace not held by the flight recorder",
-                     "trace_id": trace_id}
+        return error_payload(404, "trace not held by the flight recorder",
+                             trace_id=trace_id)
     return 200, entry
 
 
@@ -208,17 +227,85 @@ def _history_payload(raw_target: str) -> tuple:
     """GET /debug/history.json?window= — the metrics-history store."""
     hist = history.get_history()
     if hist is None:
-        return 503, {"error": "metrics history disabled "
-                              "(PIO_METRICS_HISTORY=0)"}
-    params = parse_qs(urlparse(raw_target).query)
+        return error_payload(503, "metrics history disabled "
+                                  "(PIO_METRICS_HISTORY=0)")
+    params = _query_params(raw_target)
     window_s = None
-    vals = params.get("window")
-    if vals:
+    raw = _one_param(params, "window")
+    if raw is not None:
         try:
-            window_s = float(vals[0])
+            window_s = float(raw)
         except ValueError:
-            return 400, {"error": "window must be seconds"}
+            return error_payload(400, "window must be seconds", window=raw)
+        if window_s <= 0:
+            return error_payload(400, "window must be positive seconds",
+                                 window=raw)
     return 200, hist.snapshot_json(window_s)
+
+
+# Per-server /debug/profile.json overrides, the /metrics renderer pattern
+# again: the supervisor swaps in its fleet-merged flamegraph here while
+# every worker keeps the process-local view.
+_PROFILE_RENDERERS: dict = {}
+
+
+def set_profile_renderer(server_name: str, renderer) -> None:
+    """Install (renderer(route) -> (status, obj)) for one server's
+    /debug/profile.json; None clears."""
+    if renderer is None:
+        _PROFILE_RENDERERS.pop(server_name, None)
+    else:
+        _PROFILE_RENDERERS[server_name] = renderer
+
+
+def _profile_payload(server: str, raw_target: str) -> tuple:
+    """GET /debug/profile.json?route=&seconds=&hz=&top= — the collapsed-
+    stack profile. `seconds` switches to an on-demand capture window
+    (blocking the handler for that long); a fleet renderer, if
+    installed, answers the plain (non-capture) form."""
+    params = _query_params(raw_target)
+    route = _one_param(params, "route")
+    raw_seconds = _one_param(params, "seconds")
+    raw_hz = _one_param(params, "hz")
+    try:
+        top_n = min(100, int(_one_param(params, "top") or 20))
+    except ValueError:
+        top_n = 20
+    if raw_seconds is not None:
+        try:
+            seconds = float(raw_seconds)
+        except ValueError:
+            return error_payload(400, "seconds must be a number",
+                                 seconds=raw_seconds)
+        if not 0 < seconds <= profiler.CAPTURE_MAX_SECONDS:
+            return error_payload(
+                400, "seconds must be in (0, %g]"
+                % profiler.CAPTURE_MAX_SECONDS, seconds=raw_seconds)
+        hz = 99.0
+        if raw_hz is not None:
+            try:
+                hz = float(raw_hz)
+            except ValueError:
+                return error_payload(400, "hz must be a number", hz=raw_hz)
+            if not 0 < hz <= profiler.CAPTURE_MAX_HZ:
+                return error_payload(
+                    400, "hz must be in (0, %g]" % profiler.CAPTURE_MAX_HZ,
+                    hz=raw_hz)
+        return profiler.capture(seconds, hz, route=route)
+    if raw_hz is not None:
+        return error_payload(400, "hz requires seconds (capture window)",
+                             hz=raw_hz)
+    renderer = _PROFILE_RENDERERS.get(server)
+    if renderer is not None:
+        try:
+            return renderer(route)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "profile renderer for %s failed; serving process-local "
+                "view", server, exc_info=True)
+    if not profiler.enabled():
+        return error_payload(503, "profiler disabled (PIO_PROFILE=0)")
+    return profiler.payload_response(route=route, top_n=top_n)
 
 
 def serve_debug_history(handler, raw_path: str) -> None:
@@ -233,6 +320,17 @@ def serve_debug_requests(handler, raw_path: str) -> None:
 
 def serve_debug_request_by_id(handler, path: str) -> None:
     status, obj = _debug_request_by_id_payload(path)
+    _serve_json(handler, obj, status=status)
+
+
+def serve_profile(handler, raw_path: str) -> None:
+    status, obj = _profile_payload(
+        getattr(handler, "pio_server_name", ""), raw_path)
+    _serve_json(handler, obj, status=status)
+
+
+def serve_profile_device(handler) -> None:
+    status, obj = profiler.device_payload()
     _serve_json(handler, obj, status=status)
 
 
@@ -263,6 +361,10 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_debug_requests(self, self.path)
         elif http_method == "GET" and path == _HISTORY_ROUTE:
             serve_debug_history(self, self.path)
+        elif http_method == "GET" and path == _PROFILE_ROUTE:
+            serve_profile(self, self.path)
+        elif http_method == "GET" and path == _PROFILE_DEVICE_ROUTE:
+            serve_profile_device(self)
         elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
             serve_debug_request_by_id(self, path)
         elif "jax" in sys.modules:
@@ -318,6 +420,7 @@ def _run_instrumented(self, http_method: str, orig) -> None:
 def instrument(handler_cls: Type, server_name: str) -> Type:
     """Build an instrumented subclass of a BaseHTTPRequestHandler class."""
     history.ensure_started()
+    profiler.ensure_started()
 
     def make_wrapper(method_name: str, orig):
         http_method = method_name[3:]
@@ -531,13 +634,34 @@ def _history_route(req):
     return routing.Response.json(status, obj)
 
 
+def _profile_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _profile_payload(req.server_name
+                                   if hasattr(req, "server_name") else "",
+                                   req.target)
+    return routing.Response.json(status, obj)
+
+
+def _profile_device_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = profiler.device_payload()
+    return routing.Response.json(status, obj)
+
+
 def register_builtin_routes(router) -> None:
     """Every routed service exposes /metrics, the flight-recorder debug
-    routes, and the metrics-history dump, same as instrument()
-    guarantees for handler classes."""
+    routes, the metrics-history dump, and the profiler, same as
+    instrument() guarantees for handler classes. The profile route is
+    blocking: a ?seconds= capture parks on the loop's worker pool
+    instead of stalling the selector."""
     history.ensure_started()
+    profiler.ensure_started()
     router.get("/metrics", _metrics_route)
     router.get(_DEBUG_LIST_ROUTE, _debug_list_route)
     router.get(_HISTORY_ROUTE, _history_route)
+    router.get(_PROFILE_ROUTE, _profile_route, blocking=True)
+    router.get(_PROFILE_DEVICE_ROUTE, _profile_device_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
                       template=_DEBUG_ONE_ROUTE)

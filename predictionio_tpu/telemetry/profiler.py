@@ -1,0 +1,668 @@
+"""Continuous wall-clock profiling: the "which *frames*" layer.
+
+Metrics (PR 2) say *what* regressed, span timelines (PR 5) say *which
+stage*, the fleet aggregate (PR 9) says *which worker* — this module
+answers the last question an operator has: which code is hot. A daemon
+thread walks ``sys._current_frames()`` at a deliberately low default
+rate (``PIO_PROFILE_HZ``, ~19 Hz — prime, so the sampler cannot phase-
+lock with second-aligned periodic work) and folds every thread's stack
+into a bounded collapsed-stack aggregate, flamegraph.pl format:
+``frame;frame;frame  count`` with the root first.
+
+Attribution is the point, not just the stacks:
+
+- Threads serving a request have an active span timeline mirrored into
+  ``spans._BY_THREAD`` by the HTTP middleware; each sample joins against
+  it so every stack is keyed by *route template* (``/queries.json`` vs
+  ``/events.json``) and hot traces keep their trace id — a flamegraph
+  node links straight to ``/debug/requests/<trace_id>.json``.
+- Threads without a timeline (the micro-batcher dispatcher, committer,
+  history sampler) attribute by thread name: ``thread:<name>`` — the
+  bookkeeper threads stay visible instead of vanishing into "<other>".
+
+Sampling, not tracing: the only per-request cost is the two dict ops
+spans.begin/finish already pay; the sampler's own cost is self-measured
+(``profile_sampler_busy_seconds_total`` / ``profile_overhead_ratio``)
+and gated ≤5% on the serving hot path by ``quality.py
+--telemetry-gate`` and bench.py's interleaved A/B.
+
+Knobs: ``PIO_PROFILE`` (default on), ``PIO_PROFILE_HZ`` (default 19),
+``PIO_PROFILE_MAX_STACKS``/``_MAX_TRACES``/``_MAX_DEPTH`` bounds.
+Served by telemetry/middleware.py at ``GET /debug/profile.json``
+(``?route=`` slice, ``?seconds=&hz=`` on-demand high-rate capture run
+inline on the handler thread with its own aggregate, so the always-on
+baseline is never perturbed) and ``GET /debug/profile/device.json``
+(jax live-buffer / device-memory view). The supervisor merges per-
+worker exports — riding PR 9's snapshot channel — into one fleet
+flamegraph via :func:`merge_profiles`; fork hooks zero inherited
+aggregates and restart the sampler so respawned workers never
+double-count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from predictionio_tpu.telemetry import spans
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+log = logging.getLogger(__name__)
+
+DEFAULT_HZ = 19.0          # prime: no phase-lock with 1s-periodic work
+DEFAULT_MAX_STACKS = 2048  # distinct collapsed stacks before <overflow>
+DEFAULT_MAX_TRACES = 256   # hot-trace ids tracked per aggregate
+DEFAULT_MAX_DEPTH = 64     # frames kept per stack (<truncated> beyond)
+CAPTURE_MAX_SECONDS = 30.0
+CAPTURE_MAX_HZ = 499.0
+OVERFLOW = "<overflow>"
+TRUNCATED = "<truncated>"
+
+PROFILE_SAMPLES = REGISTRY.counter(
+    "profile_samples_total",
+    "Thread stack samples folded into the profile aggregate")
+PROFILE_SWEEPS = REGISTRY.counter(
+    "profile_sweeps_total", "Sampler wakeups (one sweep samples all threads)")
+PROFILE_DROPPED = REGISTRY.counter(
+    "profile_dropped_total",
+    "Samples folded into <overflow> because the stack table was full")
+PROFILE_DISTINCT = REGISTRY.gauge(
+    "profile_distinct_stacks",
+    "Distinct collapsed stacks currently held by the aggregate")
+PROFILE_BUSY = REGISTRY.counter(
+    "profile_sampler_busy_seconds_total",
+    "Wall time the sampler thread spent inside sweeps (self-measured)")
+PROFILE_OVERHEAD = REGISTRY.gauge(
+    "profile_overhead_ratio",
+    "Sampler busy time / elapsed time since the sampler started")
+PROFILE_RUNNING = REGISTRY.gauge(
+    "profile_sampler_running", "1 while the always-on sampler thread is live")
+PROFILE_HZ = REGISTRY.gauge(
+    "profile_sampler_hz", "Configured always-on sampling rate")
+
+
+def _truthy(v: Optional[str], default: bool = True) -> bool:
+    if v is None:
+        return default
+    return v not in ("0", "false", "off", "no", "")
+
+
+def enabled() -> bool:
+    """Always-on unless PIO_PROFILE=0 — read per call so tests and
+    bench legs can flip it without re-importing."""
+    return _truthy(os.environ.get("PIO_PROFILE"), default=True)
+
+
+# -- stack collapsing ----------------------------------------------------------
+
+
+def _collapse(frame, max_depth: int = DEFAULT_MAX_DEPTH) -> str:
+    """One thread's stack as a collapsed flamegraph line, root-first.
+
+    Frame labels are ``module.function``; a label can never smuggle the
+    ``;`` separator (sanitised on the rare path it appears)."""
+    parts: List[str] = []
+    depth = 0
+    while frame is not None and depth < max_depth:
+        code = frame.f_code
+        label = "%s.%s" % (frame.f_globals.get("__name__", "?"),
+                           code.co_name)
+        if ";" in label:
+            label = label.replace(";", ":")
+        parts.append(label)
+        frame = frame.f_back
+        depth += 1
+    if frame is not None:
+        parts.append(TRUNCATED)
+    parts.reverse()
+    return ";".join(parts)
+
+
+class StackAggregate:
+    """Bounded collapsed-stack store keyed (route, stack) with exact
+    sample accounting: sum of every stack count always equals
+    ``samples`` — overflowed stacks land in an ``<overflow>`` bucket
+    (counted, labelled, never silently lost), which is what lets the
+    fleet merge claim *exact* sums."""
+
+    __slots__ = ("max_stacks", "max_traces", "lock", "stacks", "routes",
+                 "traces", "samples", "dropped", "distinct", "started_at")
+
+    def __init__(self, max_stacks: int = DEFAULT_MAX_STACKS,
+                 max_traces: int = DEFAULT_MAX_TRACES):
+        self.max_stacks = int(max_stacks)
+        self.max_traces = int(max_traces)
+        self.lock = threading.Lock()
+        # route template -> {collapsed stack -> count}
+        self.stacks: Dict[str, Dict[str, int]] = {}
+        # route template -> samples
+        self.routes: Dict[str, int] = {}
+        # trace_id -> [count, route]
+        self.traces: Dict[str, list] = {}
+        self.samples = 0
+        self.dropped = 0
+        self.distinct = 0
+        self.started_at = time.time()
+
+    def add_batch(self, batch: Iterable[Tuple[str, str, Optional[str]]]
+                  ) -> int:
+        """Fold one sweep's (route, collapsed, trace_id) samples in under
+        a single lock acquisition; returns how many were folded."""
+        n = 0
+        with self.lock:
+            for route, collapsed, trace_id in batch:
+                n += 1
+                self.samples += 1
+                self.routes[route] = self.routes.get(route, 0) + 1
+                per = self.stacks.get(route)
+                if per is None:
+                    per = self.stacks[route] = {}
+                count = per.get(collapsed)
+                if count is not None:
+                    per[collapsed] = count + 1
+                elif self.distinct < self.max_stacks:
+                    per[collapsed] = 1
+                    self.distinct += 1
+                else:
+                    # table full: keep the sample, lose the stack detail
+                    self.dropped += 1
+                    per[OVERFLOW] = per.get(OVERFLOW, 0) + 1
+                if trace_id:
+                    t = self.traces.get(trace_id)
+                    if t is not None:
+                        t[0] += 1
+                    elif len(self.traces) < self.max_traces:
+                        self.traces[trace_id] = [1, route]
+        return n
+
+    def clear(self) -> None:
+        with self.lock:
+            self.stacks = {}
+            self.routes = {}
+            self.traces = {}
+            self.samples = 0
+            self.dropped = 0
+            self.distinct = 0
+            self.started_at = time.time()
+
+    def snapshot(self) -> Dict:
+        """Deep-enough copy for payload building / fleet export."""
+        with self.lock:
+            return {
+                "samples": self.samples,
+                "dropped": self.dropped,
+                "distinct_stacks": self.distinct,
+                "since": self.started_at,
+                "routes": dict(self.routes),
+                "stacks": {r: dict(per) for r, per in self.stacks.items()},
+                "traces": {t: list(v) for t, v in self.traces.items()},
+            }
+
+
+# -- sampling ------------------------------------------------------------------
+
+# thread name -> route bucket, trailing pool indices collapsed so a
+# 32-thread worker pool is one flamegraph slice, not 32
+_THREAD_BUCKETS: Dict[str, str] = {}
+
+
+def _thread_bucket(name: str) -> str:
+    bucket = _THREAD_BUCKETS.get(name)
+    if bucket is None:
+        base = name.rstrip("0123456789")
+        if base != name and base.endswith(("-", "_")):
+            base = base[:-1]
+        if len(_THREAD_BUCKETS) > 512:  # hostile thread churn: stop caching
+            return "thread:%s" % base
+        bucket = _THREAD_BUCKETS[name] = "thread:%s" % base
+    return bucket
+
+
+def _sweep(aggregate: StackAggregate, skip_idents: Tuple[int, ...],
+           max_depth: int = DEFAULT_MAX_DEPTH) -> int:
+    """Sample every live thread once into ``aggregate``. Threads in
+    ``skip_idents`` (the sampler itself, a capture's handler thread) are
+    excluded — a profiler that mostly profiles itself is noise."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    frames = sys._current_frames()
+    batch: List[Tuple[str, str, Optional[str]]] = []
+    for ident, frame in frames.items():
+        if ident in skip_idents:
+            continue
+        tl = spans.thread_timeline(ident)
+        if tl is not None:
+            route = tl.route
+            trace_id = tl.trace_id
+        else:
+            route = _thread_bucket(names.get(ident, "?"))
+            trace_id = None
+        batch.append((route, _collapse(frame, max_depth), trace_id))
+    del frames  # drop frame refs promptly; holding them pins locals
+    return aggregate.add_batch(batch)
+
+
+class StackSampler:
+    """The always-on daemon thread. One instance per process (module
+    global ``SAMPLER``); capture windows use :func:`capture`, which runs
+    inline on the caller with a private aggregate instead."""
+
+    def __init__(self, hz: float = DEFAULT_HZ,
+                 aggregate: Optional[StackAggregate] = None,
+                 max_depth: int = DEFAULT_MAX_DEPTH):
+        self.hz = max(0.1, min(float(hz), CAPTURE_MAX_HZ))
+        self.aggregate = aggregate if aggregate is not None else AGGREGATE
+        self.max_depth = int(max_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # survives fork (plain attribute) so the fork hook knows whether
+        # to restart the sampler in the child
+        self._running = False
+        self._started_monotonic = 0.0
+        self.busy_s = 0.0
+
+    @classmethod
+    def from_env(cls) -> "StackSampler":
+        def _f(name, default):
+            try:
+                return float(os.environ.get(name) or default)
+            except ValueError:
+                return default
+        return cls(hz=_f("PIO_PROFILE_HZ", DEFAULT_HZ),
+                   max_depth=int(_f("PIO_PROFILE_MAX_DEPTH",
+                                    DEFAULT_MAX_DEPTH)))
+
+    def is_running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.is_running():
+            return
+        self._stop = threading.Event()
+        self._started_monotonic = time.monotonic()
+        self.busy_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="pio-profile-sampler", daemon=True)
+        self._running = True
+        self._thread.start()
+        PROFILE_RUNNING.set(1)
+        PROFILE_HZ.set(self.hz)
+
+    def stop(self) -> None:
+        self._running = False
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        self._thread = None
+        PROFILE_RUNNING.set(0)
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        own = (threading.get_ident(),)
+        while not self._stop.wait(interval):
+            t0 = time.perf_counter()
+            try:
+                n = _sweep(self.aggregate, own, self.max_depth)
+                PROFILE_SWEEPS.inc()
+                if n:
+                    PROFILE_SAMPLES.inc(n)
+                PROFILE_DISTINCT.set(self.aggregate.distinct)
+                if self.aggregate.dropped:
+                    # mirror the aggregate's own exact tally
+                    PROFILE_DROPPED.labels().set(
+                        float(self.aggregate.dropped))
+            except Exception:  # noqa: BLE001 — the sampler must not die
+                pass
+            busy = time.perf_counter() - t0
+            self.busy_s += busy
+            PROFILE_BUSY.inc(busy)
+            elapsed = time.monotonic() - self._started_monotonic
+            if elapsed > 0:
+                PROFILE_OVERHEAD.set(self.busy_s / elapsed)
+
+
+# -- analysis ------------------------------------------------------------------
+
+
+def top_frames(stacks: Dict[str, Dict[str, int]], top_n: int = 20
+               ) -> Tuple[List[Dict], List[Dict]]:
+    """(top_self, top_cumulative) over a route→stack→count table.
+
+    Self time goes to the leaf frame; cumulative counts a frame once per
+    stack it appears in (set-deduped so recursion can't double-bill).
+    Self entries carry a per-route breakdown — the dashboard's panel and
+    the gate's "burn frame on the right route" check read it directly."""
+    self_counts: Dict[str, int] = {}
+    cum_counts: Dict[str, int] = {}
+    route_split: Dict[str, Dict[str, int]] = {}
+    for route, per in stacks.items():
+        for collapsed, n in per.items():
+            frames = collapsed.split(";")
+            leaf = frames[-1]
+            self_counts[leaf] = self_counts.get(leaf, 0) + n
+            rs = route_split.setdefault(leaf, {})
+            rs[route] = rs.get(route, 0) + n
+            for fr in set(frames):
+                cum_counts[fr] = cum_counts.get(fr, 0) + n
+    top_self = [
+        {"frame": f, "samples": n,
+         "routes": dict(sorted(route_split[f].items(),
+                               key=lambda kv: -kv[1]))}
+        for f, n in sorted(self_counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))[:top_n]]
+    top_cum = [
+        {"frame": f, "samples": n}
+        for f, n in sorted(cum_counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))[:top_n]]
+    return top_self, top_cum
+
+
+def _hot_traces(traces: Dict[str, list], top_n: int = 10) -> List[Dict]:
+    ordered = sorted(traces.items(), key=lambda kv: (-kv[1][0], kv[0]))
+    return [{"trace_id": tid, "samples": count, "route": route,
+             "debug_path": "/debug/requests/%s.json" % tid}
+            for tid, (count, route) in ordered[:top_n]]
+
+
+def build_payload(snap: Dict, route: Optional[str] = None,
+                  top_n: int = 20, extra: Optional[Dict] = None
+                  ) -> Tuple[int, Dict]:
+    """(status, body) for /debug/profile.json from an aggregate
+    snapshot. ``route`` slices to one route template (or thread:<name>
+    bucket); an unknown slice is a 404 in the shared error-envelope
+    shape, matching the other /debug routes."""
+    stacks = snap["stacks"]
+    routes = snap["routes"]
+    traces = snap["traces"]
+    if route is not None:
+        if route not in routes:
+            return 404, {"status": 404,
+                         "error": "no samples for route",
+                         "route": route,
+                         "known_routes": sorted(routes)}
+        stacks = {route: stacks.get(route, {})}
+        routes = {route: routes[route]}
+        traces = {t: v for t, v in traces.items() if v[1] == route}
+    top_self, top_cum = top_frames(stacks, top_n)
+    body = {
+        "samples": (sum(routes.values()) if route is not None
+                    else snap["samples"]),
+        "dropped": snap["dropped"],
+        "distinct_stacks": snap["distinct_stacks"],
+        "since": snap["since"],
+        "routes": dict(sorted(routes.items(), key=lambda kv: -kv[1])),
+        "stacks": stacks,
+        "top_self": top_self,
+        "top_cumulative": top_cum,
+        "hot_traces": _hot_traces(traces),
+    }
+    if extra:
+        body.update(extra)
+    return 200, body
+
+
+def payload_response(route: Optional[str] = None, top_n: int = 20
+                     ) -> Tuple[int, Dict]:
+    """The always-on aggregate's /debug/profile.json body."""
+    sampler = SAMPLER
+    extra = {
+        "enabled": enabled(),
+        "running": bool(sampler is not None and sampler.is_running()),
+        "hz": sampler.hz if sampler is not None else None,
+        "overhead_ratio": round(
+            sampler.busy_s
+            / max(1e-9, time.monotonic() - sampler._started_monotonic), 6)
+        if sampler is not None and sampler._started_monotonic else 0.0,
+    }
+    return build_payload(AGGREGATE.snapshot(), route=route, top_n=top_n,
+                         extra=extra)
+
+
+def capture(seconds: float, hz: float = 99.0,
+            route: Optional[str] = None) -> Tuple[int, Dict]:
+    """On-demand high-rate window, run *inline* on the calling thread
+    (the middleware mounts this on a blocking route, so the event-loop
+    transport parks it on a worker). A private aggregate keeps the
+    always-on baseline unperturbed; the caller's own thread is excluded
+    so the capture doesn't profile itself waiting."""
+    seconds = max(0.05, min(float(seconds), CAPTURE_MAX_SECONDS))
+    hz = max(1.0, min(float(hz), CAPTURE_MAX_HZ))
+    agg = StackAggregate()
+    skip = (threading.get_ident(),)
+    sampler = SAMPLER
+    if sampler is not None and sampler._thread is not None:
+        skip = skip + (sampler._thread.ident,)
+    interval = 1.0 / hz
+    deadline = time.monotonic() + seconds
+    sweeps = 0
+    busy = 0.0
+    while time.monotonic() < deadline:
+        t0 = time.perf_counter()
+        try:
+            _sweep(agg, skip)
+        except Exception:  # noqa: BLE001
+            pass
+        sweeps += 1
+        spent = time.perf_counter() - t0
+        busy += spent
+        time.sleep(max(0.0, interval - spent))
+    return build_payload(agg.snapshot(), route=route, extra={
+        "capture": True, "seconds": seconds, "hz": hz,
+        "sweeps": sweeps,
+        "overhead_ratio": round(busy / max(1e-9, seconds), 6),
+    })
+
+
+# -- device memory (the TPU side) ---------------------------------------------
+
+
+def device_payload() -> Tuple[int, Dict]:
+    """GET /debug/profile/device.json — jax live-buffer and device-memory
+    view. Lazy-import discipline: processes that never loaded jax (event
+    server, tests) answer a 503 envelope instead of paying the import."""
+    if "jax" not in sys.modules:
+        return 503, {"status": 503,
+                     "error": "jax not loaded in this process"}
+    import jax
+
+    out: Dict = {"backend": None, "devices": [], "live_buffers": {},
+                 "top_buffers": [], "memory_stats": {}}
+    try:
+        out["backend"] = jax.default_backend()
+        out["devices"] = [str(d) for d in jax.devices()]
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        per_device: Dict[str, Dict] = {}
+        buffers = []
+        for arr in jax.live_arrays():
+            try:
+                dev = str(next(iter(arr.devices())))
+                nbytes = int(arr.nbytes)
+            except Exception:  # noqa: BLE001
+                continue
+            slot = per_device.setdefault(dev, {"count": 0, "bytes": 0})
+            slot["count"] += 1
+            slot["bytes"] += nbytes
+            buffers.append((nbytes, str(arr.shape), str(arr.dtype), dev))
+        out["live_buffers"] = per_device
+        buffers.sort(key=lambda b: -b[0])
+        out["top_buffers"] = [
+            {"bytes": b, "shape": shape, "dtype": dtype, "device": dev}
+            for b, shape, dtype, dev in buffers[:20]]
+    except Exception:  # noqa: BLE001
+        out["live_buffers_error"] = "live_arrays unavailable"
+    try:
+        prof = jax.profiler.device_memory_profile()
+        out["device_memory_profile_bytes"] = len(prof)
+    except Exception:  # noqa: BLE001
+        out["device_memory_profile_bytes"] = None
+    try:
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", None)
+            if callable(stats):
+                s = stats()
+                if s:
+                    out["memory_stats"][str(d)] = {
+                        k: v for k, v in s.items()
+                        if isinstance(v, (int, float))}
+    except Exception:  # noqa: BLE001
+        pass
+    return 200, out
+
+
+# -- fleet merge (rides PR 9's snapshot channel) -------------------------------
+
+
+def export_state() -> Dict:
+    """The per-worker profile block embedded in aggregate
+    snapshot_registry() payloads — what the supervisor merges."""
+    sampler = SAMPLER
+    snap = AGGREGATE.snapshot()
+    snap["hz"] = sampler.hz if sampler is not None else None
+    snap["running"] = bool(sampler is not None and sampler.is_running())
+    return snap
+
+
+def merge_profiles(parts: Iterable[Tuple[str, Optional[Dict]]],
+                   top_n: int = 20) -> Dict:
+    """Merge (worker_label, export_state()) pairs into one fleet
+    flamegraph. Stack and route counts are summed exactly — integers,
+    no averaging — and the per-worker sample counts ship *inside the
+    same payload* as the total, so exactness is checkable from one
+    fetch: ``samples == sum(workers.values())`` always holds."""
+    workers: Dict[str, int] = {}
+    stacks: Dict[str, Dict[str, int]] = {}
+    routes: Dict[str, int] = {}
+    traces: Dict[str, list] = {}
+    samples = 0
+    dropped = 0
+    running = 0
+    for wlabel, prof in parts:
+        if prof is None:
+            workers.setdefault(str(wlabel), 0)
+            continue
+        n = int(prof.get("samples", 0))
+        workers[str(wlabel)] = workers.get(str(wlabel), 0) + n
+        samples += n
+        dropped += int(prof.get("dropped", 0))
+        if prof.get("running"):
+            running += 1
+        for route, per in prof.get("stacks", {}).items():
+            dst = stacks.setdefault(route, {})
+            for collapsed, count in per.items():
+                dst[collapsed] = dst.get(collapsed, 0) + int(count)
+        for route, count in prof.get("routes", {}).items():
+            routes[route] = routes.get(route, 0) + int(count)
+        for tid, val in prof.get("traces", {}).items():
+            prev = traces.get(tid)
+            if prev is None:
+                traces[tid] = [int(val[0]), val[1]]
+            else:
+                prev[0] += int(val[0])
+    top_self, top_cum = top_frames(stacks, top_n)
+    return {
+        "fleet": True,
+        "workers": workers,
+        "samplers_running": running,
+        "samples": samples,
+        "dropped": dropped,
+        "distinct_stacks": sum(len(per) for per in stacks.values()),
+        "routes": dict(sorted(routes.items(), key=lambda kv: -kv[1])),
+        "stacks": stacks,
+        "top_self": top_self,
+        "top_cumulative": top_cum,
+        "hot_traces": _hot_traces(traces),
+    }
+
+
+def filter_merged(merged: Dict, route: Optional[str],
+                  top_n: int = 20) -> Tuple[int, Dict]:
+    """Apply a ?route= slice to a merge_profiles() payload — same 404
+    envelope as the process-local route miss. The worker sample counts
+    stay fleet-wide (they are the exactness cross-check); `samples` is
+    recomputed for the slice."""
+    if route is None:
+        return 200, merged
+    if route not in merged["routes"]:
+        return 404, {"status": 404, "error": "no samples for route",
+                     "route": route,
+                     "known_routes": sorted(merged["routes"])}
+    stacks = {route: merged["stacks"].get(route, {})}
+    top_self, top_cum = top_frames(stacks, top_n)
+    out = dict(merged)
+    out.update({
+        "route": route,
+        "samples": merged["routes"][route],
+        "routes": {route: merged["routes"][route]},
+        "stacks": stacks,
+        "top_self": top_self,
+        "top_cumulative": top_cum,
+        "hot_traces": [t for t in merged["hot_traces"]
+                       if t["route"] == route],
+    })
+    return 200, out
+
+
+# -- process-wide lifecycle ----------------------------------------------------
+
+AGGREGATE = StackAggregate(
+    max_stacks=int(os.environ.get("PIO_PROFILE_MAX_STACKS")
+                   or DEFAULT_MAX_STACKS),
+    max_traces=int(os.environ.get("PIO_PROFILE_MAX_TRACES")
+                   or DEFAULT_MAX_TRACES))
+SAMPLER: Optional[StackSampler] = None
+_sampler_lock = threading.Lock()
+
+
+def ensure_started() -> Optional[StackSampler]:
+    """Start (or restart) the always-on sampler; every instrumented
+    server calls this at startup, same contract as history. Returns
+    None when PIO_PROFILE=0."""
+    global SAMPLER
+    if not enabled():
+        return None
+    with _sampler_lock:
+        if SAMPLER is None:
+            SAMPLER = StackSampler.from_env()
+        SAMPLER.start()
+        return SAMPLER
+
+
+def stop() -> None:
+    """Stop the always-on sampler (bench's sampler-off A/B leg; tests)."""
+    with _sampler_lock:
+        if SAMPLER is not None:
+            SAMPLER.stop()
+
+
+def _reinit_after_fork() -> None:
+    # A forked child inherits the aggregate's counts but NOT the sampler
+    # thread. Zero everything (the supervisor merge must never sum a
+    # parent's history twice) and restart the sampler iff it was running
+    # at fork time — respawned pool workers come back profiled without
+    # waiting for their server to call ensure_started().
+    global _sampler_lock
+    _sampler_lock = threading.Lock()
+    AGGREGATE.lock = threading.Lock()
+    AGGREGATE.clear()
+    sampler = SAMPLER
+    if sampler is not None:
+        was_running = sampler._running
+        sampler._stop = threading.Event()
+        sampler._thread = None
+        sampler._running = False
+        sampler.busy_s = 0.0
+        sampler._started_monotonic = 0.0
+        PROFILE_RUNNING.set(0)
+        if was_running and enabled():
+            sampler.start()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)

@@ -36,9 +36,11 @@ loop cannot grow a timeline without bound.
 from __future__ import annotations
 
 import contextvars
+import os
 import sys
+import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from predictionio_tpu.telemetry import tracing
 
@@ -141,16 +143,33 @@ class Timeline:
 _active: contextvars.ContextVar[Optional[Timeline]] = \
     contextvars.ContextVar("pio_timeline", default=None)
 
+# Thread-ident → active Timeline. Contextvars are invisible from other
+# threads, but the stack sampler (telemetry/profiler.py) must attribute a
+# thread's frames to the request it is serving — so begin/finish mirror
+# the active timeline into this plain dict. Dict store/pop on int keys is
+# GIL-atomic; no lock on the per-request hot path. The sampler only ever
+# *reads* (a racy read sees either the old or new timeline, both fine for
+# a statistical profile).
+_BY_THREAD: Dict[int, Timeline] = {}
+
 
 def current() -> Optional[Timeline]:
     return _active.get()
+
+
+def thread_timeline(ident: int) -> Optional[Timeline]:
+    """The timeline active on another thread, by thread ident — the
+    profiler's route/trace join point. Best-effort by design."""
+    return _BY_THREAD.get(ident)
 
 
 def begin(server: str, route: str, method: str,
           trace_id: str) -> tuple[Timeline, contextvars.Token]:
     """Open a timeline for the current context; pair with `finish()`."""
     tl = Timeline(server, route, method, trace_id)
-    return tl, _active.set(tl)
+    token = _active.set(tl)
+    _BY_THREAD[threading.get_ident()] = tl
+    return tl, token
 
 
 def finish(tl: Timeline, token: contextvars.Token, status: Optional[int],
@@ -161,7 +180,26 @@ def finish(tl: Timeline, token: contextvars.Token, status: Optional[int],
     tl.duration_s = duration_s
     tl.error = tl.error or error
     _active.reset(token)
+    # Restore the outer timeline for nested begins (workflow runs that
+    # issue sub-requests on the same thread); drop the entry otherwise so
+    # idle pool threads don't pin finished timelines.
+    outer = _active.get()
+    ident = threading.get_ident()
+    if outer is None:
+        _BY_THREAD.pop(ident, None)
+    else:
+        _BY_THREAD[ident] = outer
     return tl
+
+
+def _reinit_after_fork() -> None:
+    # Thread idents are reused and only the forking thread survives into
+    # the child — inherited entries would mis-attribute fresh threads.
+    _BY_THREAD.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)
 
 
 def record(name: str, duration_s: float,

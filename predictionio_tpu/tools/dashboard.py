@@ -66,6 +66,13 @@ control endpoint — <code>/status.json</code> on the port announced as
 random sample of the rest) — newest first, full JSON at
 <a href="/debug/requests.json">/debug/requests.json</a>.</p>
 {flight}
+<h2>Profile</h2>
+<p>Always-on wall-clock stack sampler: top frames by self-time with the
+route split each frame's samples came from. Collapsed stacks and
+capture windows at <a href="/debug/profile.json">/debug/profile.json</a>
+(<code>?route=</code>, <code>?seconds=&amp;hz=</code>); device memory at
+<a href="/debug/profile/device.json">/debug/profile/device.json</a>.</p>
+{profile}
 <h2>Experiments</h2>
 <p>Experimentation plane: per-variant routed traffic by outcome, the
 sliding-window traffic share, and each arm's Beta reward posterior
@@ -395,6 +402,37 @@ def _experiment_table(registry=REGISTRY) -> str:
     return "".join(out)
 
 
+def _profile_table() -> str:
+    from predictionio_tpu.telemetry import profiler
+
+    _status, body = profiler.payload_response(top_n=10)
+    if not body.get("enabled", True):
+        return ("<p>Profiler disabled (<code>PIO_PROFILE=0</code>); set "
+                "<code>PIO_PROFILE=1</code> to re-enable.</p>")
+    out = [
+        "<p>Sampler %s at %.0f Hz — %d samples over %d stacks, overhead "
+        "%.2f%% of one core.</p>" % (
+            "running" if body.get("running") else "stopped",
+            body.get("hz") or 0.0, body.get("samples", 0),
+            body.get("distinct_stacks", 0),
+            (body.get("overhead_ratio") or 0.0) * 100.0)]
+    top_self = body.get("top_self") or []
+    if not top_self:
+        out.append("<p>No samples yet.</p>")
+        return "".join(out)
+    out.append("<table><tr><th>Frame (self-time)</th><th>Samples</th>"
+               "<th>Routes</th></tr>")
+    for entry in top_self[:10]:
+        routes = ", ".join(
+            f"{html.escape(r)}: {n}"
+            for r, n in entry.get("routes", {}).items()) or "—"
+        out.append(f"<tr><td>{html.escape(entry['frame'])}</td>"
+                   f"<td>{entry['samples']}</td>"
+                   f"<td>{routes}</td></tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -447,6 +485,7 @@ class Dashboard(HttpService):
                     history=_history_section(),
                     supervisor=_supervisor_table(),
                     flight=_flight_table(),
+                    profile=_profile_table(),
                     experiment=_experiment_table(),
                     hotpath=_hotpath_table(),
                     telemetry=_telemetry_table(),

@@ -42,6 +42,17 @@ JIT_COMPILE_SECONDS = REGISTRY.histogram(
     labelnames=("fn",),
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
              60.0, 120.0))
+# Info-style gauge (the pio_build_info pattern): set to 1 per function
+# whose jax build cannot expose compile metering, so absent
+# jit_compiles_total series are explainable from /metrics instead of
+# looking like "this function never compiles".
+JIT_METERING_UNAVAILABLE = REGISTRY.gauge(
+    "jit_metering_unavailable",
+    "1 when this jax build lacks _cache_size and metered_jit degraded "
+    "to plain jax.jit for the labelled function",
+    labelnames=("fn",))
+
+_warned_no_cache_size = False
 
 
 def metered_jit(fn, label: Optional[str] = None, **jit_kwargs):
@@ -66,6 +77,18 @@ def metered_jit(fn, label: Optional[str] = None, **jit_kwargs):
     seconds = JIT_COMPILE_SECONDS.labels(fn=name)
     cache_size = getattr(jitted, "_cache_size", None)
     if cache_size is None:
+        # Degrading silently would make the absent jit_* series
+        # indistinguishable from "never compiles": say so once in the
+        # log and permanently on /metrics.
+        global _warned_no_cache_size
+        if not _warned_no_cache_size:
+            _warned_no_cache_size = True
+            log.warning(
+                "profiling: this jax build has no _cache_size on jitted "
+                "callables — compile metering (jit_compiles_total / "
+                "jit_compile_seconds) is unavailable; metered_jit "
+                "degrades to plain jax.jit")
+        JIT_METERING_UNAVAILABLE.labels(fn=name).set(1)
         return jitted
     span_name = f"jit.compile.{name}"
 

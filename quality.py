@@ -30,7 +30,13 @@ def main():
                         "a 4-worker pool drill's supervisor /metrics "
                         "counter totals differ from the sum of the "
                         "per-worker registries (fleet-aggregation drill, "
-                        "history sampling held under the 5% overhead bar)")
+                        "history sampling held under the 5% overhead "
+                        "bar), if the always-on stack sampler is not "
+                        "live with /debug/profile.json non-empty under "
+                        "load at ≤5% p95 overhead (profiler drill), or "
+                        "if the fleet-merged flamegraph's sample count "
+                        "differs from the exact per-worker sum / "
+                        "misattributes the seeded burn route")
     p.add_argument("--serving-gate", action="store_true",
                    help="run the serving CI gate (no jax, no data): fails "
                         "if any predict route bypasses admission control / "

@@ -1,0 +1,426 @@
+"""Continuous profiling plane (ISSUE 10): collapsed-stack aggregation
+with exact sample accounting, route/trace attribution through the span
+registry, capture windows, the fleet merge's sum-exactness, fork
+hygiene (child zeroes inherited counts and restarts its sampler), and
+the consistent /debug/* error envelopes. The live 4-worker flamegraph
+drill runs in `quality.py --telemetry-gate`."""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.telemetry import profiler, spans
+from predictionio_tpu.telemetry.profiler import (
+    OVERFLOW,
+    TRUNCATED,
+    StackAggregate,
+    StackSampler,
+    _collapse,
+    _thread_bucket,
+    build_payload,
+    filter_merged,
+    merge_profiles,
+    top_frames,
+)
+from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _burn_until(stop_event):
+    x = 0
+    while not stop_event.is_set():
+        x += 1
+    return x
+
+
+# -- stack collapsing ---------------------------------------------------------
+
+class TestCollapse:
+    def test_root_first_module_function_labels(self):
+        line = _collapse(sys._getframe())
+        frames = line.split(";")
+        # leaf is this test function, root is the interpreter's entry
+        assert frames[-1].endswith(
+            ".test_root_first_module_function_labels")
+        assert all("." in f for f in frames)
+
+    def test_depth_cap_marks_truncation(self):
+        def deep(n):
+            if n:
+                return deep(n - 1)
+            return _collapse(sys._getframe(), max_depth=5)
+        line = deep(20)
+        frames = line.split(";")
+        assert frames[0] == TRUNCATED
+        assert len(frames) == 6  # 5 kept + the marker
+
+
+class TestThreadBucket:
+    def test_pool_indices_collapse(self):
+        assert _thread_bucket("pio-http-worker-17") == \
+            _thread_bucket("pio-http-worker-3") == \
+            "thread:pio-http-worker"
+
+    def test_plain_names_pass_through(self):
+        assert _thread_bucket("MainThread") == "thread:MainThread"
+
+
+# -- bounded aggregate: exactness is the contract -----------------------------
+
+class TestStackAggregate:
+    def test_overflow_keeps_sample_totals_exact(self):
+        agg = StackAggregate(max_stacks=3)
+        agg.add_batch([("/q", "a;b%d" % i, None) for i in range(10)])
+        snap = agg.snapshot()
+        assert snap["samples"] == 10
+        assert snap["dropped"] == 7
+        assert snap["stacks"]["/q"][OVERFLOW] == 7
+        # the exactness invariant the fleet merge relies on
+        assert sum(sum(per.values())
+                   for per in snap["stacks"].values()) == snap["samples"]
+
+    def test_trace_table_bounded(self):
+        agg = StackAggregate(max_traces=2)
+        agg.add_batch([("/q", "a", "t%d" % i) for i in range(5)])
+        agg.add_batch([("/q", "a", "t0")])
+        snap = agg.snapshot()
+        assert set(snap["traces"]) == {"t0", "t1"}
+        assert snap["traces"]["t0"] == [2, "/q"]
+
+    def test_clear_zeroes_everything(self):
+        agg = StackAggregate()
+        agg.add_batch([("/q", "a", "t0")])
+        agg.clear()
+        snap = agg.snapshot()
+        assert snap["samples"] == 0 and not snap["stacks"] \
+            and not snap["traces"]
+
+
+# -- analysis -----------------------------------------------------------------
+
+class TestTopFrames:
+    def test_self_vs_cumulative_and_route_split(self):
+        stacks = {"/q": {"root;mid;leaf": 6, "root;leaf": 2},
+                  "/e": {"root;other": 1}}
+        top_self, top_cum = top_frames(stacks)
+        self_by = {e["frame"]: e for e in top_self}
+        assert self_by["leaf"]["samples"] == 8
+        assert self_by["leaf"]["routes"] == {"/q": 8}
+        cum_by = {e["frame"]: e["samples"] for e in top_cum}
+        assert cum_by["root"] == 9    # on every stack
+        assert cum_by["mid"] == 6
+
+    def test_recursion_counted_once_per_stack(self):
+        _, top_cum = top_frames({"/q": {"f;f;f": 5}})
+        assert top_cum == [{"frame": "f", "samples": 5}]
+
+    def test_route_filter_404_envelope(self):
+        snap = StackAggregate().snapshot()
+        status, body = build_payload(snap, route="/nope")
+        assert status == 404
+        assert body["status"] == 404
+        assert body["error"] == "no samples for route"
+        assert body["known_routes"] == []
+
+
+# -- live sampling with attribution -------------------------------------------
+
+class TestSamplerAttribution:
+    def test_request_thread_attributes_to_route_and_trace(self):
+        agg = StackAggregate()
+        sampler = StackSampler(hz=199.0, aggregate=agg)
+        stop_burn = threading.Event()
+
+        def serve_request():
+            tl, token = spans.begin("testsvc", "/queries.json", "POST",
+                                    "trace-prof-1")
+            try:
+                _burn_until(stop_burn)
+            finally:
+                spans.finish(tl, token, 200, 0.0)
+
+        worker = threading.Thread(target=serve_request,
+                                  name="req-worker-1")
+        idle = threading.Thread(target=_burn_until, args=(stop_burn,),
+                                name="bg-pool-7")
+        worker.start()
+        idle.start()
+        sampler.start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                snap = agg.snapshot()
+                if (snap["routes"].get("/queries.json", 0) >= 3
+                        and snap["routes"].get("thread:bg-pool", 0) >= 3):
+                    break
+                time.sleep(0.02)
+        finally:
+            stop_burn.set()
+            sampler.stop()
+            worker.join(timeout=5)
+            idle.join(timeout=5)
+        snap = agg.snapshot()
+        assert snap["routes"]["/queries.json"] >= 3
+        # the non-request thread buckets by (index-collapsed) name
+        assert snap["routes"]["thread:bg-pool"] >= 3
+        # trace join: flamegraph node → flight-recorder path
+        assert snap["traces"]["trace-prof-1"][1] == "/queries.json"
+        burn_stacks = snap["stacks"]["/queries.json"]
+        assert any("_burn_until" in s for s in burn_stacks)
+        status, body = build_payload(snap)
+        hot = {t["trace_id"]: t for t in body["hot_traces"]}
+        assert hot["trace-prof-1"]["debug_path"] == \
+            "/debug/requests/trace-prof-1.json"
+
+    def test_capture_window_inline_and_clamped(self):
+        stop_burn = threading.Event()
+        t = threading.Thread(target=_burn_until, args=(stop_burn,),
+                             name="capture-burn")
+        t.start()
+        try:
+            res = profiler.capture(0.2, hz=199)
+        finally:
+            stop_burn.set()
+            t.join(timeout=5)
+        status, body = res
+        assert status == 200
+        assert body["capture"] is True and body["sweeps"] >= 3
+        assert body["samples"] > 0
+        # clamping: absurd asks come back bounded, not honoured
+        assert profiler.capture(0.05, hz=10**6)[1]["hz"] == \
+            profiler.CAPTURE_MAX_HZ
+
+
+# -- fleet merge --------------------------------------------------------------
+
+def _state(samples_by_route, traces=None, running=True):
+    return {
+        "samples": sum(samples_by_route.values()),
+        "dropped": 0,
+        "distinct_stacks": len(samples_by_route),
+        "since": 0.0,
+        "routes": dict(samples_by_route),
+        "stacks": {r: {"root;leaf_%s" % r.strip("/"): n}
+                   for r, n in samples_by_route.items()},
+        "traces": dict(traces or {}),
+        "hz": 19.0,
+        "running": running,
+    }
+
+
+class TestFleetMerge:
+    def test_sum_is_exact_and_checkable_from_one_payload(self):
+        parts = [("w0", _state({"/queries.json": 10, "/events.json": 4})),
+                 ("w1", _state({"/queries.json": 7})),
+                 ("w2", None)]  # snapshot without a profile block
+        merged = merge_profiles(parts)
+        assert merged["fleet"] is True
+        assert merged["workers"] == {"w0": 14, "w1": 7, "w2": 0}
+        # the acceptance identity: total equals the per-worker sum
+        assert merged["samples"] == sum(merged["workers"].values()) == 21
+        assert merged["routes"]["/queries.json"] == 17
+        assert sum(sum(per.values())
+                   for per in merged["stacks"].values()) == 21
+        assert merged["samplers_running"] == 2
+
+    def test_trace_counts_merge_across_workers(self):
+        parts = [("w0", _state({"/q": 1}, traces={"tA": [3, "/q"]})),
+                 ("w1", _state({"/q": 1}, traces={"tA": [2, "/q"]}))]
+        merged = merge_profiles(parts)
+        hot = {t["trace_id"]: t["samples"] for t in merged["hot_traces"]}
+        assert hot["tA"] == 5
+
+    def test_filter_merged_slices_but_keeps_worker_totals(self):
+        merged = merge_profiles(
+            [("w0", _state({"/queries.json": 5, "/events.json": 2}))])
+        status, sliced = filter_merged(merged, "/queries.json")
+        assert status == 200
+        assert sliced["samples"] == 5
+        assert sliced["routes"] == {"/queries.json": 5}
+        # fleet-wide worker counts survive the slice (exactness check)
+        assert sliced["workers"] == {"w0": 7}
+        status, body = filter_merged(merged, "/nope")
+        assert status == 404 and body["error"] == "no samples for route"
+
+    def test_export_rides_the_snapshot_channel(self):
+        from predictionio_tpu.telemetry import aggregate
+        snap = aggregate.snapshot_registry()
+        assert "profile" in snap
+        assert set(snap["profile"]) >= {"samples", "stacks", "routes",
+                                        "running", "hz"}
+
+
+# -- fork hygiene -------------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(os, "fork"), reason="needs os.fork")
+class TestForkHygiene:
+    def _in_child(self, check):
+        """Run `check` in a forked child; returns its JSON result."""
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            try:
+                os.close(r)
+                payload = json.dumps(check()).encode()
+                os.write(w, payload)
+                os.close(w)
+            finally:
+                os._exit(0)
+        os.close(w)
+        chunks = b""
+        while True:
+            chunk = os.read(r, 65536)
+            if not chunk:
+                break
+            chunks += chunk
+        os.close(r)
+        os.waitpid(pid, 0)
+        return json.loads(chunks)
+
+    def test_child_zeroes_aggregate_and_restarts_sampler(self):
+        profiler.ensure_started()
+        profiler.AGGREGATE.add_batch(
+            [("/queries.json", "root;leaf", "parent-trace")] * 8)
+        parent_samples = profiler.AGGREGATE.snapshot()["samples"]
+        assert parent_samples >= 8
+
+        def check():
+            time.sleep(0.05)  # let the restarted sampler breathe
+            snap = profiler.AGGREGATE.snapshot()
+            return {
+                "inherited_traces": "parent-trace" in snap["traces"],
+                "running": bool(profiler.SAMPLER is not None
+                                and profiler.SAMPLER.is_running()),
+                "by_thread_empty": not spans._BY_THREAD,
+            }
+
+        res = self._in_child(check)
+        # never double-count a parent's history in the fleet sum
+        assert res["inherited_traces"] is False
+        assert res["running"] is True
+        assert res["by_thread_empty"] is True
+        # the parent's aggregate is untouched by the child's clear
+        assert profiler.AGGREGATE.snapshot()["samples"] >= parent_samples
+
+    def test_child_stays_stopped_when_parent_was_stopped(self):
+        profiler.ensure_started()
+        profiler.stop()
+
+        def check():
+            return {"running": bool(profiler.SAMPLER is not None
+                                    and profiler.SAMPLER.is_running())}
+
+        try:
+            assert self._in_child(check)["running"] is False
+        finally:
+            profiler.ensure_started()
+
+
+# -- HTTP surface + consistent /debug envelopes -------------------------------
+
+class _OkHandler(JsonRequestHandler):
+    def do_GET(self):
+        self.read_body()
+        self.send_json(200, {"ok": True})
+
+
+@pytest.fixture()
+def profsvc():
+    svc = HttpService("127.0.0.1", 0, _OkHandler, server_name="profsvc")
+    svc.start()
+    try:
+        yield svc
+    finally:
+        svc.shutdown()
+
+
+class TestHttpSurface:
+    def test_profile_endpoint_live_and_attributing(self, profsvc):
+        # the sampler rides instrument(): no opt-in beyond the service
+        status, body = _get(profsvc.port, "/debug/profile.json")
+        assert status == 200
+        assert body["running"] is True and body["enabled"] is True
+        assert body["hz"] > 0
+
+    def test_capture_via_query_params(self, profsvc):
+        status, body = _get(profsvc.port,
+                            "/debug/profile.json?seconds=0.1&hz=67")
+        assert status == 200
+        assert body["capture"] is True and body["hz"] == 67.0
+
+    def test_param_envelopes(self, profsvc):
+        for path, fragment in [
+            ("/debug/profile.json?seconds=99", "seconds"),
+            ("/debug/profile.json?seconds=abc", "seconds"),
+            ("/debug/profile.json?hz=50", "hz requires seconds"),
+            ("/debug/profile.json?seconds=0.1&hz=9999", "hz"),
+        ]:
+            status, body = _get(profsvc.port, path)
+            assert status == 400, path
+            assert body["status"] == 400 and fragment in body["error"], path
+
+    def test_route_miss_envelope(self, profsvc):
+        status, body = _get(profsvc.port,
+                            "/debug/profile.json?route=/absent.json")
+        assert status == 404
+        assert body["status"] == 404
+        assert body["route"] == "/absent.json"
+        assert "known_routes" in body
+
+    def test_device_endpoint_answers_envelope_or_payload(self, profsvc):
+        status, body = _get(profsvc.port, "/debug/profile/device.json")
+        if "jax" in sys.modules:
+            assert status == 200 and "live_buffers" in body
+        else:
+            assert status == 503
+            assert body == {"status": 503,
+                            "error": "jax not loaded in this process"}
+
+    def test_debug_requests_envelopes_are_consistent(self, profsvc):
+        # bad kind → 400 with the shared shape
+        status, body = _get(profsvc.port, "/debug/requests.json?kind=bogus")
+        assert (status, body["status"]) == (400, 400)
+        assert body["kind"] == "bogus"
+        # a syntactically invalid trace id ('!' is outside the id
+        # alphabet; plain letters like "zzz" are *valid* and 404 instead)
+        status, body = _get(profsvc.port, "/debug/requests/a!b.json")
+        assert (status, body["status"]) == (400, 400)
+        assert body["error"] == "bad trace id"
+        # a well-formed id the recorder never held → 404 + trace_id echo
+        status, body = _get(profsvc.port, "/debug/requests/zzzz.json")
+        assert (status, body["status"]) == (404, 404)
+        assert body["trace_id"] == "zzzz"
+
+    def test_history_envelopes(self, profsvc):
+        status, body = _get(profsvc.port, "/debug/history.json?window=abc")
+        assert (status, body["status"]) == (400, 400)
+        status, body = _get(profsvc.port, "/debug/history.json?window=-5")
+        assert (status, body["status"]) == (400, 400)
+        assert "positive" in body["error"]
+
+    def test_profile_families_on_metrics(self, profsvc):
+        conn = http.client.HTTPConnection("127.0.0.1", profsvc.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        for family in ("profile_samples_total", "profile_sweeps_total",
+                       "profile_sampler_running", "profile_sampler_hz",
+                       "profile_overhead_ratio"):
+            assert family in text

@@ -3,6 +3,7 @@ flags, and per-epoch emission through the train workflow (SURVEY.md §5
 'Tracing / profiling' + 'Metrics / logging')."""
 
 import json
+import logging
 import os
 
 import numpy as np
@@ -12,6 +13,7 @@ from predictionio_tpu.utils.profiling import (
     NullMetricsLogger,
     annotate,
     maybe_trace,
+    metered_jit,
 )
 
 
@@ -61,6 +63,45 @@ class TestTrace:
         assert os.path.isdir(prof_root)
         runs = os.listdir(prof_root)
         assert runs and os.listdir(os.path.join(prof_root, runs[0]))
+
+
+class TestMeteredJitDegradation:
+    def test_missing_cache_size_warns_once_and_marks_metrics(
+            self, monkeypatch, caplog):
+        """A jax build without `_cache_size` must not degrade silently:
+        one log warning (globally), and `jit_metering_unavailable{fn}`
+        set to 1 per degraded function on /metrics."""
+        import jax
+
+        from predictionio_tpu.telemetry.registry import REGISTRY
+        from predictionio_tpu.utils import profiling as prof_mod
+
+        class _PlainJitted:
+            def __call__(self, x):
+                return x
+
+        monkeypatch.setattr(jax, "jit", lambda fn, **kw: _PlainJitted())
+        monkeypatch.setattr(prof_mod, "_warned_no_cache_size", False)
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.utils.profiling"):
+            f1 = metered_jit(lambda x: x, label="degraded_a")
+            f2 = metered_jit(lambda x: x, label="degraded_b")
+        # degraded to the plain jitted callable, still callable
+        assert isinstance(f1, _PlainJitted) and f1(3) == 3
+        assert isinstance(f2, _PlainJitted)
+        warned = [r for r in caplog.records
+                  if "no _cache_size" in r.getMessage()]
+        assert len(warned) == 1  # once per process, not per function
+        gauge = dict(REGISTRY.get("jit_metering_unavailable").collect())
+        assert gauge[("degraded_a",)] == 1
+        assert gauge[("degraded_b",)] == 1
+
+    def test_metering_intact_when_cache_size_present(self):
+        import jax
+
+        f = metered_jit(lambda x: x + 1, label="metered_ok")
+        assert hasattr(f, "jitted")  # wrapper, not the degraded path
+        assert int(f(jax.numpy.asarray(1))) == 2
 
 
 class TestWorkflowMetricsWiring:
