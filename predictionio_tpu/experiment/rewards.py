@@ -16,18 +16,15 @@ properties a direct in-memory update cannot:
   resumes the experiment where it left off instead of back at the
   uniform prior.
 
-Polling is watermark + overlap: each poll asks for events from slightly
-before the last seen event time (re-reading the overlap costs a few
-duplicate rows; the `_seen` id map makes re-applying them impossible),
-because group-commit batches can land with event times that interleave
-with an in-flight poll.
+The watermark+overlap+dedup tail loop itself lives in
+`ingest/tailer.py` (`StoreTailer`) since PR 11 — the online-learning
+plane tails the same store with the same machinery. This subclass only
+supplies the $reward filter and the posterior update.
 """
 
 from __future__ import annotations
 
 import logging
-import threading
-from datetime import timedelta
 from typing import Optional
 
 from predictionio_tpu.experiment.bandit import ThompsonBandit
@@ -35,54 +32,21 @@ from predictionio_tpu.experiment.metrics import (
     EXPERIMENT_POSTERIOR_MEAN,
     EXPERIMENT_REWARDS,
 )
+from predictionio_tpu.ingest.tailer import OVERLAP, StoreTailer  # noqa: F401
 
 log = logging.getLogger(__name__)
 
-# how far behind the watermark each poll re-reads; must exceed the gap
-# between a commit's event_time and its visibility in the store
-OVERLAP = timedelta(seconds=2.0)
 
-
-class RewardTailer:
+class RewardTailer(StoreTailer):
     """Poll the durable event store for $reward events and apply them."""
 
     def __init__(self, storage, bandit: ThompsonBandit,
                  app_id: int = 1, channel_id: Optional[int] = None,
                  interval_s: float = 0.5):
-        self.storage = storage
+        super().__init__(storage, app_id=app_id, channel_id=channel_id,
+                         interval_s=interval_s, event_names=["$reward"],
+                         name="reward-tailer")
         self.bandit = bandit
-        self.app_id = app_id
-        self.channel_id = channel_id
-        self.interval_s = interval_s
-        self._since = None  # event-time watermark; None → full replay
-        self._seen: dict = {}  # applied-event key → event_time
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    @staticmethod
-    def _event_key(e) -> object:
-        if e.event_id:
-            return e.event_id
-        return (e.entity_id, e.event_time, repr(e.properties.to_dict()))
-
-    def poll_once(self) -> int:
-        """One tail pass. Returns the number of rewards newly applied."""
-        start = self._since - OVERLAP if self._since is not None else None
-        events = self.storage.l_events().find(
-            self.app_id, channel_id=self.channel_id,
-            start_time=start, event_names=["$reward"])
-        applied = 0
-        for e in events:
-            key = self._event_key(e)
-            if key in self._seen:
-                continue
-            self._seen[key] = e.event_time
-            if self._since is None or e.event_time > self._since:
-                self._since = e.event_time
-            if self._apply(e):
-                applied += 1
-        self._prune_seen()
-        return applied
 
     def _apply(self, e) -> bool:
         props = e.properties.to_dict()
@@ -100,33 +64,3 @@ class RewardTailer:
         EXPERIMENT_POSTERIOR_MEAN.labels(variant=variant).set(
             self.bandit.posterior_mean(variant))
         return True
-
-    def _prune_seen(self) -> None:
-        # only keys inside the overlap window can recur in a future poll
-        if self._since is None or len(self._seen) < 4096:
-            return
-        cutoff = self._since - 2 * OVERLAP
-        self._seen = {k: t for k, t in self._seen.items() if t >= cutoff}
-
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="reward-tailer", daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=5.0)
-            self._thread = None
-
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self.poll_once()
-            except Exception:  # noqa: BLE001 — the tail loop must survive
-                log.exception("reward tail pass failed; retrying")
-            self._stop.wait(self.interval_s)

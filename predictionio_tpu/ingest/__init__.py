@@ -10,10 +10,12 @@ budget. See writer.py for the mechanism and docs/performance.md for the
 measured effect.
 """
 
+from predictionio_tpu.ingest.tailer import StoreTailer  # noqa: F401
 from predictionio_tpu.ingest.writer import (  # noqa: F401
     GroupCommitWriter,
     IngestConfig,
     IngestOverload,
 )
 
-__all__ = ["GroupCommitWriter", "IngestConfig", "IngestOverload"]
+__all__ = ["GroupCommitWriter", "IngestConfig", "IngestOverload",
+           "StoreTailer"]

@@ -1,0 +1,337 @@
+"""Online-learning gate — CI drill that the event→servable loop earns
+its keep. Run via `python quality.py --online-gate`. Four drills:
+
+1. **Freshness**: a trained rec-test engine behind a live OnlinePlane
+   (50 ms poll interval), fed a burst of rating events for existing AND
+   never-seen users. Every new user must become servable with a
+   non-empty personalized answer, and the p95 of
+   `online_event_to_servable_seconds` over the drill must be ≤ 5 s —
+   the ROADMAP item-2 north-star bar, measured from the same histogram
+   `bench.py --freshness` reads.
+
+2. **Crash recovery**: `online.pre_watermark` armed in `error` mode
+   kills the fold tailer in the worst window — batch folded and
+   hot-swapped, watermark NOT advanced. The drill asserts the fold
+   landed (events already servable), then disarms and polls again: the
+   replayed batch must re-solve to bit-identical factors (fold-in
+   idempotence) and a further poll must deliver nothing new — zero
+   events lost, zero double-applied.
+
+3. **Full-retrain parity**: with item folds off, a folded user's row
+   must re-solve bit-identically against the served item factors (a
+   fold IS one half-epoch restricted to that row), and the plane-wide
+   parity check — every common user row re-solved one half-epoch —
+   must bound relative drift: a converged model plus folds stays within
+   5% of what a fresh half-epoch would serve.
+
+4. **Telemetry**: the online_* families must render on /metrics.
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+
+FRESHNESS_P95_BAR_S = 5.0
+PARITY_REL_MAX = 0.05
+
+
+def _storage():
+    from predictionio_tpu.storage.registry import (
+        SourceConfig,
+        Storage,
+        StorageConfig,
+    )
+
+    src = SourceConfig(name="ONLINE_GATE", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    Storage.reset(storage)
+    return storage
+
+
+def _train(storage, n_users=12, n_items=8, iters=15):
+    """Seed the rec-test engine: block-structured ratings (even users
+    love even items) through the normal CoreWorkflow train path."""
+    from datetime import datetime, timezone
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    from predictionio_tpu.workflow.workflow_utils import (
+        EngineVariant,
+        extract_engine_params,
+        get_engine,
+    )
+
+    app_id = storage.meta_apps().insert(App(id=0, name="OnlineGateApp"))
+    le = storage.l_events()
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    for u in range(n_users):
+        for i in range(n_items):
+            if i % 2 == u % 2:
+                le.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap({"rating": 5.0}), event_time=t0),
+                    app_id)
+    variant = EngineVariant.from_dict({
+        "id": "online-gate",
+        "engineFactory": ("predictionio_tpu.templates.recommendation."
+                          "RecommendationEngine"),
+        "datasource": {"params": {"appName": "OnlineGateApp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": iters, "lambda": 0.05, "seed": 1}}],
+    })
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    CoreWorkflow.run_train(engine, ep, variant,
+                           WorkflowContext(storage=storage, seed=1))
+    return app_id
+
+
+@contextlib.contextmanager
+def _server(storage, **online_kw):
+    from predictionio_tpu.online import OnlineConfig
+    from predictionio_tpu.workflow.create_server import (
+        PredictionServer,
+        ServerConfig,
+    )
+
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id="online-gate",
+                          engine_variant="online-gate")
+    server = PredictionServer(config, storage, plugins=None,
+                              online=OnlineConfig(**online_kw))
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def _rate(storage, app_id, user, item, rating=5.0):
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+
+    storage.l_events().insert(Event(
+        event="rate", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": rating})), app_id)
+
+
+def _hist_p95(child, base_counts, base_count) -> float:
+    """p95 upper bound from cumulative bucket deltas since `base`."""
+    counts = [c - b for c, b in zip(child.counts, base_counts)]
+    total = child.count - base_count
+    if total <= 0:
+        return float("inf")
+    acc, target = 0, 0.95 * total
+    for bound, c in zip(child.buckets, counts):
+        acc += c
+        if acc >= target:
+            return bound
+    return float("inf")
+
+
+def _freshness_problems() -> list:
+    from predictionio_tpu.online.metrics import ONLINE_EVENT_TO_SERVABLE
+
+    problems = []
+    storage = _storage()
+    try:
+        app_id = _train(storage)
+        ch = ONLINE_EVENT_TO_SERVABLE.labels()
+        base = (list(ch.counts), ch.count)
+        with _server(storage, interval_s=0.05) as server:
+            new_users = [f"fresh{j}" for j in range(6)]
+            n_sent = 0
+            for j, u in enumerate(new_users):
+                for i in (1, 3, 5):
+                    _rate(storage, app_id, u, f"i{(i + j) % 8}")
+                    n_sent += 1
+            for u in ("u0", "u1"):  # existing users keep learning too
+                _rate(storage, app_id, u, "i7")
+                n_sent += 1
+            deadline = time.monotonic() + 60
+            while (server.online.events_folded < n_sent
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if server.online.events_folded < n_sent:
+                problems.append(
+                    f"freshness: only {server.online.events_folded}/{n_sent} "
+                    f"events folded within 60s")
+            for u in new_users:
+                result, _ = server.serving.handle_query(
+                    {"user": u, "num": 3}, {})
+                if not result.get("itemScores"):
+                    problems.append(
+                        f"freshness: never-seen user {u!r} still has no "
+                        f"recommendations after fold")
+            p95 = _hist_p95(ch, *base)
+            if p95 > FRESHNESS_P95_BAR_S:
+                problems.append(
+                    f"freshness: p95 event→servable {p95:.2f}s exceeds the "
+                    f"{FRESHNESS_P95_BAR_S:.0f}s north-star bar")
+    finally:
+        _reset(storage)
+    return problems
+
+
+def _crash_problems() -> list:
+    import numpy as np
+
+    from predictionio_tpu.utils.faults import FaultInjected
+
+    problems = []
+    storage = _storage()
+    prev_faults = os.environ.get("PIO_FAULTS")
+    try:
+        app_id = _train(storage)
+        # item folds off so the opposing factors are FIXED across the
+        # replay: fold-in idempotence is then exact (bit-identical). With
+        # item folds on, a replay is one extra alternation half-step —
+        # convergent, not byte-stable (docs/online.md runbook).
+        with _server(storage, interval_s=0.05, fold_items=False) as server:
+            server.online.stop()  # drive polls by hand
+            for i in (1, 3, 5):
+                _rate(storage, app_id, "crash1", f"i{i}")
+            _rate(storage, app_id, "u0", "i5")
+            os.environ["PIO_FAULTS"] = "online.pre_watermark=error"
+            try:
+                server.online.poll_once()
+                problems.append("crash: armed fault site did not fire")
+            except FaultInjected:
+                pass
+            state = server._states["online-gate"]
+            model = state.models[0]
+            if model.user_ids.get("crash1") is None:
+                problems.append(
+                    "crash: fold did not land before the crash window "
+                    "(crash1 missing from the served model)")
+            factors_after_crash = np.array(model.user_factors, copy=True)
+            os.environ.pop("PIO_FAULTS", None)
+            replayed = server.online.poll_once()
+            if replayed <= 0:
+                problems.append(
+                    "crash: restart did not replay the unacked batch "
+                    "(watermark advanced past unfolded events)")
+            model2 = server._states["online-gate"].models[0]
+            row = model2.user_ids.get("crash1")
+            row0 = model.user_ids.get("crash1")
+            if row is None or row0 is None or not np.array_equal(
+                    np.asarray(model2.user_factors)[row],
+                    factors_after_crash[row0]):
+                problems.append(
+                    "crash: replayed fold is not idempotent (crash1's "
+                    "factors changed across the replay)")
+            if server.online.poll_once() != 0:
+                problems.append(
+                    "crash: a clean third poll still delivered events "
+                    "(dedup/watermark did not settle)")
+            result, _ = server.serving.handle_query(
+                {"user": "crash1", "num": 3}, {})
+            if not result.get("itemScores"):
+                problems.append(
+                    "crash: crash1 not servable after recovery "
+                    "(acked-but-unfolded event lost)")
+    finally:
+        if prev_faults is None:
+            os.environ.pop("PIO_FAULTS", None)
+        else:
+            os.environ["PIO_FAULTS"] = prev_faults
+        _reset(storage)
+    return problems
+
+
+def _parity_problems() -> list:
+    import numpy as np
+
+    from predictionio_tpu.online import foldin
+
+    problems = []
+    storage = _storage()
+    try:
+        app_id = _train(storage)
+        # item folds off: folded user rows must re-solve bit-identically
+        # (nothing moves the item factors after the fold)
+        with _server(storage, interval_s=0.05, fold_items=False) as server:
+            server.online.stop()
+            for i in (0, 2, 4):
+                _rate(storage, app_id, "parity1", f"i{i}")
+            _rate(storage, app_id, "u3", "i6")
+            server.online.poll_once()
+            ctx = server.online._contexts[0]
+            state = server._states["online-gate"]
+            model = state.models[ctx.als[0][0]]
+            cfg = ctx.als[0][1]
+            row = model.user_ids.get("parity1")
+            if row is None:
+                problems.append("parity: folded user missing from model")
+            else:
+                hist = server.online._history(ctx, "parity1", "user")
+                cols = np.asarray([model.item_ids[i] for i, _ in hist],
+                                  np.int32)
+                vals = np.asarray([v for _, v in hist], np.float32)
+                resolved = foldin.solve_rows(
+                    np.asarray(model.item_factors), [(cols, vals)], cfg)
+                if not np.array_equal(
+                        resolved[0], np.asarray(model.user_factors)[row]):
+                    problems.append(
+                        "parity: a folded row does not bitwise-match its "
+                        "own half-epoch re-solve")
+            stats = server.online.parity_check()
+            for variant, s in stats.items():
+                if s["rel_max"] > PARITY_REL_MAX:
+                    problems.append(
+                        f"parity: variant {variant!r} drifts "
+                        f"{s['rel_max']:.3f} (rel max) from a fresh "
+                        f"half-epoch, bound {PARITY_REL_MAX}")
+            if not stats:
+                problems.append("parity: parity_check covered no variants")
+    finally:
+        _reset(storage)
+    return problems
+
+
+def _telemetry_problems() -> list:
+    from predictionio_tpu.telemetry.registry import REGISTRY
+
+    problems = []
+    text = REGISTRY.render()
+    for family in ("online_events_folded_total", "online_rows_folded_total",
+                   "online_event_to_servable_seconds", "online_lag_seconds",
+                   "online_swaps_total", "online_parity_drift"):
+        if f"# TYPE {family} " not in text:
+            problems.append(f"telemetry: /metrics is missing {family}")
+    return problems
+
+
+def _reset(storage) -> None:
+    from predictionio_tpu.storage.registry import Storage
+
+    storage.close()
+    Storage.reset(None)
+
+
+def run_gate() -> int:
+    problems = []
+    for drill in (_freshness_problems, _crash_problems,
+                  _parity_problems, _telemetry_problems):
+        try:
+            problems += drill()
+        except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+            problems.append(f"{drill.__name__} crashed: {e!r}")
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"online gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

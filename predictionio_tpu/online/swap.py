@@ -1,0 +1,58 @@
+"""DeltaSwapper: publish folded models into the served-state table.
+
+The serving plane's dispatch closures read `server._states[variant]` at
+dispatch time (create_server.py), so publishing a fold is one dict-entry
+replacement under the state lock — the same atomic-swap contract as
+`/reload`, minus the storage round trip. Two deliberate differences from
+the full-reload path:
+
+- **per-user cache invalidation** — a fold changes a handful of users'
+  answers; dropping the whole per-variant result cache (what `/reload`
+  does, correctly: *every* answer changed) would throw away thousands of
+  still-valid entries per fold. The swapper publishes exactly the
+  touched entity ids on the ingest `InvalidationBus`, and each variant's
+  ServingPlane subscription drops those users' entries (plus the
+  anonymous ones) for its own variant only.
+- **stale-state detection** — a fold computed against state S must not
+  clobber a full reload that landed mid-solve. The caller passes the
+  state it folded from; on mismatch the swap is refused and the fold
+  batch replays against the new state on the next poll (`StaleState`
+  propagates through the tailer, which then does not advance its
+  watermark — fold-in's idempotence makes the replay free).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from predictionio_tpu.ingest.invalidation import BUS
+from predictionio_tpu.online.metrics import ONLINE_STALE_SWAPS, ONLINE_SWAPS
+
+
+class StaleState(RuntimeError):
+    """A full /reload replaced the state this fold was computed from."""
+
+
+class DeltaSwapper:
+    def __init__(self, states: Dict[str, object], lock, bus=None):
+        self._states = states
+        self._lock = lock
+        self._bus = bus if bus is not None else BUS
+
+    def swap(self, variant: str, expected_state, models: List[object],
+             touched_users: Optional[List[str]] = None) -> object:
+        """Atomically replace `variant`'s models; returns the new state."""
+        with self._lock:
+            current = self._states.get(variant)
+            if current is not expected_state:
+                ONLINE_STALE_SWAPS.inc()
+                raise StaleState(
+                    f"served state for variant {variant!r} changed mid-fold")
+            new_state = copy.copy(current)
+            new_state.models = models
+            self._states[variant] = new_state
+        ONLINE_SWAPS.labels(variant=variant).inc()
+        if touched_users:
+            self._bus.publish(sorted(touched_users), variant=variant)
+        return new_state

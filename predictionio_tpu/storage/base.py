@@ -13,7 +13,7 @@ import abc
 import dataclasses
 import secrets
 from datetime import datetime
-from typing import TYPE_CHECKING, Iterable, Optional
+from typing import TYPE_CHECKING, Iterable, Optional, Sequence
 
 from predictionio_tpu.data.events import Event
 
@@ -258,13 +258,16 @@ class LEvents(abc.ABC):
         start_time: Optional[datetime] = None,
         until_time: Optional[datetime] = None,
         entity_type: Optional[str] = None,
-        entity_id: Optional[str] = None,
+        entity_id: Optional[str | Sequence[str]] = None,
         event_names: Optional[list[str]] = None,
         target_entity_type: Optional[str] = None,
-        target_entity_id: Optional[str] = None,
+        target_entity_id: Optional[str | Sequence[str]] = None,
         limit: Optional[int] = None,
         reversed: bool = False,
-    ) -> Iterable[Event]: ...
+    ) -> Iterable[Event]:
+        """Entity filters accept one id or a sequence of ids (an
+        IN-style batch lookup; an empty sequence matches nothing)."""
+        ...
 
     def aggregate_properties_columnar(
         self,

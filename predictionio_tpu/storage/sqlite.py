@@ -19,7 +19,7 @@ import threading
 import time
 import uuid
 from datetime import datetime, timezone
-from typing import Iterable, Optional
+from typing import Iterable, Optional, Sequence
 
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.events import Event, format_time, parse_time
@@ -168,6 +168,8 @@ CREATE INDEX IF NOT EXISTS idx_events_scan
     ON events (app_id, channel_id, event_time);
 CREATE INDEX IF NOT EXISTS idx_events_entity
     ON events (app_id, channel_id, entity_type, entity_id);
+CREATE INDEX IF NOT EXISTS idx_events_target
+    ON events (app_id, channel_id, target_entity_type, target_entity_id);
 """
 
 
@@ -880,10 +882,10 @@ class SQLiteLEvents(base.LEvents):
         start_time: Optional[datetime] = None,
         until_time: Optional[datetime] = None,
         entity_type: Optional[str] = None,
-        entity_id: Optional[str] = None,
+        entity_id: Optional[str | Sequence[str]] = None,
         event_names: Optional[list[str]] = None,
         target_entity_type: Optional[str] = None,
-        target_entity_id: Optional[str] = None,
+        target_entity_id: Optional[str | Sequence[str]] = None,
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterable[Event]:
@@ -903,15 +905,25 @@ class SQLiteLEvents(base.LEvents):
         if entity_type is not None:
             clauses.append("entity_type=?")
             params.append(entity_type)
-        if entity_id is not None:
-            clauses.append("entity_id=?")
-            params.append(entity_id)
+        # entity filters accept one id or a batch of ids (one IN query
+        # instead of N point lookups — the online fold plane's cold
+        # fetches would otherwise convoy on the GIL/store lock)
+        for col, want in (("entity_id", entity_id),
+                          ("target_entity_id", target_entity_id)):
+            if want is None:
+                continue
+            if isinstance(want, str):
+                clauses.append(f"{col}=?")
+                params.append(want)
+            else:
+                ids = list(want)
+                if not ids:
+                    return []
+                clauses.append(f"{col} IN ({','.join('?' * len(ids))})")
+                params.extend(ids)
         if target_entity_type is not None:
             clauses.append("target_entity_type=?")
             params.append(target_entity_type)
-        if target_entity_id is not None:
-            clauses.append("target_entity_id=?")
-            params.append(target_entity_id)
         if event_names:
             clauses.append(f"event IN ({','.join('?' * len(event_names))})")
             params.extend(event_names)

@@ -55,6 +55,11 @@ Sites in the tree:
 - `sqlite.pre_commit` — in the sqlite backend between a transaction's
   statements and its COMMIT; `delay:` here widens the write-lock window
   to reproduce `database is locked` contention
+- `online.pre_watermark` — in the online-learning plane's fold tailer,
+  after a batch has folded and hot-swapped into the served state but
+  BEFORE the watermark/dedup state advances; a kill or `error` here
+  forces the next poll to replay the batch, proving fold-in idempotence
+  and zero acked-but-unfolded events (the --online-gate crash drill)
 """
 
 from __future__ import annotations

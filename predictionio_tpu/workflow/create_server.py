@@ -29,6 +29,7 @@ from predictionio_tpu.experiment import (
     RewardTailer,
     VariantRouter,
 )
+from predictionio_tpu.online import OnlineConfig, OnlinePlane
 from predictionio_tpu.plugins import PluginRejection
 from predictionio_tpu.serving import (
     DeadlineExceeded,
@@ -156,7 +157,8 @@ class PredictionServer(HttpService):
                  plugins=None, reuse_port: bool = False,
                  supervisor_pid: Optional[int] = None,
                  serving_config: Optional[ServingConfig] = None,
-                 experiment: Optional[ExperimentConfig] = None):
+                 experiment: Optional[ExperimentConfig] = None,
+                 online: Optional[OnlineConfig] = None):
         from predictionio_tpu.plugins import load_plugins_from_env
 
         self.config = config
@@ -227,6 +229,22 @@ class PredictionServer(HttpService):
             self.serving = self._planes[self._primary_variant]
         self._worker_pid = worker_pid
 
+        # Online-learning plane (opt-in, PIO_ONLINE=1): tails rating
+        # events out of the durable store, folds the dirty factor rows,
+        # and hot-swaps the served state per variant — bandit arms keep
+        # learning mid-experiment. A plane that fails to start must not
+        # take serving down: the server just stays batch-fresh.
+        self.online: Optional[OnlinePlane] = None
+        online_cfg = online if online is not None else OnlineConfig.from_env()
+        if online_cfg is not None:
+            try:
+                self.online = OnlinePlane(self, online_cfg)
+                self.online.start()
+            except Exception:  # noqa: BLE001
+                log.exception("online plane failed to start; serving "
+                              "continues without fold-in")
+                self.online = None
+
         # Alert watchdog (opt-in, PIO_ALERTS=1): rules run against the
         # metrics history; firing/resolve edges become $alert events
         # through a dedicated group-commit writer into the event store.
@@ -293,6 +311,8 @@ class PredictionServer(HttpService):
                 self.serving.snapshot(),
                 instances={v: s.instance.id
                            for v, s in self._states.items()})
+        if self.online is not None:
+            payload["online"] = self.online.snapshot()
         return Response.json(200, payload)
 
     def _variant_headers(self, extra: Optional[dict] = None) -> Optional[dict]:
@@ -417,12 +437,21 @@ class PredictionServer(HttpService):
                          self._states[v].instance.id, v)
         if errors and len(errors) == len(self._variants):
             raise errors[0]
+        if self.online is not None:
+            # outside the state lock: a fold pass holds its own lock
+            # while swapping (which takes the state lock), so rebasing
+            # under the state lock would deadlock against it. A fold
+            # racing this reload is refused by the swapper's stale-state
+            # check and replays against the new instances.
+            self.online.rebase()
 
     def shutdown(self) -> None:
         """Graceful drain: the HTTP server stops accepting and finishes
         in-flight handlers first (their queued queries still dispatch),
         then the batcher's dispatcher thread is joined."""
         super().shutdown()
+        if self.online is not None:
+            self.online.stop()
         if self._tailer is not None:
             self._tailer.stop()
         if self.watchdog is not None:

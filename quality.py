@@ -70,6 +70,18 @@ def main():
                         "$reward events through the real ingest funnel "
                         "converges ≥80% of traffic onto the better arm, "
                         "and the experiment_* telemetry renders")
+    p.add_argument("--online-gate", action="store_true",
+                   help="run the online-learning CI gate (jax on the local "
+                        "backend, in-memory data): trains a small engine, "
+                        "then drills freshness (burst of rating events for "
+                        "existing and never-seen users must reach the "
+                        "served model with p95 event→servable ≤ 5 s), "
+                        "crash recovery (a fault between fold-in and "
+                        "watermark advance must replay to bit-identical "
+                        "factors with zero events lost), full-retrain "
+                        "parity (folded rows bitwise-match their own "
+                        "half-epoch; plane-wide drift bounded), and the "
+                        "online_* telemetry render")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")
     p.add_argument("--scale", choices=["100k", "2m", "20m"], default="100k")
@@ -113,6 +125,11 @@ def main():
 
     if args.experiment_gate:
         from predictionio_tpu.experiment.gate import run_gate
+
+        return run_gate()
+
+    if args.online_gate:
+        from predictionio_tpu.online.gate import run_gate
 
         return run_gate()
 
