@@ -1,0 +1,34 @@
+"""pio-lint: one AST engine, four rule packs, three migrated gates.
+
+The analysis package is the repo's machine-checked safety net: the
+conventions that keep the fleet correct (lock-or-GIL-atomic shared
+state, nothing blocking on the event-loop thread, tier/pad discipline
+in front of every jit boundary, fault sites and metric families that
+stay covered) are enforced here as rules over parsed ASTs — no imports,
+no jax, CI-cheap.
+
+Entry points:
+
+- ``bin/pio-lint`` / ``python -m predictionio_tpu.analysis.cli`` — the
+  CLI (text or ``--json``), exit 1 on any non-baselined finding.
+- ``python quality.py --analysis-gate`` — the CI gate wrapper.
+- :mod:`predictionio_tpu.analysis.engine` — ``Project``/``Module``
+  loading, the rule registry, inline suppressions, and the
+  ``conf/analysis-baseline.json`` workflow.
+- :mod:`predictionio_tpu.analysis.astutil` — the shared resolver the
+  serving/ingest/hotpath gates used to duplicate (router registrations,
+  handler resolution incl. local aliases, same-module call closure).
+
+See docs/static-analysis.md for the rule catalog and the suppression /
+baseline workflow.
+"""
+
+from predictionio_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Module,
+    Project,
+    all_rules,
+    load_baseline,
+    load_default_rules,
+    run_rules,
+)

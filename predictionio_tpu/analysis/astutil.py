@@ -1,0 +1,230 @@
+"""Shared AST resolution for the analysis engine and the CI gates.
+
+This is the canonical home of the machinery `utils/route_scan.py` grew
+ad hoc (that module is now a thin re-export shim): resolve Router
+registrations back to handler FunctionDefs, index a module's function
+definitions, and walk same-module call closures. The gates and every
+rule pack build on these primitives, so the walk/resolve code lives in
+exactly one place.
+
+Over the old route_scan it adds local-alias resolution: a registration
+spelled
+
+    h = self._handle_query
+    router.post("/queries.json", h, blocking=True)
+
+resolves through the assignment to ``_handle_query``, so gated
+invariants can't be dodged by aliasing the handler.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# Router registration spellings: method name → HTTP verb for the
+# get/post/delete/put shorthands; `add`/`add_prefix` carry the verb as
+# their first argument.
+_VERB_METHODS = {"get": "GET", "post": "POST", "delete": "DELETE",
+                 "put": "PUT"}
+
+_ALIAS_DEPTH = 3
+
+
+@dataclasses.dataclass
+class RouteReg:
+    """One Router registration call, handler resolved through aliases."""
+
+    method: str
+    path: str
+    handler_name: str
+    handler_node: ast.AST
+    call: ast.Call
+    blocking: bool
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def assignment_aliases(tree: ast.AST) -> Dict[str, ast.AST]:
+    """name → assigned value for every single-target ``name = <expr>``
+    in the module (any scope; last assignment wins). Used to chase
+    locally-aliased handlers back to the real callable."""
+    aliases: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            aliases[node.targets[0].id] = node.value
+    return aliases
+
+
+def resolve_alias(node: ast.AST, aliases: Dict[str, ast.AST],
+                  depth: int = _ALIAS_DEPTH) -> ast.AST:
+    """Follow ``h = self._handle_query``-style local aliases: while the
+    node is a bare Name with a recorded assignment, step to the assigned
+    expression (bounded, cycle-safe)."""
+    seen = set()
+    for _ in range(depth):
+        if not isinstance(node, ast.Name) or node.id in seen:
+            break
+        seen.add(node.id)
+        nxt = aliases.get(node.id)
+        if nxt is None or nxt is node:
+            break
+        node = nxt
+    return node
+
+
+def _handler_name(node: ast.AST) -> Optional[str]:
+    """The registered callable's terminal name: `self._handle_query` and
+    `_handle_query` both resolve to "_handle_query"; lambdas return
+    "<lambda>"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Lambda):
+        return "<lambda>"
+    return None
+
+
+def _blocking_kwarg(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "blocking":
+            return bool(isinstance(kw.value, ast.Constant) and kw.value.value)
+    return False
+
+
+def registration_details(tree: ast.AST) -> Iterator[RouteReg]:
+    """Yield a :class:`RouteReg` for every Router registration call in
+    the module. `path` is the exact path for get/post/delete/add and
+    "<prefix>*<suffix>" for add_prefix. Handler expressions resolve
+    through local Name aliases before naming."""
+    aliases = assignment_aliases(tree)
+
+    def _resolve(handler: ast.AST) -> Tuple[Optional[str], ast.AST]:
+        name = _handler_name(handler)
+        if isinstance(handler, ast.Name):
+            resolved = resolve_alias(handler, aliases)
+            resolved_name = _handler_name(resolved)
+            if resolved is not handler and resolved_name:
+                return resolved_name, resolved
+        return name, handler
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr in _VERB_METHODS and len(node.args) >= 2:
+            path = _const_str(node.args[0])
+            name, handler = _resolve(node.args[1])
+            # require a leading-slash path AND a resolvable handler so
+            # unrelated `.get("/x", default)` dict lookups don't match
+            if path and path.startswith("/") and name:
+                yield RouteReg(_VERB_METHODS[attr], path, name, handler,
+                               node, _blocking_kwarg(node))
+        elif attr == "add" and len(node.args) >= 3:
+            method = _const_str(node.args[0])
+            path = _const_str(node.args[1])
+            name, handler = _resolve(node.args[2])
+            if method and path and path.startswith("/") and name:
+                yield RouteReg(method.upper(), path, name, handler, node,
+                               _blocking_kwarg(node))
+        elif attr == "add_prefix" and len(node.args) >= 4:
+            method = _const_str(node.args[0])
+            prefix = _const_str(node.args[1])
+            suffix = _const_str(node.args[2])
+            name, handler = _resolve(node.args[3])
+            if method and prefix and prefix.startswith("/") and name:
+                yield RouteReg(method.upper(), f"{prefix}*{suffix or ''}",
+                               name, handler, node, _blocking_kwarg(node))
+
+
+def registrations(tree: ast.AST) -> Iterator[Tuple[str, str, str, ast.AST]]:
+    """Back-compat shape: (http_method, path, handler_name,
+    handler_node) for every Router registration call in the module."""
+    for reg in registration_details(tree):
+        yield reg.method, reg.path, reg.handler_name, reg.handler_node
+
+
+def function_defs(tree: ast.AST) -> dict:
+    """name → FunctionDef for every function in the module (module level
+    and inside classes; last definition wins on collisions)."""
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    return defs
+
+
+def handlers_for(tree: ast.AST, path: str,
+                 method: Optional[str] = None) -> List[ast.AST]:
+    """FunctionDef/Lambda nodes registered for `path` (exact match on
+    the registered path; prefix routes match their "<prefix>*<suffix>"
+    spelling), optionally filtered by HTTP method."""
+    defs = function_defs(tree)
+    out: List[ast.AST] = []
+    for m, p, name, handler_node in registrations(tree):
+        if p != path or (method is not None and m != method.upper()):
+            continue
+        if isinstance(handler_node, ast.Lambda):
+            out.append(handler_node)
+        elif name in defs:
+            out.append(defs[name])
+    return out
+
+
+def attr_calls(fn: ast.AST) -> set:
+    """Attribute-call names inside a function body (x.y() → "y")."""
+    calls = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            calls.add(node.func.attr)
+    return calls
+
+
+def reachable_functions(tree: ast.AST, roots: List[ast.AST],
+                        max_depth: int = 4) -> List[ast.AST]:
+    """The same-module call closure of `roots`: the root handlers plus
+    every module-local function they (transitively) call by terminal
+    name. Cross-module calls are out of scope — gates assert per-file."""
+    defs = function_defs(tree)
+    seen_names: set = set()
+    out: List[ast.AST] = []
+    frontier = list(roots)
+    for _ in range(max_depth):
+        next_frontier: List[ast.AST] = []
+        for fn in frontier:
+            out.append(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name and name in defs and name not in seen_names:
+                    seen_names.add(name)
+                    next_frontier.append(defs[name])
+        if not next_frontier:
+            break
+        frontier = next_frontier
+    return out
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """x → "x", a.b.c → "c", calls unwrap to their func's name."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

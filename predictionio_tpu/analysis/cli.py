@@ -1,0 +1,112 @@
+"""pio-lint CLI: run the analysis engine over the repo.
+
+    python bin/pio-lint                 # text output
+    python bin/pio-lint --json          # machine output (CI)
+    python bin/pio-lint --rules race-shared-state,race-lock-order
+    python bin/pio-lint --list-rules
+    python bin/pio-lint --no-baseline   # show grandfathered findings too
+
+Exit 0 when every finding is baselined (conf/analysis-baseline.json)
+or inline-suppressed; 1 on any new finding or a malformed baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from predictionio_tpu.analysis import engine
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pio-lint",
+        description="whole-repo static analysis: race detector, "
+                    "event-loop blocking-call rule, jit shape "
+                    "discipline, coverage rules, and the migrated CI "
+                    "gates — one AST engine, no imports of the scanned "
+                    "code")
+    p.add_argument("--root", default=engine.default_root(),
+                   help="repo root to scan (default: this checkout)")
+    p.add_argument("--subdir", action="append", default=None,
+                   help="scan root(s) relative to --root (default: "
+                        "predictionio_tpu)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: "
+                        "<root>/conf/analysis-baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="JSON output")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        rules = engine.all_rules()
+        if args.as_json:
+            print(json.dumps({rid: r.doc for rid, r in sorted(rules.items())},
+                             indent=2))
+        else:
+            for rid in sorted(rules):
+                print(f"{rid:24s} {rules[rid].doc}")
+        return 0
+
+    subdirs = tuple(args.subdir) if args.subdir else engine.DEFAULT_SUBDIRS
+    project = engine.Project(args.root, subdirs=subdirs)
+    rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    try:
+        findings = engine.run_rules(project, rule_ids)
+    except KeyError as e:
+        print(f"pio-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(
+        args.root, engine.DEFAULT_BASELINE)
+    baseline = {}
+    baseline_error = None
+    if not args.no_baseline:
+        try:
+            baseline = engine.load_baseline(baseline_path)
+        except (engine.BaselineError, ValueError) as e:
+            baseline_error = str(e)
+    new, grandfathered, stale = engine.partition(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "root": project.root,
+            "modules": len(project.modules()),
+            "findings": [dict(f.to_dict(), baselined=(f.key in baseline))
+                         for f in findings],
+            "new": len(new),
+            "baselined": len(grandfathered),
+            "stale_baseline": stale,
+            "baseline_error": baseline_error,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render(), file=sys.stderr)
+        if args.no_baseline:
+            for f in grandfathered:
+                print(f"{f.render()}  [baselined]", file=sys.stderr)
+        if baseline_error:
+            print(f"pio-lint: baseline error: {baseline_error}",
+                  file=sys.stderr)
+        for key in stale:
+            print(f"pio-lint: note: baseline entry {key!r} no longer "
+                  f"fires — remove it", file=sys.stderr)
+        verdict = "FAIL" if (new or baseline_error) else "OK"
+        print(f"pio-lint: {verdict} — {len(new)} new finding(s), "
+              f"{len(grandfathered)} baselined, "
+              f"{len(project.modules())} module(s) scanned")
+    return 1 if (new or baseline_error) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,683 @@
+"""Rule pack (a): the concurrency/race detector.
+
+The repo's thread-safety discipline is "lock it or keep it GIL-atomic":
+shared instance attributes and module globals touched from more than
+one thread entry point must either be accessed under one consistent
+lock or stick to operations a single CPython bytecode/C-call completes
+atomically (deque.append, a dict subscript store, a plain rebind).
+
+This pack enforces that statically, per module:
+
+1. enumerate thread **entry points** — ``threading.Thread`` targets,
+   ``os.register_at_fork`` hooks, Router-registered handlers, executor
+   ``submit(callable)`` targets, and the public methods of any class
+   that spawns a background thread (those run on arbitrary request
+   threads while the background loop runs);
+2. walk each entry point's same-module call closure and classify every
+   access to ``self.*`` attributes and module globals (store / RMW /
+   mutating call / copy / iteration / load), tracking the stack of
+   ``with <lock>:`` blocks around each access;
+3. for attributes written from ≥2 entry points, flag:
+   - RMW outside any lock (``x += 1``, ``d[k] = d.get(k) + 1``),
+   - Python-level iteration outside any lock,
+   - accesses governed by two *different* locks (consistent-lock
+     inference),
+   - stores published outside the lock that orders the same function's
+     sibling shared writes,
+   - copy-reads (``list(self.x)``) outside a lock when every other
+     access of that attribute holds one.
+
+GIL-atomic single ops stay allowed without a lock — that's the point of
+the discipline, not a hole in it (the deferred-bookkeeper pattern:
+request threads ``deque.append`` lock-free, one drain thread pops under
+its drain lock).
+
+``race-global-rmw`` additionally flags module-global read-modify-writes
+and in-place clear()+refill rebuilds even in modules that spawn no
+threads themselves — module singletons are called from everyone else's
+threads.
+
+``race-lock-order`` flags A→B vs B→A lock acquisition order inversions
+across nested ``with`` blocks and same-module calls made while holding
+a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import Finding, Module, Project, rule
+
+# container mutations a single C call completes under the GIL
+ATOMIC_MUTATIONS = {
+    "append", "appendleft", "extend", "extendleft", "pop", "popleft",
+    "popitem", "add", "discard", "remove", "clear", "update",
+    "setdefault", "insert", "sort", "put", "put_nowait",
+}
+# builtins that copy/reduce a container in one C call — atomic, but a
+# *read* that participates in lock-discipline inference
+COPY_FUNCS = {"list", "tuple", "sorted", "set", "frozenset", "sum",
+              "min", "max", "dict"}
+# attribute types that are inherently thread-safe / thread-owned
+_SAFE_BINDINGS = {
+    "Lock", "RLock", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "local", "Thread", "Timer",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "ThreadPoolExecutor",
+}
+_SKIP_FUNCS = {"__init__", "__post_init__", "__new__"}
+_LOCKISH = ("lock", "mutex", "cond", "sem")
+
+MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                 "OrderedDict", "Counter"}
+
+
+def _lockish_name(name: Optional[str]) -> bool:
+    return bool(name) and any(t in name.lower() for t in _LOCKISH)
+
+
+def _lock_label(expr: ast.AST, class_name: Optional[str]) -> Optional[str]:
+    t = astutil.terminal_name(expr)
+    if not _lockish_name(t):
+        return None
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return f"{class_name or '?'}.{t}"
+    return t
+
+
+@dataclasses.dataclass
+class Access:
+    owner: Optional[str]    # class name, or None for module globals
+    attr: str
+    kind: str               # store|rmw|mutcall|atomic_call|copy|iter|load
+    line: int
+    locks: Tuple[str, ...]  # with-locks held, outermost first
+    fn: str
+
+    @property
+    def lock(self) -> Optional[str]:
+        return self.locks[-1] if self.locks else None
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind in ("store", "rmw", "mutcall")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _subtree_reads(node: ast.AST, owner_is_self: bool, attr: str) -> bool:
+    """Does the expression read self.<attr> (or global <attr>)?"""
+    for n in ast.walk(node):
+        if owner_is_self:
+            if _self_attr(n) == attr:
+                return True
+        elif isinstance(n, ast.Name) and n.id == attr:
+            return True
+    return False
+
+
+class _FnScan:
+    """One function's accesses, lock acquisitions, and call-while-held
+    edges."""
+
+    def __init__(self, fn: ast.AST, class_name: Optional[str],
+                 global_names: Set[str], exempt_attrs: Set[str]):
+        self.fn = fn
+        self.name = getattr(fn, "name", "<lambda>")
+        self.class_name = class_name
+        self.accesses: List[Access] = []
+        self.acquires: Set[str] = set()
+        # (held_locks, callee_terminal_name, line)
+        self.calls_while_held: List[Tuple[Tuple[str, ...], str, int]] = []
+        # (outer_lock, inner_lock, line) from lexically nested withs
+        self.with_edges: List[Tuple[str, str, int]] = []
+        self._globals = global_names
+        self._exempt = exempt_attrs
+        self._consumed: Set[int] = set()
+        body = getattr(fn, "body", [])
+        for stmt in body:
+            self._visit(stmt, ())
+
+    # -- recording ---------------------------------------------------------
+
+    def _record(self, owner: Optional[str], attr: str, kind: str,
+                line: int, locks: Tuple[str, ...]) -> None:
+        if owner is not None and attr in self._exempt:
+            return
+        if owner is not None and _lockish_name(attr):
+            return
+        self.accesses.append(
+            Access(owner, attr, kind, line, locks, self.name))
+
+    def _target_of(self, node: ast.AST) -> Optional[Tuple[Optional[str], str,
+                                                          bool]]:
+        """(owner, attr, via_subscript) when node names shared state:
+        self.X, self.X[...], global G, or G[...]."""
+        sub = False
+        if isinstance(node, ast.Subscript):
+            node, sub = node.value, True
+        a = _self_attr(node)
+        if a is not None:
+            return self.class_name, a, sub
+        if isinstance(node, ast.Name) and node.id in self._globals:
+            return None, node.id, sub
+        return None
+
+    # -- traversal ---------------------------------------------------------
+
+    def _visit(self, node: ast.AST, locks: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                self._visit_expr(item.context_expr, locks)
+                label = _lock_label(item.context_expr, self.class_name)
+                if label:
+                    acquired.append(label)
+            if acquired:
+                for outer in locks:
+                    for inner in acquired:
+                        if outer != inner:
+                            self.with_edges.append(
+                                (outer, inner, node.lineno))
+                self.acquires.update(acquired)
+            inner_locks = locks + tuple(acquired)
+            for stmt in node.body:
+                self._visit(stmt, inner_locks)
+            return
+        if isinstance(node, ast.Assign):
+            rmw = False
+            for tgt in node.targets:
+                hit = self._target_of(tgt)
+                if hit is not None:
+                    owner, attr, _sub = hit
+                    rmw = _subtree_reads(node.value, owner is not None, attr)
+                    self._record(owner, attr, "rmw" if rmw else "store",
+                                 tgt.lineno, locks)
+                    self._consume_target(tgt)
+            self._visit_expr(node.value, locks)
+            return
+        if isinstance(node, ast.AugAssign):
+            hit = self._target_of(node.target)
+            if hit is not None:
+                owner, attr, _sub = hit
+                self._record(owner, attr, "rmw", node.target.lineno, locks)
+                self._consume_target(node.target)
+            self._visit_expr(node.value, locks)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            hit = self._target_of(node.iter)
+            if hit is not None and not isinstance(node.iter, ast.Subscript):
+                owner, attr, _sub = hit
+                self._record(owner, attr, "iter", node.iter.lineno, locks)
+                self._consume_target(node.iter)
+            else:
+                self._visit_expr(node.iter, locks)
+            for stmt in node.body + node.orelse:
+                self._visit(stmt, locks)
+            return
+        if isinstance(node, ast.Expr):
+            self._visit_expr(node.value, locks)
+            return
+        # generic statements: visit expression children with same locks
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._visit(child, locks)
+            else:
+                self._visit_expr(child, locks)
+
+    def _consume_target(self, node: ast.AST) -> None:
+        for n in ast.walk(node):
+            self._consumed.add(id(n))
+
+    def _visit_expr(self, node: ast.AST, locks: Tuple[str, ...]) -> None:
+        for n in ast.walk(node):
+            if id(n) in self._consumed:
+                continue
+            if isinstance(n, ast.Call):
+                # self.X.method(...) — mutation vs. unknown call
+                fnode = n.func
+                if isinstance(fnode, ast.Attribute):
+                    hit = self._target_of(fnode.value)
+                    if hit is not None and not isinstance(
+                            fnode.value, ast.Subscript):
+                        owner, attr, _sub = hit
+                        kind = ("mutcall" if fnode.attr in ATOMIC_MUTATIONS
+                                else "atomic_call")
+                        self._record(owner, attr, kind, n.lineno, locks)
+                        self._consume_target(fnode.value)
+                    # lock held while calling a same-module function
+                    if locks:
+                        self.calls_while_held.append(
+                            (locks, fnode.attr, n.lineno))
+                elif isinstance(fnode, ast.Name):
+                    if fnode.id in COPY_FUNCS and len(n.args) == 1:
+                        hit = self._target_of(n.args[0])
+                        if hit is not None and not isinstance(
+                                n.args[0], ast.Subscript):
+                            owner, attr, _sub = hit
+                            self._record(owner, attr, "copy", n.lineno,
+                                         locks)
+                            self._consume_target(n.args[0])
+                    elif fnode.id == "len" and len(n.args) == 1:
+                        hit = self._target_of(n.args[0])
+                        if hit is not None:
+                            owner, attr, _sub = hit
+                            self._record(owner, attr, "load", n.lineno,
+                                         locks)
+                            self._consume_target(n.args[0])
+                    if locks:
+                        self.calls_while_held.append(
+                            (locks, fnode.id, n.lineno))
+                continue
+            if isinstance(n, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                              ast.DictComp)):
+                for gen in n.generators:
+                    hit = self._target_of(gen.iter)
+                    if hit is not None and not isinstance(
+                            gen.iter, ast.Subscript):
+                        owner, attr, _sub = hit
+                        self._record(owner, attr, "iter", gen.iter.lineno,
+                                     locks)
+                        self._consume_target(gen.iter)
+                continue
+        # plain loads (whatever wasn't consumed by a specific pattern)
+        for n in ast.walk(node):
+            if id(n) in self._consumed:
+                continue
+            a = _self_attr(n)
+            if a is not None:
+                self._record(self.class_name, a, "load", n.lineno, locks)
+                self._consumed.add(id(n))
+            elif isinstance(n, ast.Name) and n.id in self._globals:
+                self._record(None, n.id, "load", n.lineno, locks)
+                self._consumed.add(id(n))
+
+
+class ModuleScan:
+    """All the per-module facts the three concurrency rules share."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        tree = mod.tree
+        assert tree is not None
+        self.defs = astutil.function_defs(tree)
+        self.global_mutables = self._module_globals(tree)
+        self.fn_class: Dict[int, Optional[str]] = {}
+        self.class_spawns: Dict[str, bool] = {}
+        self.exempt_attrs: Dict[Optional[str], Set[str]] = {}
+        self._index_classes(tree)
+        self.thread_targets = self._thread_targets(tree)
+        self.handler_names = {reg.handler_name
+                              for reg in astutil.registration_details(tree)}
+        self.scans: Dict[int, _FnScan] = {}
+        for name, fn in self.defs.items():
+            cls = self.fn_class.get(id(fn))
+            self.scans[id(fn)] = _FnScan(
+                fn, cls, self.global_mutables,
+                self.exempt_attrs.get(cls, set()))
+        self.entry_points = self._entry_points()
+
+    # -- indexing ----------------------------------------------------------
+
+    @staticmethod
+    def _module_globals(tree: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in getattr(tree, "body", []):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                if value is None:
+                    continue
+                mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+                if isinstance(value, ast.Call):
+                    mutable = astutil.terminal_name(value) in MUTABLE_CTORS
+                if not mutable:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) and not _lockish_name(t.id):
+                        out.add(t.id)
+        return out
+
+    def _index_classes(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            spawns = False
+            exempt: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.fn_class[id(sub)] = node.name
+                if isinstance(sub, ast.Call):
+                    t = astutil.terminal_name(sub)
+                    if t in ("Thread", "Timer", "register_at_fork"):
+                        spawns = True
+                if isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        a = _self_attr(tgt)
+                        if a and isinstance(sub.value, ast.Call):
+                            if astutil.terminal_name(
+                                    sub.value) in _SAFE_BINDINGS:
+                                exempt.add(a)
+            self.class_spawns[node.name] = spawns
+            self.exempt_attrs[node.name] = exempt
+
+    @staticmethod
+    def _thread_targets(tree: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = astutil.terminal_name(node)
+            if t in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        n = astutil.terminal_name(kw.value)
+                        if n:
+                            names.add(n)
+            elif t == "register_at_fork":
+                for kw in node.keywords:
+                    n = astutil.terminal_name(kw.value)
+                    if n:
+                        names.add(n)
+            elif (t == "submit" and node.args
+                  and isinstance(node.args[0], (ast.Attribute, ast.Name))):
+                n = astutil.terminal_name(node.args[0])
+                if n:
+                    names.add(n)
+        return names
+
+    def _entry_points(self) -> Dict[str, ast.AST]:
+        eps: Dict[str, ast.AST] = {}
+        for name, fn in self.defs.items():
+            if name in _SKIP_FUNCS:
+                continue
+            cls = self.fn_class.get(id(fn))
+            if name in self.thread_targets or name in self.handler_names:
+                eps[name] = fn
+            elif (cls is not None and self.class_spawns.get(cls)
+                  and not name.startswith("_")):
+                # public methods of a thread-spawning class run on
+                # arbitrary caller threads concurrently with its loop
+                eps[name] = fn
+        return eps
+
+    # -- derived -----------------------------------------------------------
+
+    def reached_by(self) -> Dict[str, List[_FnScan]]:
+        """entry point name → the _FnScans of its call closure."""
+        assert self.mod.tree is not None
+        out: Dict[str, List[_FnScan]] = {}
+        for name, fn in self.entry_points.items():
+            reach = astutil.reachable_functions(self.mod.tree, [fn])
+            scans = []
+            seen: Set[int] = set()
+            for r in reach:
+                if id(r) in self.scans and id(r) not in seen:
+                    # entry points skip each other's bodies: a public
+                    # method calling another public method analyses it,
+                    # that's fine — closure stays as computed
+                    seen.add(id(r))
+                    scans.append(self.scans[id(r)])
+            out[name] = scans
+        return out
+
+
+def _scan(project: Project, mod: Module) -> ModuleScan:
+    cache = project.__dict__.setdefault("_concurrency_cache", {})
+    ms = cache.get(mod.path)
+    if ms is None:
+        ms = ModuleScan(mod)
+        cache[mod.path] = ms
+    return ms
+
+
+# -- rule: race-shared-state ------------------------------------------------
+
+
+def _attr_desc(owner: Optional[str], attr: str) -> str:
+    return f"self.{attr}" if owner else attr
+
+
+@rule("race-shared-state",
+      "shared attributes written from ≥2 thread entry points must be "
+      "lock-consistent or GIL-atomic")
+def race_shared_state(project: Project) -> Iterable[Finding]:
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        ms = _scan(project, mod)
+        if not ms.entry_points:
+            continue
+        reached = ms.reached_by()
+        # (owner, attr) → accesses (deduped) and the EPs whose closure
+        # writes it; plus per-function access lists for sibling-write
+        # lookups across attributes
+        accesses: Dict[Tuple[Optional[str], str], List[Access]] = {}
+        writer_eps: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        fn_accs: Dict[str, List[Access]] = {}
+        seen_scan_ids: Set[int] = set()
+        for ep, scans in reached.items():
+            for fs in scans:
+                for acc in fs.accesses:
+                    if acc.owner is None:
+                        continue    # globals: race-global-rmw's job
+                    key = (acc.owner, acc.attr)
+                    if acc.is_write:
+                        writer_eps.setdefault(key, set()).add(ep)
+                    if id(fs) not in seen_scan_ids:
+                        accesses.setdefault(key, []).append(acc)
+                        fn_accs.setdefault(fs.name, []).append(acc)
+            seen_scan_ids.update(id(fs) for fs in scans)
+        shared_keys = {k for k, eps in writer_eps.items() if len(eps) >= 2}
+        for key in sorted(shared_keys,
+                          key=lambda kv: (kv[0] or "", kv[1])):
+            yield from _check_attr(mod, key, sorted(writer_eps[key]),
+                                   accesses.get(key, []), fn_accs,
+                                   shared_keys)
+
+
+def _check_attr(mod: Module, key: Tuple[Optional[str], str],
+                eps: List[str], accs: List[Access],
+                fn_accs: Dict[str, List[Access]],
+                shared_keys: Set[Tuple[Optional[str], str]]
+                ) -> Iterable[Finding]:
+    owner, attr = key
+    desc = _attr_desc(owner, attr)
+    symbol = f"{owner}.{attr}" if owner else attr
+    locks_used = sorted({a.lock for a in accs if a.lock})
+    governing = locks_used[0] if len(locks_used) == 1 else None
+    ep_note = f"written from entry points {', '.join(eps)}"
+
+    # C: two different locks claim the same attribute
+    if len(locks_used) >= 2:
+        first = next(a for a in accs if a.lock == locks_used[0])
+        other = next(a for a in accs if a.lock == locks_used[1])
+        yield Finding(
+            "race-shared-state", mod.rel, other.line,
+            f"{desc} is accessed under two different locks "
+            f"({locks_used[0]} e.g. line {first.line}, {locks_used[1]} "
+            f"here); {ep_note} — consistent-lock inference failed",
+            symbol=symbol,
+            hint="pick one lock to govern this attribute")
+        return
+
+    for a in accs:
+        if a.lock:
+            continue
+        if a.kind == "rmw":
+            yield Finding(
+                "race-shared-state", mod.rel, a.line,
+                f"{desc} is read-modify-written outside any lock in "
+                f"{a.fn}(); {ep_note} — concurrent updates lose writes",
+                symbol=symbol,
+                hint=(f"take {governing}" if governing
+                      else "guard the update with a lock (or restructure "
+                           "to a single atomic store)"))
+        elif a.kind == "iter":
+            yield Finding(
+                "race-shared-state", mod.rel, a.line,
+                f"{desc} is iterated outside any lock in {a.fn}(); "
+                f"{ep_note} — Python-level iteration over a container "
+                f"another thread mutates can skip/raise mid-loop",
+                symbol=symbol,
+                hint=(f"copy under {governing} first" if governing
+                      else "snapshot with list(...) under a lock first"))
+        elif a.kind == "store":
+            # D: published outside a lock that orders the same
+            # function's sibling shared writes
+            sibling = _locked_sibling_write(fn_accs.get(a.fn, []), a,
+                                            shared_keys)
+            if sibling is not None:
+                yield Finding(
+                    "race-shared-state", mod.rel, a.line,
+                    f"{desc} is published outside {sibling.lock} in "
+                    f"{a.fn}(), which orders its sibling shared write "
+                    f"({_attr_desc(sibling.owner, sibling.attr)}, line "
+                    f"{sibling.line}) under the lock; {ep_note} — "
+                    f"readers pairing the two can see them torn",
+                    symbol=symbol,
+                    hint=f"move this store inside the {sibling.lock} "
+                         f"block")
+    # E: copy-read outside the lock while the writers all hold it — the
+    # only unlocked accesses are atomic copies (unlocked stores/RMW/iter
+    # already got their own findings above), and at least one write is
+    # lock-governed, so the lock clearly means to order this state
+    if governing:
+        meaningful = [a for a in accs
+                      if a.kind in ("store", "rmw", "copy", "iter",
+                                    "mutcall")]
+        unlocked = [a for a in meaningful if not a.lock]
+        if unlocked and all(a.kind == "copy" for a in unlocked) \
+                and any(a.lock and a.is_write for a in meaningful):
+            for a in unlocked:
+                if a.kind == "copy":
+                    yield Finding(
+                        "race-shared-state", mod.rel, a.line,
+                        f"{desc} is copied outside {governing} in "
+                        f"{a.fn}() while every other access holds the "
+                        f"lock; {ep_note} — the copy can interleave with "
+                        f"a locked multi-step update",
+                        symbol=symbol,
+                        hint=f"take {governing} around the read")
+
+
+def _locked_sibling_write(fn_accesses: List[Access], unlocked: Access,
+                          shared_keys: Set[Tuple[Optional[str], str]]
+                          ) -> Optional[Access]:
+    """A locked write in the same function to a *different* shared
+    attribute — evidence the function means to order its publishes."""
+    for a in fn_accesses:
+        if (a.lock and a.is_write
+                and (a.owner, a.attr) != (unlocked.owner, unlocked.attr)
+                and (a.owner, a.attr) in shared_keys):
+            return a
+    return None
+
+
+# -- rule: race-global-rmw --------------------------------------------------
+
+
+@rule("race-global-rmw",
+      "module-global mutables must not be read-modify-written or "
+      "rebuilt in place without a lock")
+def race_global_rmw(project: Project) -> Iterable[Finding]:
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        ms = _scan(project, mod)
+        if not ms.global_mutables:
+            continue
+        for fs in ms.scans.values():
+            cleared: Dict[str, Access] = {}
+            stored: Set[str] = set()
+            for a in fs.accesses:
+                if a.owner is not None or a.lock:
+                    continue
+                if a.kind == "rmw":
+                    yield Finding(
+                        "race-global-rmw", mod.rel, a.line,
+                        f"module global {a.attr} is read-modify-written "
+                        f"outside any lock in {a.fn}() — concurrent "
+                        f"callers lose updates",
+                        symbol=a.attr,
+                        hint="guard with a module lock or fold the "
+                             "update into one atomic store")
+                elif a.kind == "mutcall":
+                    # clear() + later refill = torn intermediate state
+                    src = mod.source.splitlines()
+                    line = (src[a.line - 1] if 0 < a.line <= len(src)
+                            else "")
+                    if f"{a.attr}.clear" in line:
+                        cleared[a.attr] = a
+                elif a.kind == "store":
+                    stored.add(a.attr)
+            for name, a in sorted(cleared.items()):
+                if name in stored:
+                    yield Finding(
+                        "race-global-rmw", mod.rel, a.line,
+                        f"module global {name} is rebuilt in place "
+                        f"(clear() then refilled) in {a.fn}() — "
+                        f"concurrent readers see a partially-filled "
+                        f"map",
+                        symbol=name,
+                        hint="build a local dict and publish it with "
+                             "one atomic rebind")
+
+
+# -- rule: race-lock-order --------------------------------------------------
+
+
+@rule("race-lock-order",
+      "lock acquisition order must be consistent (no A→B vs B→A)")
+def race_lock_order(project: Project) -> Iterable[Finding]:
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        ms = _scan(project, mod)
+        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        acq_closure: Dict[str, Set[str]] = {}
+        for name, fn in ms.defs.items():
+            fs = ms.scans[id(fn)]
+            closure = set(fs.acquires)
+            assert mod.tree is not None
+            for r in astutil.reachable_functions(mod.tree, [fn],
+                                                 max_depth=3):
+                rs = ms.scans.get(id(r))
+                if rs is not None:
+                    closure |= rs.acquires
+            acq_closure[name] = closure
+        for name, fn in ms.defs.items():
+            fs = ms.scans[id(fn)]
+            for outer, inner, line in fs.with_edges:
+                edges.setdefault((outer, inner), (line, fs.name))
+            for held, callee, line in fs.calls_while_held:
+                for inner in acq_closure.get(callee, ()):
+                    for outer in held:
+                        if outer != inner:
+                            edges.setdefault((outer, inner),
+                                             (line, fs.name))
+        reported = set()
+        for (a, b), (line, fn_name) in sorted(edges.items(),
+                                              key=lambda kv: kv[1][0]):
+            if (b, a) in edges and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_line, other_fn = edges[(b, a)]
+                yield Finding(
+                    "race-lock-order", mod.rel, max(line, other_line),
+                    f"lock order inversion: {a} → {b} in {fn_name}() "
+                    f"(line {line}) but {b} → {a} in {other_fn}() "
+                    f"(line {other_line}) — two threads taking opposite "
+                    f"orders deadlock",
+                    symbol=f"{a}/{b}",
+                    hint="pick one acquisition order and hold it "
+                         "everywhere")

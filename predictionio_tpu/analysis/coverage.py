@@ -1,0 +1,133 @@
+"""Rule pack (d): coverage rules.
+
+Two "the receipts must keep existing" checks:
+
+- ``coverage-fault-site``: every ``faults.inject("<site>")`` call site
+  in the package must be referenced (armed) by some test or gate —
+  a fault site nobody drills is a crash-consistency claim nobody
+  proves. Reference corpus: ``tests/**``, the in-package ``*gate*.py``
+  modules, ``quality.py`` and ``bench.py``.
+
+- ``coverage-metric-docs``: every ``*_total``/``*_seconds`` metric
+  family registered on the process-wide REGISTRY must be rendered
+  somewhere an operator will find it — a dashboard panel
+  (``tools/**``) or a doc table (``docs/**``). Telemetry nobody can
+  see regresses silently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import Finding, Project, rule
+
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_SUFFIXES = ("_total", "_seconds")
+
+
+def _const_str_arg(call: ast.Call) -> str:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return ""
+
+
+def _fault_sites(project: Project) -> List[Tuple[str, int, str]]:
+    """(file, line, site) for every faults.inject("site") call."""
+    out = []
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = astutil.terminal_name(node)
+            if t != "inject":
+                continue
+            site = _const_str_arg(node)
+            if site and "." in site:
+                out.append((mod.rel, node.lineno, site))
+    return out
+
+
+def _reference_corpus(project: Project,
+                      extra_subdirs: Tuple[str, ...]) -> str:
+    texts = []
+    for sub in extra_subdirs:
+        for _rel, text in project.text_files(sub, (".py", ".md", ".sh")):
+            texts.append(text)
+    for mod in project.modules():
+        base = mod.rel.rsplit("/", 1)[-1]
+        if "gate" in base:
+            texts.append(mod.source)
+    # top-level drivers next to the package
+    for name in ("quality.py", "bench.py"):
+        for rel, text in project.text_files(".", (".py",)):
+            if rel == name:
+                texts.append(text)
+    return "\n".join(texts)
+
+
+@rule("coverage-fault-site",
+      "every faults.inject() site must be armed by some test or gate")
+def coverage_fault_site(project: Project) -> Iterable[Finding]:
+    sites = _fault_sites(project)
+    if not sites:
+        return
+    corpus = _reference_corpus(project, ("tests",))
+    seen_sites = set()
+    for file, line, site in sorted(sites):
+        if site in seen_sites:
+            continue
+        seen_sites.add(site)
+        if site in corpus:
+            continue
+        yield Finding(
+            "coverage-fault-site", file, line,
+            f"fault site {site!r} is injected here but no test or gate "
+            f"ever arms it (PIO_FAULTS={site}) — the failure mode it "
+            f"marks is unproven",
+            symbol=site,
+            hint=f"add a drill that arms PIO_FAULTS={site} and asserts "
+                 f"the recovery invariant")
+
+
+@rule("coverage-metric-docs",
+      "every *_total/*_seconds REGISTRY family must appear in a "
+      "dashboard panel or doc table")
+def coverage_metric_docs(project: Project) -> Iterable[Finding]:
+    registered: Dict[str, Tuple[str, int]] = {}
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "REGISTRY"):
+                continue
+            name = _const_str_arg(node)
+            if name.endswith(_METRIC_SUFFIXES) and name not in registered:
+                registered[name] = (mod.rel, node.lineno)
+    if not registered:
+        return
+    corpus_parts = []
+    for sub in ("docs", "tools"):
+        for _rel, text in project.text_files(sub, (".md", ".py", ".html")):
+            corpus_parts.append(text)
+    corpus = "\n".join(corpus_parts)
+    for name in sorted(registered):
+        if name in corpus:
+            continue
+        file, line = registered[name]
+        yield Finding(
+            "coverage-metric-docs", file, line,
+            f"metric family {name!r} is registered here but rendered in "
+            f"no dashboard panel or doc table — operators can't find "
+            f"what isn't written down",
+            symbol=name, severity="warning",
+            hint="add it to the metrics reference table in "
+                 "docs/observability.md (or a tools/ dashboard panel)")

@@ -1,0 +1,303 @@
+"""The analysis engine: module loading, the rule registry, findings,
+inline suppressions, and the baseline workflow.
+
+Design:
+
+- A :class:`Project` lazily parses every ``*.py`` under its scan roots
+  once (AST + source + per-line suppression map) and caches the result;
+  every rule shares the cache, so a full lint is one parse pass.
+- A rule is a function ``(project) -> iterable[Finding]`` registered
+  with the :func:`rule` decorator. Rules never import the code they
+  scan.
+- Findings carry ``file:line``, severity, rule id, a stable ``symbol``
+  anchor and a fix hint. The baseline is keyed on
+  ``rule:file:symbol-or-line`` so grandfathered findings survive
+  unrelated line drift.
+- ``# pio-lint: disable=<rule>[,<rule>...]`` on (or standalone
+  immediately above) the flagged line suppresses it; suppressions are
+  for reviewed false positives and should carry a justification
+  comment. ``conf/analysis-baseline.json`` grandfathers pre-existing
+  findings so CI fails only on regressions; every entry must carry a
+  ``reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*pio-lint:\s*disable=([\w\-,\s]+)")
+
+DEFAULT_SUBDIRS = ("predictionio_tpu",)
+DEFAULT_BASELINE = os.path.join("conf", "analysis-baseline.json")
+
+
+def default_root() -> str:
+    """The repo root (two levels above this file's package dir)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+# -- findings ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    file: str          # path relative to the project root, '/'-separated
+    line: int          # 1-based; 0 for whole-project findings
+    message: str
+    severity: str = "error"     # "error" | "warning"
+    symbol: str = ""            # stable anchor (function/attr name)
+    hint: str = ""              # how to fix it
+
+    @property
+    def key(self) -> str:
+        """Baseline key — stable across unrelated line drift."""
+        anchor = self.symbol or str(self.line)
+        return f"{self.rule}:{self.file}:{anchor}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "severity": self.severity, "message": self.message,
+                "symbol": self.symbol, "hint": self.hint, "key": self.key}
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else (self.file or "-")
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f" (fix: {self.hint})"
+        return out
+
+
+# -- modules ----------------------------------------------------------------
+
+
+class Module:
+    """One parsed source file: AST, function index, suppression map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            self.tree = None
+            self.error = str(e)
+        self._suppressions: Optional[Dict[int, set]] = None
+
+    @property
+    def suppressions(self) -> Dict[int, set]:
+        """line → set of disabled rule ids. A trailing comment applies
+        to its own line; a standalone comment line applies to itself
+        AND the following line."""
+        if self._suppressions is None:
+            supp: Dict[int, set] = {}
+            lines = self.source.splitlines()
+            try:
+                tokens = tokenize.generate_tokens(
+                    io.StringIO(self.source).readline)
+                for tok in tokens:
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _SUPPRESS_RE.search(tok.string)
+                    if not m:
+                        continue
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    row = tok.start[0]
+                    supp.setdefault(row, set()).update(rules)
+                    before = lines[row - 1][:tok.start[1]]
+                    if not before.strip():     # standalone comment line
+                        supp.setdefault(row + 1, set()).update(rules)
+            except tokenize.TokenizeError:
+                pass
+            self._suppressions = supp
+        return self._suppressions
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule_id in rules or "all" in rules)
+
+
+class Project:
+    """A set of parsed modules under `root`, plus text access to the
+    rest of the tree (tests/, docs/, tools/) for coverage rules."""
+
+    def __init__(self, root: str,
+                 subdirs: Optional[Sequence[str]] = None):
+        self.root = os.path.abspath(root)
+        self.subdirs = tuple(subdirs) if subdirs else None
+        self._modules: Optional[List[Module]] = None
+
+    def _scan_roots(self) -> List[str]:
+        if not self.subdirs:
+            return [self.root]
+        return [os.path.join(self.root, d) for d in self.subdirs]
+
+    def modules(self) -> List[Module]:
+        if self._modules is None:
+            mods: List[Module] = []
+            for scan_root in self._scan_roots():
+                for dirpath, dirnames, filenames in os.walk(scan_root):
+                    dirnames[:] = sorted(
+                        d for d in dirnames
+                        if d not in ("__pycache__", ".git"))
+                    for fn in sorted(filenames):
+                        if not fn.endswith(".py"):
+                            continue
+                        path = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(path, self.root)
+                        try:
+                            with open(path, encoding="utf-8") as f:
+                                mods.append(Module(path, rel, f.read()))
+                        except OSError:
+                            continue
+            self._modules = mods
+        return self._modules
+
+    def module(self, rel_suffix: str) -> Optional[Module]:
+        """The module whose rel path ends with `rel_suffix`."""
+        suffix = rel_suffix.replace(os.sep, "/")
+        for m in self.modules():
+            if m.rel == suffix or m.rel.endswith("/" + suffix):
+                return m
+        return None
+
+    def text_files(self, subdir: str,
+                   suffixes: Tuple[str, ...]) -> List[Tuple[str, str]]:
+        """[(rel, text)] for files under root/subdir with a suffix —
+        reference corpora (tests, docs, tools) outside the scan roots."""
+        base = os.path.join(self.root, subdir)
+        out: List[Tuple[str, str]] = []
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if not fn.endswith(suffixes):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        out.append((os.path.relpath(path, self.root)
+                                    .replace(os.sep, "/"), f.read()))
+                except OSError:
+                    continue
+        return out
+
+
+# -- rule registry ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    doc: str
+    fn: Callable[[Project], Iterable[Finding]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, doc: str):
+    """Register a rule function ``(project) -> iterable[Finding]``."""
+
+    def deco(fn):
+        _RULES[rule_id] = Rule(rule_id, doc, fn)
+        return fn
+
+    return deco
+
+
+def load_default_rules() -> None:
+    """Import the rule packs (registration happens at import)."""
+    from predictionio_tpu.analysis import (  # noqa: F401
+        concurrency,
+        coverage,
+        eventloop,
+        gates,
+        shapes,
+    )
+
+
+def all_rules() -> Dict[str, Rule]:
+    load_default_rules()
+    return dict(_RULES)
+
+
+def run_rules(project: Project,
+              rule_ids: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run rules over the project, drop inline-suppressed findings,
+    return the rest sorted by (file, line, rule)."""
+    rules = all_rules()
+    if rule_ids is not None:
+        unknown = [r for r in rule_ids if r not in rules]
+        if unknown:
+            raise KeyError(f"unknown rule(s): {unknown} "
+                           f"(known: {sorted(rules)})")
+        selected = [rules[r] for r in rule_ids]
+    else:
+        selected = [rules[r] for r in sorted(rules)]
+    by_rel = {m.rel: m for m in project.modules()}
+    out: List[Finding] = []
+    for r in selected:
+        for f in r.fn(project):
+            mod = by_rel.get(f.file)
+            if mod is not None and f.line and mod.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (missing reason, bad shape)."""
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """key → reason. Every entry must be a reviewed, commented one:
+    a missing/empty ``reason`` is an error, not a default."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("findings", data if isinstance(data, list) else [])
+    out: Dict[str, str] = {}
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("key"):
+            raise BaselineError(f"baseline entry missing 'key': {e!r}")
+        if not str(e.get("reason", "")).strip():
+            raise BaselineError(
+                f"baseline entry {e['key']!r} has no reason — baseline "
+                f"entries must be reviewed and commented")
+        out[e["key"]] = e["reason"]
+    return out
+
+
+def partition(findings: Sequence[Finding], baseline: Dict[str, str]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, baselined, stale_baseline_keys)."""
+    new: List[Finding] = []
+    grandfathered: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.key in baseline:
+            grandfathered.append(f)
+            seen.add(f.key)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - seen)
+    return new, grandfathered, stale

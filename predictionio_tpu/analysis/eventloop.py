@@ -1,0 +1,153 @@
+"""Rule pack (b): the event-loop blocking-call rule.
+
+The selector transport (utils/httploop.py) runs routes registered
+``blocking=False`` (the default) INLINE on the loop thread: one slow
+call there stalls every connection the process owns. Routes doing real
+work must register ``blocking=True`` to run on the worker pool.
+
+The rule finds, per module, every non-blocking Router registration,
+resolves the handler and its same-module call closure, and flags any
+reachable call that can block:
+
+- ``time.sleep``, ``subprocess.*``, ``os.fsync``/``fdatasync``/
+  ``os.system``
+- sqlite/DB-API surface: ``.execute``/``.executemany``/
+  ``.executescript``/``.commit``/``.fetchall``/``.fetchone``
+- blocking socket/HTTP calls: ``.sendall``, ``urlopen``,
+  ``http.client`` requests via ``.getresponse``
+- the storage accessors (``l_events``/``meta_apps``/
+  ``meta_access_keys``/``meta_channels``/``p_events``) — each returns a
+  sqlite-backed DAO, so touching one from the loop thread puts disk I/O
+  on the event loop (the auth path's access-key lookup is the classic
+  miss).
+
+The loop driver itself (any function calling ``.select(...)``) and its
+closure are held to the same list, so loop-internal helpers can't grow
+a blocking call either.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import Finding, Project, rule
+
+# module-qualified calls that block: (module name, attr) — None attr
+# matches any attribute of the module
+_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("os", "fsync"),
+    ("os", "fdatasync"),
+    ("os", "system"),
+    ("subprocess", None),
+    ("shutil", "copytree"),
+}
+# DB-API / blocking-socket method names (on any object)
+_BLOCKING_ATTRS = {
+    "execute", "executemany", "executescript", "commit", "fetchall",
+    "fetchone", "sendall", "getresponse",
+}
+# storage accessors returning sqlite-backed DAOs
+_STORAGE_ACCESSORS = {
+    "l_events", "p_events", "meta_apps", "meta_access_keys",
+    "meta_channels",
+}
+_BARE_CALLS = {"urlopen"}
+
+
+def _blocking_calls(fn: ast.AST) -> List[Tuple[int, str]]:
+    hits: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                for mod_name, attr in _MODULE_CALLS:
+                    if f.value.id == mod_name and attr in (None, f.attr):
+                        hits.append((node.lineno, f"{mod_name}.{f.attr}"))
+                        break
+                else:
+                    if f.attr in _BLOCKING_ATTRS:
+                        hits.append((node.lineno, f".{f.attr}()"))
+                    elif f.attr in _STORAGE_ACCESSORS:
+                        hits.append(
+                            (node.lineno,
+                             f".{f.attr}() (sqlite-backed storage)"))
+            elif f.attr in _BLOCKING_ATTRS:
+                hits.append((node.lineno, f".{f.attr}()"))
+            elif f.attr in _STORAGE_ACCESSORS:
+                hits.append(
+                    (node.lineno, f".{f.attr}() (sqlite-backed storage)"))
+        elif isinstance(f, ast.Name) and f.id in _BARE_CALLS:
+            hits.append((node.lineno, f"{f.id}()"))
+    return hits
+
+
+def _loop_drivers(tree: ast.AST) -> List[ast.AST]:
+    """Functions that drive a selector loop (call ``.select(...)``)."""
+    out = []
+    for name, fn in astutil.function_defs(tree).items():
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "select"):
+                out.append(fn)
+                break
+    return out
+
+
+def _fn_name(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+@rule("loop-blocking-call",
+      "non-blocking route handlers and the selector loop must not "
+      "reach blocking calls (sqlite, sleep, fsync, subprocess, "
+      "sendall)")
+def loop_blocking_call(project: Project) -> Iterable[Finding]:
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        tree = mod.tree
+        defs = astutil.function_defs(tree)
+        seen: Set[Tuple[int, str]] = set()
+
+        def _flag(root_desc: str, roots: List[ast.AST],
+                  symbol: str) -> Iterable[Finding]:
+            for fn in astutil.reachable_functions(tree, roots):
+                for lineno, what in _blocking_calls(fn):
+                    if (lineno, what) in seen:
+                        continue
+                    seen.add((lineno, what))
+                    yield Finding(
+                        "loop-blocking-call", mod.rel, lineno,
+                        f"{_fn_name(fn)}() (reachable from {root_desc}) "
+                        f"calls {what} on the event-loop thread — one "
+                        f"slow call here stalls every connection",
+                        symbol=symbol,
+                        hint="register the route blocking=True (worker "
+                             "pool) or move the call off the loop "
+                             "thread")
+
+        for reg in astutil.registration_details(tree):
+            if reg.blocking:
+                continue
+            handler = reg.handler_node
+            roots: List[ast.AST]
+            if isinstance(handler, ast.Lambda):
+                roots = [handler]
+            elif reg.handler_name in defs:
+                roots = [defs[reg.handler_name]]
+            else:
+                continue
+            yield from _flag(
+                f"non-blocking route {reg.method} {reg.path}", roots,
+                symbol=f"{reg.method} {reg.path}")
+        drivers = _loop_drivers(tree)
+        if drivers:
+            yield from _flag(
+                f"the selector loop ({', '.join(sorted(_fn_name(d) for d in drivers))})",
+                drivers, symbol="<loop>")

@@ -1,0 +1,40 @@
+"""Analysis gate — CI wrapper over the pio-lint engine.
+
+Run via ``python quality.py --analysis-gate``. Fails on any finding not
+grandfathered in ``conf/analysis-baseline.json`` (whose every entry
+must carry a reviewed ``reason``) and not inline-suppressed. No
+imports of the scanned code, no jax — pure AST.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from predictionio_tpu.analysis import engine
+
+
+def run_gate() -> int:
+    project = engine.Project(engine.default_root(),
+                             subdirs=engine.DEFAULT_SUBDIRS)
+    findings = engine.run_rules(project)
+    baseline_path = os.path.join(engine.default_root(),
+                                 engine.DEFAULT_BASELINE)
+    problems = []
+    try:
+        baseline = engine.load_baseline(baseline_path)
+    except (engine.BaselineError, ValueError) as e:
+        baseline = {}
+        problems.append(f"baseline: {e}")
+    new, grandfathered, _stale = engine.partition(findings, baseline)
+    problems.extend(f.render() for f in new)
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"analysis gate: {'FAIL' if problems else 'OK'} "
+          f"({len(problems)} problem(s), {len(grandfathered)} baselined, "
+          f"{len(project.modules())} module(s) scanned)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_gate())

@@ -1,0 +1,314 @@
+"""The three pre-existing ad-hoc AST gates, ported onto the engine.
+
+Each rule reproduces its legacy gate's static scan byte-for-byte
+(message text, sentinel checks, exemptions), so
+``serving/gate.py``, ``ingest/gate.py`` and ``utils/hotpath_gate.py``
+can delegate their static layer here — same CLI flags, same pass/fail
+behavior — while the duplicated walk/resolve code lives in
+:mod:`predictionio_tpu.analysis.astutil` only.
+
+``legacy_lines()`` reconstructs the exact strings the old
+``_static_scan()`` implementations printed: ``file:line: message`` when
+a line is known, ``file: message`` for file-scoped findings, and the
+bare message for project-scoped sentinels.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import (
+    Finding,
+    Project,
+    rule,
+    run_rules as engine_run_rules,
+)
+
+# -- shared helpers ---------------------------------------------------------
+
+
+def _exempt(mod_rel: str, suffixes: Sequence[str]) -> bool:
+    return any(mod_rel == s or mod_rel.endswith("/" + s) for s in suffixes)
+
+
+def legacy_lines(findings: Iterable[Finding]) -> List[str]:
+    out = []
+    for f in findings:
+        if f.line:
+            out.append(f"{f.file}:{f.line}: {f.message}")
+        elif f.file:
+            out.append(f"{f.file}: {f.message}")
+        else:
+            out.append(f.message)
+    return out
+
+
+def run_legacy_static(rule_id: str, pkg_dir: str) -> List[str]:
+    """The old per-gate ``_static_scan()`` surface: run one migrated
+    rule over the package dir and return the legacy problem strings
+    (file findings in scan order, project-scoped sentinels last, as the
+    old scanners printed them)."""
+    project = Project(pkg_dir)
+    findings = engine_run_rules(project, [rule_id])
+    return legacy_lines([f for f in findings if f.file]
+                        + [f for f in findings if not f.file])
+
+
+# -- hotpath: no bare json on the hot routes --------------------------------
+
+_HOT_EXEMPT = ("utils/hotpath_gate.py",)
+_HOT_ROUTES = (
+    ("POST", "/queries.json"),
+    ("POST", "/events.json"),
+    ("POST", "/batch/events.json"),
+)
+_BARE_JSON = {"dumps", "loads"}
+
+
+def _bare_json_calls(fn: ast.AST) -> list:
+    """(lineno, name) for every `json.dumps(...)`/`json.loads(...)`
+    call inside fn. fastjson.dumps/loads spell the module differently and
+    don't match."""
+    hits = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BARE_JSON
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "json"):
+            hits.append((node.lineno, f"json.{node.func.attr}"))
+    return hits
+
+
+@rule("gate-hotpath-json",
+      "hot-route handlers (and their same-module call closure) must "
+      "use utils.fastjson, not bare json.dumps/loads")
+def gate_hotpath_json(project: Project) -> Iterable[Finding]:
+    found = 0
+    for mod in project.modules():
+        if _exempt(mod.rel, _HOT_EXEMPT):
+            continue
+        if mod.tree is None:
+            yield Finding("gate-hotpath-json", mod.rel, 0,
+                          f"unparseable ({mod.error})")
+            continue
+        for method, route in _HOT_ROUTES:
+            handlers = astutil.handlers_for(mod.tree, route, method=method)
+            if not handlers:
+                continue
+            found += 1
+            for fn in astutil.reachable_functions(mod.tree, handlers):
+                for lineno, name in _bare_json_calls(fn):
+                    fn_name = getattr(fn, "name", "<lambda>")
+                    yield Finding(
+                        "gate-hotpath-json", mod.rel, lineno,
+                        f"{fn_name} (reachable from "
+                        f"{method} {route}) calls bare {name}() on the hot "
+                        f"path — use utils.fastjson (bound encoder, cached "
+                        f"envelopes) so encode cost and envelope bytes stay "
+                        f"pinned",
+                        symbol=fn_name,
+                        hint="route the encode through utils.fastjson")
+    if found < len(_HOT_ROUTES):
+        # the gate must notice if the hot routes stop being resolvable —
+        # an empty scan proves nothing
+        yield Finding(
+            "gate-hotpath-json", "", 0,
+            f"static: only {found}/{len(_HOT_ROUTES)} hot routes "
+            f"resolved to router-registered handlers; the hot-path gate "
+            f"has nothing to hold",
+            symbol="<sentinel>")
+
+
+# -- serving: /queries.json must pass admission -----------------------------
+
+_SERVING_EXEMPT = ("serving/gate.py",)
+_QUERY_ROUTE = "/queries.json"
+_DIRECT_DISPATCH = {"predict", "predict_batch"}
+_PLANE_ENTRY = "handle_query"
+
+
+def _contains_query_route(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and node.value == _QUERY_ROUTE:
+            return True
+    return False
+
+
+def _scan_query_handler(fn: ast.FunctionDef, rel: str
+                        ) -> Iterable[Finding]:
+    calls = astutil.attr_calls(fn)
+    if _PLANE_ENTRY not in calls:
+        yield Finding(
+            "gate-serving-admission", rel, fn.lineno,
+            f"{fn.name} routes {_QUERY_ROUTE} without "
+            f"calling the serving plane's {_PLANE_ENTRY}() — predict "
+            f"requests must pass admission control",
+            symbol=fn.name,
+            hint="dispatch through ServingPlane.handle_query")
+    direct = calls & _DIRECT_DISPATCH
+    if direct:
+        yield Finding(
+            "gate-serving-admission", rel, fn.lineno,
+            f"{fn.name} calls {sorted(direct)} directly "
+            f"in the {_QUERY_ROUTE} handler — dispatch belongs behind "
+            f"ServingPlane.{_PLANE_ENTRY} (queue bound, deadlines, shed)",
+            symbol=fn.name,
+            hint="remove the direct engine dispatch")
+
+
+@rule("gate-serving-admission",
+      "every /queries.json handler must go through "
+      "ServingPlane.handle_query (admission control)")
+def gate_serving_admission(project: Project) -> Iterable[Finding]:
+    found_route = False
+    for mod in project.modules():
+        if _exempt(mod.rel, _SERVING_EXEMPT):
+            continue
+        if mod.tree is None:
+            yield Finding("gate-serving-admission", mod.rel, 0,
+                          f"unparseable ({mod.error})")
+            continue
+        # legacy transport: do_* methods with the route constant inline
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name.startswith("do_")
+                    and _contains_query_route(node)):
+                found_route = True
+                yield from _scan_query_handler(node, mod.rel)
+        # event-loop transport: resolve router.post("/queries.json", fn)
+        # back to fn's FunctionDef and hold it to the same contract
+        for handler in astutil.handlers_for(mod.tree, _QUERY_ROUTE,
+                                            method="POST"):
+            found_route = True
+            if isinstance(handler, ast.FunctionDef):
+                yield from _scan_query_handler(handler, mod.rel)
+            else:
+                yield Finding(
+                    "gate-serving-admission", mod.rel, 0,
+                    f"{_QUERY_ROUTE} is registered to a lambda — the "
+                    f"predict handler must be a named function the gate can "
+                    f"hold to the admission contract",
+                    symbol="<lambda>",
+                    hint="register a named handler function")
+    if not found_route:
+        # the gate must notice if the predict route itself disappears —
+        # an empty scan proves nothing
+        yield Finding(
+            "gate-serving-admission", "", 0,
+            f"static: no in-package handler routes {_QUERY_ROUTE}; "
+            f"the serving gate has nothing to hold",
+            symbol="<sentinel>")
+
+
+# -- ingest: /events.json writes must use the write plane -------------------
+
+_INGEST_EXEMPT = ("ingest/gate.py",)
+_EVENTS_ROUTE = "/events.json"
+_PLANE_ENTRIES = {"submit", "_insert_event"}
+
+
+def _routes_single_events(fn: ast.AST) -> bool:
+    """True when fn routes single-event POSTs: contains the /events.json
+    constant (the batch route is a distinct constant and may also be
+    present in the same do_POST — that's fine, we check the single-event
+    funnel, not the batch path)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and node.value == _EVENTS_ROUTE:
+            return True
+    return False
+
+
+@rule("gate-ingest-funnel",
+      "every POST /events.json handler must funnel through "
+      "_insert_event/submit (the group-commit write plane)")
+def gate_ingest_funnel(project: Project) -> Iterable[Finding]:
+    found_route = False
+    found_funnel = False
+    for mod in project.modules():
+        if _exempt(mod.rel, _INGEST_EXEMPT):
+            continue
+        if mod.tree is None:
+            yield Finding("gate-ingest-funnel", mod.rel, 0,
+                          f"unparseable ({mod.error})")
+            continue
+        tree = mod.tree
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            # write handlers only: GET /events.json is the read/find route
+            # and legitimately never touches the write plane
+            if node.name in ("do_POST", "do_PUT") \
+                    and _routes_single_events(node):
+                found_route = True
+                if not (_PLANE_ENTRIES & astutil.attr_calls(node)):
+                    yield Finding(
+                        "gate-ingest-funnel", mod.rel, node.lineno,
+                        f"{node.name} routes "
+                        f"{_EVENTS_ROUTE} without dispatching through the "
+                        f"ingest write plane (_insert_event/submit) — "
+                        f"single-event writes must get group commit and "
+                        f"backpressure",
+                        symbol=node.name,
+                        hint="dispatch through _insert_event/submit")
+        # event-loop transport: resolve router.post("/events.json", fn)
+        # back to fn's FunctionDef and hold it to the same funnel
+        # contract (POST only — GET /events.json is the read route)
+        for handler in astutil.handlers_for(tree, _EVENTS_ROUTE,
+                                            method="POST"):
+            found_route = True
+            if not isinstance(handler, ast.FunctionDef):
+                yield Finding(
+                    "gate-ingest-funnel", mod.rel, 0,
+                    f"POST {_EVENTS_ROUTE} is registered to a lambda — "
+                    f"the write handler must be a named function the gate "
+                    f"can hold to the write-plane contract",
+                    symbol="<lambda>",
+                    hint="register a named handler function")
+            elif not (_PLANE_ENTRIES & astutil.attr_calls(handler)):
+                yield Finding(
+                    "gate-ingest-funnel", mod.rel, handler.lineno,
+                    f"{handler.name} routes "
+                    f"{_EVENTS_ROUTE} without dispatching through the ingest "
+                    f"write plane (_insert_event/submit) — single-event "
+                    f"writes must get group commit and backpressure",
+                    symbol=handler.name,
+                    hint="dispatch through _insert_event/submit")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name == "_insert_event":
+                found_funnel = True
+                calls = astutil.attr_calls(node)
+                if "submit" not in calls:
+                    yield Finding(
+                        "gate-ingest-funnel", mod.rel, node.lineno,
+                        f"_insert_event does not call "
+                        f"the write plane's submit() — the 201 would not be "
+                        f"group-committed or admission-bounded",
+                        symbol="_insert_event",
+                        hint="call GroupCommitWriter.submit")
+                if "insert" in calls:
+                    yield Finding(
+                        "gate-ingest-funnel", mod.rel, node.lineno,
+                        f"_insert_event calls a bare "
+                        f"storage insert() — durable writes belong behind "
+                        f"GroupCommitWriter.submit (coalescing, shed path)",
+                        symbol="_insert_event",
+                        hint="remove the bare insert")
+    if not found_route:
+        # the gate must notice if the ingest route itself disappears —
+        # an empty scan proves nothing
+        yield Finding(
+            "gate-ingest-funnel", "", 0,
+            f"static: no in-package handler routes {_EVENTS_ROUTE}; "
+            f"the ingest gate has nothing to hold",
+            symbol="<sentinel>")
+    if found_route and not found_funnel:
+        yield Finding(
+            "gate-ingest-funnel", "", 0,
+            "static: no in-package _insert_event funnel found; the "
+            "single-event write path is unverifiable",
+            symbol="<sentinel-funnel>")

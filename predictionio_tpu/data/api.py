@@ -136,7 +136,9 @@ class _EventRoutes:
         r = Router()
         r.get("/", self._handle_root)
         r.get("/events.json", self._handle_find, blocking=True)
-        r.get("/stats.json", self._handle_stats)
+        # blocking: _auth's cache-miss path reads meta_access_keys /
+        # meta_channels (sqlite) — that must not run on the loop thread
+        r.get("/stats.json", self._handle_stats, blocking=True)
         r.add_prefix("GET", "/events/", ".json", self._handle_get_event,
                      template="/events/<id>.json", blocking=True)
         r.post("/events.json", self._handle_insert, blocking=True)

@@ -288,7 +288,10 @@ class VariantRouter:
 
     def traffic_share(self) -> Dict[str, float]:
         self._drain()
-        window = list(self._recent)
+        with self._drain_lock:
+            # the bookkeeper extends _recent in multi-step chunks under
+            # this lock; copying outside it can catch a half-applied batch
+            window = list(self._recent)
         n = len(window) or 1
         return {v: window.count(v) / n for v in self.exp_config.variants}
 

@@ -28,130 +28,19 @@ Exit code 0 when clean; 1 with one line per violation otherwise.
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-from predictionio_tpu.utils import route_scan
-
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_EXEMPT = {
-    os.path.join("ingest", "gate.py"),
-}
-
-_EVENTS_ROUTE = "/events.json"
-_BATCH_ROUTE = "/batch/events.json"
-# the write-plane entry points a single-event POST handler must reach
-_PLANE_ENTRIES = {"submit", "_insert_event"}
-
-
-def _routes_single_events(fn: ast.AST) -> bool:
-    """True when fn routes single-event POSTs: contains the /events.json
-    constant (the batch route is a distinct constant and may also be
-    present in the same do_POST — that's fine, we check the single-event
-    funnel, not the batch path)."""
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Constant) and node.value == _EVENTS_ROUTE:
-            return True
-    return False
-
-
-def _attr_calls(fn: ast.AST) -> set:
-    calls = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            calls.add(node.func.attr)
-    return calls
-
-
-def _scan_file(path: str, rel: str) -> tuple[list[str], bool, bool]:
-    """Returns (problems, saw_single_event_route, saw_insert_event_fn)."""
-    with open(path, encoding="utf-8") as f:
-        try:
-            tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            return [f"{rel}: unparseable ({e})"], False, False
-    problems = []
-    saw_route = False
-    saw_funnel = False
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        # write handlers only: GET /events.json is the read/find route
-        # and legitimately never touches the write plane
-        if node.name in ("do_POST", "do_PUT") and _routes_single_events(node):
-            saw_route = True
-            if not (_PLANE_ENTRIES & _attr_calls(node)):
-                problems.append(
-                    f"{rel}:{node.lineno}: {node.name} routes "
-                    f"{_EVENTS_ROUTE} without dispatching through the "
-                    f"ingest write plane (_insert_event/submit) — "
-                    f"single-event writes must get group commit and "
-                    f"backpressure")
-    # event-loop transport: resolve router.post("/events.json", fn) back
-    # to fn's FunctionDef and hold it to the same funnel contract (POST
-    # only — GET /events.json is the read route)
-    for handler in route_scan.handlers_for(tree, _EVENTS_ROUTE,
-                                           method="POST"):
-        saw_route = True
-        if not isinstance(handler, ast.FunctionDef):
-            problems.append(
-                f"{rel}: POST {_EVENTS_ROUTE} is registered to a lambda — "
-                f"the write handler must be a named function the gate can "
-                f"hold to the write-plane contract")
-        elif not (_PLANE_ENTRIES & _attr_calls(handler)):
-            problems.append(
-                f"{rel}:{handler.lineno}: {handler.name} routes "
-                f"{_EVENTS_ROUTE} without dispatching through the ingest "
-                f"write plane (_insert_event/submit) — single-event "
-                f"writes must get group commit and backpressure")
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name == "_insert_event":
-            saw_funnel = True
-            calls = _attr_calls(node)
-            if "submit" not in calls:
-                problems.append(
-                    f"{rel}:{node.lineno}: _insert_event does not call "
-                    f"the write plane's submit() — the 201 would not be "
-                    f"group-committed or admission-bounded")
-            if "insert" in calls:
-                problems.append(
-                    f"{rel}:{node.lineno}: _insert_event calls a bare "
-                    f"storage insert() — durable writes belong behind "
-                    f"GroupCommitWriter.submit (coalescing, shed path)")
-    return problems, saw_route, saw_funnel
 
 
 def _static_scan() -> list[str]:
-    problems = []
-    found_route = False
-    found_funnel = False
-    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, _PKG_DIR)
-            if rel in _EXEMPT:
-                continue
-            file_problems, saw_route, saw_funnel = _scan_file(path, rel)
-            problems.extend(file_problems)
-            found_route = found_route or saw_route
-            found_funnel = found_funnel or saw_funnel
-    if not found_route:
-        # the gate must notice if the ingest route itself disappears —
-        # an empty scan proves nothing
-        problems.append(
-            f"static: no in-package handler routes {_EVENTS_ROUTE}; "
-            f"the ingest gate has nothing to hold")
-    if found_route and not found_funnel:
-        problems.append(
-            "static: no in-package _insert_event funnel found; the "
-            "single-event write path is unverifiable")
-    return problems
+    # the scan itself (do_POST/do_PUT + router-handler resolution, the
+    # _insert_event→submit funnel checks, both sentinels) is the
+    # pio-lint rule `gate-ingest-funnel`; this wrapper keeps the gate's
+    # legacy output shape
+    from predictionio_tpu.analysis.gates import run_legacy_static
+    return run_legacy_static("gate-ingest-funnel", _PKG_DIR)
 
 
 def _runtime_check() -> list[str]:

@@ -127,6 +127,10 @@ class StoreTailer:
         if self._since is None or len(self._seen) < _SEEN_PRUNE_AT:
             return
         cutoff = self._since - 2 * self.overlap
+        # single-writer: poll_once() is the synchronous alternative to the
+        # background thread (tests, catch-up), never run concurrently with
+        # it — and the rebuild publishes atomically by rebinding
+        # pio-lint: disable=race-shared-state
         self._seen = {k: t for k, t in self._seen.items() if t >= cutoff}
 
     # -- background loop ----------------------------------------------------

@@ -261,6 +261,11 @@ class CircuitBreaker:
         self.half_open = False
 
     def record_failure(self, now: float, rapid: bool) -> None:
+        # only the reap path (supervisor main loop) calls this; the reader
+        # thread's record_success does plain stores, and _on_death's
+        # ready-drain beat sequences a dying worker's READY before its
+        # failure is counted
+        # pio-lint: disable=race-shared-state
         self.failures = self.failures + 1 if rapid else 1
         self.half_open = False
         if self.failures >= self.threshold:
@@ -667,7 +672,10 @@ class Supervisor:
 
     def _add_slot(self) -> _Slot:
         slot = _Slot(self._slot_seq, self.cfg)
-        self._slot_seq += 1
+        # single-writer: run() seeds the initial slots before the tick
+        # thread starts (Thread.start is the ordering edge); afterwards
+        # only _tick_loop's scale-up path allocates
+        self._slot_seq += 1  # pio-lint: disable=race-shared-state
         with self._lock:
             self._slots.append(slot)
         return slot

@@ -24,103 +24,19 @@ Exit code 0 when clean; 1 with one line per violation otherwise.
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-from predictionio_tpu.utils import route_scan
-
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_EXEMPT = {
-    os.path.join("serving", "gate.py"),
-}
-
-_QUERY_ROUTE = "/queries.json"
-# engine dispatch spellings a predict handler must not call directly
-_DIRECT_DISPATCH = {"predict", "predict_batch"}
-# the admission-controlled entry point (ServingPlane.handle_query)
-_PLANE_ENTRY = "handle_query"
-
-
-def _contains_query_route(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Constant) and node.value == _QUERY_ROUTE:
-            return True
-    return False
-
-
-def _scan_handler(fn: ast.FunctionDef, rel: str) -> list[str]:
-    problems = []
-    calls = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            calls.add(node.func.attr)
-    if _PLANE_ENTRY not in calls:
-        problems.append(
-            f"{rel}:{fn.lineno}: {fn.name} routes {_QUERY_ROUTE} without "
-            f"calling the serving plane's {_PLANE_ENTRY}() — predict "
-            f"requests must pass admission control")
-    direct = calls & _DIRECT_DISPATCH
-    if direct:
-        problems.append(
-            f"{rel}:{fn.lineno}: {fn.name} calls {sorted(direct)} directly "
-            f"in the {_QUERY_ROUTE} handler — dispatch belongs behind "
-            f"ServingPlane.{_PLANE_ENTRY} (queue bound, deadlines, shed)")
-    return problems
-
-
-def _scan_file(path: str, rel: str) -> tuple[list[str], bool]:
-    """Returns (problems, saw_query_route)."""
-    with open(path, encoding="utf-8") as f:
-        try:
-            tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            return [f"{rel}: unparseable ({e})"], False
-    problems = []
-    saw_route = False
-    # legacy transport: do_* methods with the route constant inline
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.FunctionDef) and node.name.startswith("do_")
-                and _contains_query_route(node)):
-            saw_route = True
-            problems.extend(_scan_handler(node, rel))
-    # event-loop transport: resolve router.post("/queries.json", fn)
-    # back to fn's FunctionDef and hold it to the same contract
-    for handler in route_scan.handlers_for(tree, _QUERY_ROUTE,
-                                           method="POST"):
-        saw_route = True
-        if isinstance(handler, ast.FunctionDef):
-            problems.extend(_scan_handler(handler, rel))
-        else:
-            problems.append(
-                f"{rel}: {_QUERY_ROUTE} is registered to a lambda — the "
-                f"predict handler must be a named function the gate can "
-                f"hold to the admission contract")
-    return problems, saw_route
 
 
 def _static_scan() -> list[str]:
-    problems = []
-    found_route = False
-    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, _PKG_DIR)
-            if rel in _EXEMPT:
-                continue
-            file_problems, saw_route = _scan_file(path, rel)
-            problems.extend(file_problems)
-            found_route = found_route or saw_route
-    if not found_route:
-        # the gate must notice if the predict route itself disappears —
-        # an empty scan proves nothing
-        problems.append(
-            f"static: no in-package handler routes {_QUERY_ROUTE}; "
-            f"the serving gate has nothing to hold")
-    return problems
+    # the scan itself (do_* + router-handler resolution, admission-call
+    # checks, the route-disappeared sentinel) is the pio-lint rule
+    # `gate-serving-admission`; this wrapper keeps the gate's legacy
+    # output shape
+    from predictionio_tpu.analysis.gates import run_legacy_static
+    return run_legacy_static("gate-serving-admission", _PKG_DIR)
 
 
 def _runtime_check() -> list[str]:

@@ -106,6 +106,7 @@ class MetricsHistory:
         from predictionio_tpu.telemetry import slo
         slo.refresh(now)
         data: Dict[str, Dict[Tuple[str, ...], object]] = {}
+        meta: Dict[str, Tuple[str, Tuple[str, ...], Tuple[float, ...]]] = {}
         for m in self.registry.families():
             name = m.name
             if not name.startswith(self.prefixes):
@@ -113,12 +114,16 @@ class MetricsHistory:
             if isinstance(m, Histogram):
                 children = {k: [list(c), s, n]
                             for k, (c, s, n) in m.collect()}
-                self._meta[name] = ("histogram", m.labelnames, m.buckets)
+                meta[name] = ("histogram", m.labelnames, m.buckets)
             else:
                 children = dict(m.collect())
-                self._meta[name] = (m.type, m.labelnames, ())
+                meta[name] = (m.type, m.labelnames, ())
             data[name] = children
         with self._lock:
+            # meta must land with (or before) the sample that references
+            # it: a reader holding a fresh sample but missing its family
+            # meta would drop the series
+            self._meta.update(meta)
             self._samples.append((now, data))
         SAMPLE_SECONDS.set(time.perf_counter() - t0)
         SAMPLES_TOTAL.inc()

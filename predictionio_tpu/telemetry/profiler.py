@@ -319,7 +319,10 @@ class StackSampler:
             except Exception:  # noqa: BLE001 — the sampler must not die
                 pass
             busy = time.perf_counter() - t0
-            self.busy_s += busy
+            # sampler-thread-confined: start() resets busy_s before the
+            # thread exists and the fork hook runs in a child where no
+            # sampler thread survives
+            self.busy_s += busy  # pio-lint: disable=race-shared-state
             PROFILE_BUSY.inc(busy)
             elapsed = time.monotonic() - self._started_monotonic
             if elapsed > 0:

@@ -65,6 +65,7 @@ Sites in the tree:
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 
@@ -75,17 +76,21 @@ class FaultInjected(RuntimeError):
 # site -> (hit threshold, mode, delay_ms)
 _armed: dict[str, tuple[int, str, int]] = {}
 _hits: dict[str, int] = {}
+_hits_lock = threading.Lock()
 _parsed_from: str = ""
 
 
 def _parse() -> None:
-    global _parsed_from
+    global _parsed_from, _armed, _hits
     spec = os.environ.get("PIO_FAULTS", "")
     if spec == _parsed_from:
         return
+    # mark the spec seen (and disarm) before parsing: a bad spec raises
+    # once, at arm time — later inject() calls must not re-raise it
     _parsed_from = spec
-    _armed.clear()
-    _hits.clear()
+    _armed = {}
+    _hits = {}
+    armed: dict[str, tuple[int, str, int]] = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -103,9 +108,12 @@ def _parse() -> None:
                 raise ValueError(f"unknown PIO_FAULTS mode {mode_spec!r}")
         if ":" in part:
             site, n = part.rsplit(":", 1)
-            _armed[site] = (int(n), mode, delay_ms)
+            armed[site] = (int(n), mode, delay_ms)
         else:
-            _armed[part] = (1, mode, delay_ms)
+            armed[part] = (1, mode, delay_ms)
+    # rebind, don't clear-and-refill: an inject() racing the re-arm must
+    # see either the old map or the new one, never a half-built map
+    _armed = armed
 
 
 def inject(site: str) -> None:
@@ -122,8 +130,9 @@ def inject(site: str) -> None:
     if entry is None:
         return
     n, mode, delay_ms = entry
-    _hits[site] = _hits.get(site, 0) + 1
-    if _hits[site] < n:
+    with _hits_lock:
+        hits = _hits[site] = _hits.get(site, 0) + 1
+    if hits < n:
         return
     if mode == "die":
         # stderr survives even though buffers don't get flushed on _exit

@@ -28,92 +28,18 @@ Exit code 0 when clean; 1 with one line per violation otherwise.
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-from predictionio_tpu.utils import route_scan
-
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_EXEMPT = {
-    os.path.join("utils", "hotpath_gate.py"),
-}
-
-# the routes whose handlers (plus same-module call closure) must not
-# touch the stock json encoder/decoder
-_HOT_ROUTES = (
-    ("POST", "/queries.json"),
-    ("POST", "/events.json"),
-    ("POST", "/batch/events.json"),
-)
-
-_BARE_JSON = {"dumps", "loads"}
-
-
-def _bare_json_calls(fn: ast.AST) -> list:
-    """(lineno, name) for every `json.dumps(...)`/`json.loads(...)`
-    call inside fn. fastjson.dumps/loads spell the module differently and
-    don't match."""
-    hits = []
-    for node in ast.walk(fn):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _BARE_JSON
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "json"):
-            hits.append((node.lineno, f"json.{node.func.attr}"))
-    return hits
-
-
-def _scan_file(path: str, rel: str) -> tuple:
-    """Returns (problems, hot_routes_found_here)."""
-    with open(path, encoding="utf-8") as f:
-        try:
-            tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            return [f"{rel}: unparseable ({e})"], 0
-    problems = []
-    found = 0
-    for method, route in _HOT_ROUTES:
-        handlers = route_scan.handlers_for(tree, route, method=method)
-        if not handlers:
-            continue
-        found += 1
-        for fn in route_scan.reachable_functions(tree, handlers):
-            for lineno, name in _bare_json_calls(fn):
-                fn_name = getattr(fn, "name", "<lambda>")
-                problems.append(
-                    f"{rel}:{lineno}: {fn_name} (reachable from "
-                    f"{method} {route}) calls bare {name}() on the hot "
-                    f"path — use utils.fastjson (bound encoder, cached "
-                    f"envelopes) so encode cost and envelope bytes stay "
-                    f"pinned")
-    return problems, found
 
 
 def _static_scan() -> list:
-    problems = []
-    found = 0
-    for dirpath, _dirnames, filenames in os.walk(_PKG_DIR):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, _PKG_DIR)
-            if rel in _EXEMPT:
-                continue
-            file_problems, file_found = _scan_file(path, rel)
-            problems.extend(file_problems)
-            found += file_found
-    if found < len(_HOT_ROUTES):
-        # the gate must notice if the hot routes stop being resolvable —
-        # an empty scan proves nothing
-        problems.append(
-            f"static: only {found}/{len(_HOT_ROUTES)} hot routes "
-            f"resolved to router-registered handlers; the hot-path gate "
-            f"has nothing to hold")
-    return problems
+    # the scan itself (hot-route resolution, call closure, bare-json
+    # detection, the resolvable-routes sentinel) is the pio-lint rule
+    # `gate-hotpath-json`; this wrapper keeps the gate's legacy output
+    from predictionio_tpu.analysis.gates import run_legacy_static
+    return run_legacy_static("gate-hotpath-json", _PKG_DIR)
 
 
 def _runtime_check() -> list:

@@ -417,13 +417,17 @@ class EventLoopHttpServer:
             self._set_parked(conn, True)
 
     def _set_parked(self, conn: _Conn, parked: bool) -> None:
+        # loop-confined: every caller runs on the loop thread — accept,
+        # pump, parse-error and close all do; pause/resume marshal through
+        # _control(), and shutdown's direct _close_all only runs once the
+        # loop thread is known dead
         was = conn.state == _PARKED
         if parked and not was:
             conn.state = _PARKED
-            self._n_parked += 1
+            self._n_parked += 1  # pio-lint: disable=race-shared-state
             self._parked_gauge.set(self._n_parked)
         elif not parked and was:
-            self._n_parked -= 1
+            self._n_parked -= 1  # pio-lint: disable=race-shared-state
             self._parked_gauge.set(self._n_parked)
 
     def _close_conn(self, conn: _Conn) -> None:
@@ -538,14 +542,16 @@ class EventLoopHttpServer:
         conn.state = _PROCESSING
         conn.close_after = close
         conn.n_requests += 1
-        self._active += 1
+        # _active is loop-confined: _pump and _reply_parse_error run on
+        # the loop thread, and workers hand _complete back via call_soon
+        self._active += 1  # pio-lint: disable=race-shared-state
         route = self.router.lookup(req.method, req.path)
         if route is None:
             if self.router.handles_method(req.method):
                 route = FALLBACK_404
             else:
                 # stdlib parity: a known verb with no handler at all → 501
-                self._active -= 1
+                self._active -= 1  # pio-lint: disable=race-shared-state
                 conn.state = _READING
                 self._reply_parse_error(
                     conn, _ParseError(
@@ -592,14 +598,14 @@ class EventLoopHttpServer:
         conn.buf = b"" if not keep_alive else conn.buf
         conn.head = None
         conn.body_needed = 0
-        self._active += 1
+        self._active += 1  # pio-lint: disable=race-shared-state
         self._complete(conn, resp, trace_id)
 
     def _complete(self, conn: _Conn, resp: Response, trace_id: str) -> None:
         """Loop-thread: assemble head+body, queue on the connection, and
         flush. Runs for inline routes, worker completions, and parse
         errors alike."""
-        self._active -= 1
+        self._active -= 1  # pio-lint: disable=race-shared-state
         if conn.closed:
             if resp.on_sent is not None:
                 resp.on_sent()

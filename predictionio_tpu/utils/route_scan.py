@@ -1,145 +1,19 @@
-"""Shared AST helpers for the CI gates: resolve router registrations.
-
-The serving/ingest/hotpath gates hold invariants about *the handler that
-serves a route* ("the /queries.json handler must call handle_query",
-"no bare json.dumps on the hot path"). Before the event-loop transport,
-routes lived inside `do_*` methods and a gate could scan those directly;
-now they are plain functions registered on a `Router` at construction:
-
-    router.post("/queries.json", self._handle_query, blocking=True)
-    r.add_prefix("POST", "/webhooks/", ".json", self._handle_webhook, ...)
-
-This module finds those registration calls in a parsed module and
-resolves the registered callables back to their FunctionDef (or Lambda)
-nodes, so the gates can keep asserting on the handler bodies without
-importing anything.
+"""Back-compat shim: the gates' shared AST helpers moved to
+:mod:`predictionio_tpu.analysis.astutil` (the pio-lint engine's canonical
+resolver, which also follows locally-assigned handler aliases like
+``h = self._handle_query; router.post(..., h)``). Import from there;
+this module just re-exports the old surface for existing callers.
 """
 
 from __future__ import annotations
 
-import ast
-from typing import Iterator, List, Optional, Tuple
+from predictionio_tpu.analysis.astutil import (  # noqa: F401
+    attr_calls,
+    function_defs,
+    handlers_for,
+    reachable_functions,
+    registrations,
+)
 
-# Router registration spellings: method name → (HTTP verb or None for
-# "first arg is the verb", index of the path argument, index of the
-# handler argument).
-_VERB_METHODS = {"get": "GET", "post": "POST", "delete": "DELETE",
-                 "put": "PUT"}
-
-
-def _handler_name(node: ast.AST) -> Optional[str]:
-    """The registered callable's terminal name: `self._handle_query` and
-    `_handle_query` both resolve to "_handle_query"; lambdas return
-    "<lambda>"."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Lambda):
-        return "<lambda>"
-    return None
-
-
-def _const_str(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def registrations(tree: ast.AST) -> Iterator[Tuple[str, str, str, ast.AST]]:
-    """Yield (http_method, path, handler_name, handler_node) for every
-    Router registration call in the module. `path` is the exact path for
-    get/post/delete/add and "<prefix>*<suffix>" for add_prefix."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)):
-            continue
-        attr = node.func.attr
-        if attr in _VERB_METHODS and len(node.args) >= 2:
-            path = _const_str(node.args[0])
-            name = _handler_name(node.args[1])
-            # require a leading-slash path AND a resolvable handler so
-            # unrelated `.get("/x", default)` dict lookups don't match
-            if path and path.startswith("/") and name:
-                yield _VERB_METHODS[attr], path, name, node.args[1]
-        elif attr == "add" and len(node.args) >= 3:
-            method = _const_str(node.args[0])
-            path = _const_str(node.args[1])
-            name = _handler_name(node.args[2])
-            if method and path and path.startswith("/") and name:
-                yield method.upper(), path, name, node.args[2]
-        elif attr == "add_prefix" and len(node.args) >= 4:
-            method = _const_str(node.args[0])
-            prefix = _const_str(node.args[1])
-            suffix = _const_str(node.args[2])
-            name = _handler_name(node.args[3])
-            if method and prefix and prefix.startswith("/") and name:
-                yield (method.upper(), f"{prefix}*{suffix or ''}", name,
-                       node.args[3])
-
-
-def function_defs(tree: ast.AST) -> dict:
-    """name → FunctionDef for every function in the module (module level
-    and inside classes; last definition wins on collisions)."""
-    defs: dict = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs[node.name] = node
-    return defs
-
-
-def handlers_for(tree: ast.AST, path: str,
-                 method: Optional[str] = None) -> List[ast.AST]:
-    """FunctionDef/Lambda nodes registered for `path` (exact match on
-    the registered path; prefix routes match their "<prefix>*<suffix>"
-    spelling), optionally filtered by HTTP method."""
-    defs = function_defs(tree)
-    out: List[ast.AST] = []
-    for m, p, name, handler_node in registrations(tree):
-        if p != path or (method is not None and m != method.upper()):
-            continue
-        if isinstance(handler_node, ast.Lambda):
-            out.append(handler_node)
-        elif name in defs:
-            out.append(defs[name])
-    return out
-
-
-def attr_calls(fn: ast.AST) -> set:
-    """Attribute-call names inside a function body (x.y() → "y")."""
-    calls = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and isinstance(node.func,
-                                                     ast.Attribute):
-            calls.add(node.func.attr)
-    return calls
-
-
-def reachable_functions(tree: ast.AST, roots: List[ast.AST],
-                        max_depth: int = 4) -> List[ast.AST]:
-    """The same-module call closure of `roots`: the root handlers plus
-    every module-local function they (transitively) call by terminal
-    name. Cross-module calls are out of scope — gates assert per-file."""
-    defs = function_defs(tree)
-    seen_names: set = set()
-    out: List[ast.AST] = []
-    frontier = list(roots)
-    for _ in range(max_depth):
-        next_frontier: List[ast.AST] = []
-        for fn in frontier:
-            out.append(fn)
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = None
-                if isinstance(node.func, ast.Attribute):
-                    name = node.func.attr
-                elif isinstance(node.func, ast.Name):
-                    name = node.func.id
-                if name and name in defs and name not in seen_names:
-                    seen_names.add(name)
-                    next_frontier.append(defs[name])
-        if not next_frontier:
-            break
-        frontier = next_frontier
-    return out
+__all__ = ["attr_calls", "function_defs", "handlers_for",
+           "reachable_functions", "registrations"]

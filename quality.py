@@ -70,6 +70,15 @@ def main():
                         "$reward events through the real ingest funnel "
                         "converges ≥80% of traffic onto the better arm, "
                         "and the experiment_* telemetry renders")
+    p.add_argument("--analysis-gate", action="store_true",
+                   help="run the static-analysis CI gate (no jax, no "
+                        "imports of the scanned code): the pio-lint "
+                        "engine's full rule set — concurrency race "
+                        "detector, event-loop blocking-call rule, jit "
+                        "shape discipline, coverage rules, and the "
+                        "migrated serving/ingest/hotpath static gates — "
+                        "fails on any finding not inline-suppressed or "
+                        "grandfathered in conf/analysis-baseline.json")
     p.add_argument("--online-gate", action="store_true",
                    help="run the online-learning CI gate (jax on the local "
                         "backend, in-memory data): trains a small engine, "
@@ -125,6 +134,11 @@ def main():
 
     if args.experiment_gate:
         from predictionio_tpu.experiment.gate import run_gate
+
+        return run_gate()
+
+    if args.analysis_gate:
+        from predictionio_tpu.analysis.gate import run_gate
 
         return run_gate()
 

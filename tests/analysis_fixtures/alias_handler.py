@@ -1,0 +1,13 @@
+"""Fixture: a /queries.json handler registered through a local alias
+(`h = self._handle_query`). The resolver must chase the assignment so
+the admission gate still sees the direct-dispatch violation inside."""
+
+
+class AliasedAPI:
+    def router(self, r):
+        h = self._handle_query
+        r.post("/queries.json", h, blocking=True)
+        return r
+
+    def _handle_query(self, req):
+        return self.engine.predict(req)

@@ -1,0 +1,26 @@
+"""Fixture: a non-blocking route whose handler reaches sqlite and
+time.sleep — both must be flagged by loop-blocking-call. The
+blocking=True route doing the same things is legal (worker pool)."""
+
+import sqlite3
+import time
+
+
+class FixtureAPI:
+    def router(self, r):
+        r.get("/fast.json", self._handle_fast)
+        r.post("/slow.json", self._handle_slow, blocking=True)
+        return r
+
+    def _handle_fast(self, req):
+        conn = sqlite3.connect(":memory:")
+        conn.execute("select 1")
+        self._settle()
+        return req
+
+    def _settle(self):
+        time.sleep(0.01)
+
+    def _handle_slow(self, req):
+        time.sleep(0.5)
+        return req

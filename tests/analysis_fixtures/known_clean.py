@@ -1,0 +1,31 @@
+"""Fixture: the same shape as known_racy, kept legal.
+
+Every read-modify-write holds the lock; the request-side deque append
+is a single GIL-atomic mutation (the deferred-bookkeeper pattern the
+race rule must NOT outlaw).
+"""
+
+import threading
+from collections import deque
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._pending = deque()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def submit(self, item):
+        self._pending.append(item)
+
+    def drain(self):
+        with self._lock:
+            while self._pending:
+                self._pending.popleft()
+            self.count += 1

@@ -1,0 +1,32 @@
+"""Fixture: a thread-spawning class with two textbook races.
+
+`count` is read-modify-written without a lock from both the background
+thread and a public method (lost updates); `items` is published outside
+the lock that orders its sibling `log` write in the same functions —
+readers pairing the two can see them torn (the history-store bug
+shape).
+"""
+
+import threading
+
+
+class RacyWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = {}
+        self.log = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.count += 1
+            self.items["tick"] = self.count
+            with self._lock:
+                self.log.append(("tick", self.count))
+
+    def poke(self):
+        self.count += 1
+        self.items["poke"] = self.count
+        with self._lock:
+            self.log.append(("poke", self.count))

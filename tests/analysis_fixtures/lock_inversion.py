@@ -1,0 +1,22 @@
+"""Fixture: two locks acquired in opposite orders on two paths —
+the classic ABBA deadlock shape race-lock-order must report."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def forward(self):
+        with self._lock_a:
+            with self._lock_b:
+                self.a += 1
+
+    def backward(self):
+        with self._lock_b:
+            with self._lock_a:
+                self.b += 1

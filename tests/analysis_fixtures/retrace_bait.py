@@ -1,0 +1,27 @@
+"""Fixture: a len()-derived dimension passed straight into a
+jit-wrapped callable (flagged) next to the disciplined spelling that
+rounds the size through a pad helper first (legal)."""
+
+
+def metered_jit(fn, label=""):
+    return fn
+
+
+def _solve(n, rows):
+    return rows
+
+
+solve = metered_jit(_solve, label="fixture.solve")
+
+
+def bad_call(rows):
+    return solve(len(rows), rows)
+
+
+def good_call(rows):
+    n = _pad_rows(len(rows))
+    return solve(n, rows)
+
+
+def _pad_rows(n):
+    return max(4, 1 << (n - 1).bit_length())

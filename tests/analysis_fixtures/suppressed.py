@@ -1,0 +1,19 @@
+"""Fixture: the known_racy shape with both inline suppression
+spellings (trailing and standalone-line-above) — the engine must not
+report either site."""
+
+import threading
+
+
+class SuppressedWorker:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.count += 1  # pio-lint: disable=race-shared-state
+
+    def poke(self):
+        # pio-lint: disable=race-shared-state
+        self.count += 1

@@ -1,0 +1,300 @@
+"""pio-lint static-analysis engine: rule packs on known fixtures, the
+suppression/baseline workflow, the migrated gate rules, and a self-scan
+holding the live tree clean.
+
+Also the regression tests for the concurrency/blocking findings the
+first whole-repo run surfaced (fault-counter exactness, history meta
+publication, traffic-share reads, the /stats.json registration) — if a
+fix regresses, both the behavioral test here and the self-scan fail.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from predictionio_tpu.analysis import astutil, engine
+from predictionio_tpu.analysis.cli import main as lint_main
+from predictionio_tpu.analysis.engine import (
+    BaselineError,
+    Finding,
+    Module,
+    Project,
+)
+from predictionio_tpu.analysis.gates import run_legacy_static
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_on_fixtures(rule_ids):
+    return engine.run_rules(Project(FIXTURES), rule_ids)
+
+
+# -- engine -----------------------------------------------------------------
+
+
+class TestEngine:
+    def test_finding_key_is_symbol_anchored(self):
+        f = Finding("r", "a/b.py", 42, "msg", symbol="fn")
+        assert f.key == "r:a/b.py:fn"
+        assert Finding("r", "a/b.py", 42, "msg").key == "r:a/b.py:42"
+
+    def test_suppressions_trailing_and_standalone(self):
+        src = ("x = 1  # pio-lint: disable=rule-a\n"
+               "# pio-lint: disable=rule-b, rule-c\n"
+               "y = 2\n"
+               "z = 3\n")
+        m = Module("f.py", "f.py", src)
+        assert m.suppressed("rule-a", 1)
+        assert m.suppressed("rule-b", 3) and m.suppressed("rule-c", 3)
+        assert not m.suppressed("rule-a", 3)
+        assert not m.suppressed("rule-b", 4)
+
+    def test_unknown_rule_is_an_error(self):
+        with pytest.raises(KeyError):
+            engine.run_rules(Project(FIXTURES), ["no-such-rule"])
+
+    def test_baseline_entry_requires_reason(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(
+            {"findings": [{"key": "r:f.py:fn", "reason": ""}]}))
+        with pytest.raises(BaselineError):
+            engine.load_baseline(str(p))
+        p.write_text(json.dumps({"findings": [{"reason": "no key"}]}))
+        with pytest.raises(BaselineError):
+            engine.load_baseline(str(p))
+        p.write_text(json.dumps(
+            {"findings": [{"key": "r:f.py:fn", "reason": "reviewed"}]}))
+        assert engine.load_baseline(str(p)) == {"r:f.py:fn": "reviewed"}
+
+    def test_partition_splits_new_grandfathered_stale(self):
+        f1 = Finding("r", "a.py", 1, "m", symbol="x")
+        f2 = Finding("r", "b.py", 2, "m", symbol="y")
+        baseline = {f2.key: "reviewed", "r:gone.py:z": "stale"}
+        new, old, stale = engine.partition([f1, f2], baseline)
+        assert new == [f1] and old == [f2] and stale == ["r:gone.py:z"]
+
+
+# -- rule packs on fixtures -------------------------------------------------
+
+
+class TestRaceRules:
+    def test_known_racy_flags_rmw_and_inconsistent_locks(self):
+        findings = run_on_fixtures(["race-shared-state"])
+        racy = [f for f in findings if f.file == "known_racy.py"]
+        attrs = {f.symbol for f in racy}
+        assert any("count" in a for a in attrs), racy
+        assert any("items" in a for a in attrs), racy
+
+    def test_known_clean_and_suppressed_stay_silent(self):
+        findings = run_on_fixtures(["race-shared-state"])
+        assert not [f for f in findings
+                    if f.file in ("known_clean.py", "suppressed.py")]
+
+    def test_lock_inversion_reported_once(self):
+        findings = run_on_fixtures(["race-lock-order"])
+        inv = [f for f in findings if f.file == "lock_inversion.py"]
+        assert len(inv) == 1, inv
+        assert "lock_a" in inv[0].message and "lock_b" in inv[0].message
+
+
+class TestLoopBlockingRule:
+    def test_nonblocking_route_closure_flagged(self):
+        findings = engine.run_rules(Project(FIXTURES),
+                                    ["loop-blocking-call"])
+        hits = [f for f in findings if f.file == "blocking_on_loop.py"]
+        whats = " ".join(f.message for f in hits)
+        assert ".execute()" in whats and "time.sleep" in whats
+        # the blocking=True route's sleep is legal: all findings anchor
+        # to the non-blocking route
+        assert {f.symbol for f in hits} == {"GET /fast.json"}
+
+    def test_live_stats_route_is_blocking(self):
+        # regression for the finding that started this: GET /stats.json
+        # reaches the sqlite-backed meta accessors via _auth, so its
+        # registration must put it on the worker pool
+        proj = Project(REPO_ROOT, subdirs=("predictionio_tpu",))
+        mod = proj.module("data/api.py")
+        regs = [r for r in astutil.registration_details(mod.tree)
+                if r.path == "/stats.json"]
+        assert regs and all(r.blocking for r in regs)
+
+
+class TestShapeRule:
+    def test_len_into_jit_flagged_pad_helper_not(self):
+        findings = run_on_fixtures(["jit-shape-discipline"])
+        hits = [f for f in findings if f.file == "retrace_bait.py"]
+        assert {f.symbol for f in hits} == {"bad_call->solve"}, hits
+
+
+class TestGateRules:
+    def test_alias_registration_resolved_to_handler(self):
+        # satellite 6: `h = self._handle_query; r.post(..., h)` must
+        # resolve through the alias — the old resolver missed it
+        findings = run_on_fixtures(["gate-serving-admission"])
+        hits = [f for f in findings if f.file == "alias_handler.py"]
+        msgs = " ".join(f.message for f in hits)
+        assert "_handle_query" in msgs
+        assert "without" in msgs and "predict" in msgs
+
+    def test_legacy_static_matches_engine_and_passes_live(self):
+        pkg = os.path.join(REPO_ROOT, "predictionio_tpu")
+        for rule_id in ("gate-hotpath-json", "gate-serving-admission",
+                        "gate-ingest-funnel"):
+            assert run_legacy_static(rule_id, pkg) == []
+
+    def test_legacy_lines_reconstruct_old_format(self):
+        from predictionio_tpu.analysis.gates import legacy_lines
+        lines = legacy_lines([
+            Finding("r", "a.py", 3, "boom"),
+            Finding("r", "a.py", 0, "file-scoped"),
+            Finding("r", "", 0, "sentinel"),
+        ])
+        assert lines == ["a.py:3: boom", "a.py: file-scoped", "sentinel"]
+
+
+# -- self-scan + CLI --------------------------------------------------------
+
+
+class TestSelfScan:
+    def test_live_tree_scans_clean_modulo_baseline(self):
+        proj = Project(REPO_ROOT, subdirs=engine.DEFAULT_SUBDIRS)
+        findings = engine.run_rules(proj)
+        baseline = engine.load_baseline(
+            os.path.join(REPO_ROOT, engine.DEFAULT_BASELINE))
+        new, _old, _stale = engine.partition(findings, baseline)
+        assert not new, "\n".join(f.render() for f in new)
+
+    def test_cli_json_exit_zero(self, capsys):
+        rc = lint_main(["--root", REPO_ROOT, "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["new"] == 0 and payload["baseline_error"] is None
+        assert payload["modules"] > 100
+
+    def test_cli_rules_filter_and_list(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        listed = capsys.readouterr().out
+        for rid in ("race-shared-state", "loop-blocking-call",
+                    "jit-shape-discipline", "gate-hotpath-json",
+                    "gate-serving-admission", "gate-ingest-funnel",
+                    "coverage-fault-site", "coverage-metric-docs",
+                    "race-lock-order", "race-global-rmw"):
+            assert rid in listed
+        assert lint_main(["--rules", "bogus"]) == 2
+
+
+# -- concurrency-fix regressions --------------------------------------------
+
+
+class TestConcurrencyFixes:
+    def test_fault_hit_counter_exact_under_threads(self, monkeypatch):
+        from predictionio_tpu.utils import faults
+        site = "analysis.regression.site"
+        monkeypatch.setenv("PIO_FAULTS", f"{site}:999999=delay:0")
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+        try:
+            faults._parse()
+            n_threads, per_thread = 8, 2000
+
+            def hammer():
+                for _ in range(per_thread):
+                    faults.inject(site)
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert faults._hits[site] == n_threads * per_thread
+        finally:
+            sys.setswitchinterval(old_interval)
+            monkeypatch.setenv("PIO_FAULTS", "")
+            faults._parse()
+
+    def test_history_meta_consistent_under_concurrent_reads(self):
+        from predictionio_tpu.telemetry.history import MetricsHistory
+        from predictionio_tpu.telemetry.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        counter = reg.counter("test_hammer_total", "fixture").labels()
+        hist = MetricsHistory(registry=reg, interval_s=0.05, window_s=10.0,
+                              prefixes=("test_",))
+        errors = []
+        stop = threading.Event()
+
+        def read():
+            while not stop.is_set():
+                try:
+                    snap = hist.snapshot_json()
+                    for fam in snap["families"].values():
+                        assert fam["type"]
+                    hist.series("test_hammer_total")
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(e)
+                    return
+
+        readers = [threading.Thread(target=read) for _ in range(3)]
+        for t in readers:
+            t.start()
+        for i in range(300):
+            counter.inc()
+            hist.sample_now(now=1000.0 + i)
+        stop.set()
+        for t in readers:
+            t.join()
+        assert not errors, errors
+        snap = hist.snapshot_json()
+        assert "test_hammer_total" in snap["families"]
+
+    def test_traffic_share_consistent_under_load(self):
+        from predictionio_tpu.experiment.router import (
+            ExperimentConfig,
+            VariantRouter,
+        )
+        from predictionio_tpu.serving import ServingConfig, ServingPlane
+        planes = {
+            v: ServingPlane(lambda qs: [{"ok": 1} for _ in qs],
+                            config=ServingConfig(batching=False),
+                            name=f"analysis-{v}")
+            for v in ("a", "b")
+        }
+        router = VariantRouter(
+            planes, ExperimentConfig(variants=("a", "b"),
+                                     share_window=64),
+            server_name="analysistest")
+        errors = []
+        try:
+            def query(i):
+                for j in range(50):
+                    try:
+                        router.handle_query({"user": f"u{i}-{j}", "num": 1})
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            def observe():
+                for _ in range(100):
+                    shares = router.traffic_share()
+                    total = sum(shares.values())
+                    if shares and not (0.0 <= total <= 1.0 + 1e-9):
+                        errors.append(AssertionError(shares))
+                        return
+
+            threads = ([threading.Thread(target=query, args=(i,))
+                        for i in range(4)]
+                       + [threading.Thread(target=observe)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            router.close()
+            for p in planes.values():
+                p.close()
+        assert not errors, errors
