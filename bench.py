@@ -1622,11 +1622,23 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     by the plane once per folded event as (swap time − event_time): the
     full path of commit visibility + tail poll + fold-in solve + hot
     delta-swap. The fold jit-compile is warmed out of band so the window
-    measures the steady state a long-lived server sees."""
+    measures the steady state a long-lived server sees.
+
+    The server's histogram is also **cross-checked externally**: probe
+    events (explicit bench-stamped `eventTime`) ride the same front
+    door, and a bench-side InvalidationBus subscriber clocks each
+    probe's event→swap latency with its own stopwatch — the swapper
+    publishes touched user ids at swap time, so the arrival of a probe
+    id (variant-scoped message) IS the moment that probe became
+    servable. Both p95s are read on the same bucket ladder and must
+    agree within 10% (`external.crosscheck_pass`), so a bug in the
+    plane's own observe path can't go unnoticed."""
     import threading
     import urllib.request
+    from datetime import datetime, timezone
 
     from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.ingest.invalidation import BUS
     from predictionio_tpu.online.gate import _reset, _server, _storage, _train
     from predictionio_tpu.online.metrics import (
         ONLINE_EVENT_TO_SERVABLE,
@@ -1643,11 +1655,15 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     ingest.start()
     url = (f"http://127.0.0.1:{ingest.port}/events.json?accessKey={key}")
 
-    def post(user, item, rating):
-        body = json.dumps({
+    def post(user, item, rating, event_time_s=None):
+        payload = {
             "event": "rate", "entityType": "user", "entityId": user,
             "targetEntityType": "item", "targetEntityId": item,
-            "properties": {"rating": rating}}).encode()
+            "properties": {"rating": rating}}
+        if event_time_s is not None:
+            payload["eventTime"] = datetime.fromtimestamp(
+                event_time_s, timezone.utc).isoformat()
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
             url, body, {"Content-Type": "application/json"})
         urllib.request.urlopen(req, timeout=10).read()
@@ -1656,6 +1672,21 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     fold_h = ONLINE_FOLDIN_SECONDS.labels()
     sent = [0] * writers
     stop = threading.Event()
+
+    # external cross-check state: probe user → bench-stamped event time,
+    # and the wall instant the swap's invalidation fan-out named it
+    probe_sent: dict = {}
+    probe_seen: dict = {}
+
+    def _on_invalidation(entity_ids, variant=None):
+        if variant is None:
+            return  # commit-path publish; only the swap carries a variant
+        now_w = time.time()
+        for eid in entity_ids:
+            if eid in probe_sent and eid not in probe_seen:
+                probe_seen[eid] = now_w
+
+    BUS.subscribe(_on_invalidation)
     try:
         with _server(storage, interval_s=interval_s) as server:
             # warm: fold passes trace + compile one solver executable per
@@ -1705,11 +1736,27 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
                     except Exception:  # noqa: BLE001 — shedding is fine here
                         time.sleep(0.001)
 
+            def prober():
+                # spaced-out probe events with a bench-stamped eventTime,
+                # clocked externally by the invalidation subscriber
+                k = 0
+                while not stop.is_set():
+                    uid = f"probe{k}"
+                    t_ev = time.time()
+                    probe_sent[uid] = t_ev
+                    try:
+                        post(uid, f"i{k % 8}", 4.0, event_time_s=t_ev)
+                    except Exception:  # noqa: BLE001 — a shed probe is no sample
+                        probe_sent.pop(uid, None)
+                    k += 1
+                    stop.wait(max(0.05, duration_s / 24.0))
+
             threads = (
                 [threading.Thread(target=writer, args=(w,), daemon=True)
                  for w in range(writers)] +
                 [threading.Thread(target=querier, args=(c,), daemon=True)
-                 for c in range(query_clients)])
+                 for c in range(query_clients)] +
+                [threading.Thread(target=prober, daemon=True)])
             for t in threads:
                 t.start()
             time.sleep(duration_s)
@@ -1722,9 +1769,15 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
             while (server.online.events_folded - warm_folded < total_sent
                    and time.monotonic() < deadline):
                 time.sleep(0.1)
+            # every probe that was acked must have swapped by now too
+            deadline = time.monotonic() + 10
+            while (len(probe_seen) < len(probe_sent)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
             folded = server.online.events_folded - warm_folded
             lag_snapshot = server.online.snapshot()
     finally:
+        BUS.unsubscribe(_on_invalidation)
         ingest.shutdown()
         _reset(storage)
 
@@ -1732,6 +1785,28 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     p50 = _hist_pctl(e2s, base_counts, base_count, 0.50)
     p95 = _hist_pctl(e2s, base_counts, base_count, 0.95)
     mean = (e2s.sum - base_sum) / n if n else float("inf")
+    # external p95 on the SAME bucket ladder, so the two reads are the
+    # same statistic (bucket upper bound) over independently clocked data
+    ext_samples = [probe_seen[u] - probe_sent[u]
+                   for u in probe_sent if u in probe_seen]
+    ext_counts = [0] * len(e2s.buckets)
+    for s in ext_samples:
+        for i, bound in enumerate(e2s.buckets):
+            if s <= bound:
+                ext_counts[i] += 1
+                break
+    ext_p95 = float("inf")
+    acc, target = 0, 0.95 * len(ext_samples)
+    if ext_samples:
+        for bound, c in zip(e2s.buckets, ext_counts):
+            acc += c
+            if acc >= target:
+                ext_p95 = bound
+                break
+    if ext_p95 == float("inf") or p95 == float("inf"):
+        crosscheck = ext_p95 == p95
+    else:
+        crosscheck = (ext_p95 <= p95 * 1.10) and (p95 <= ext_p95 * 1.10)
     record = {
         # bucket upper bound: the honest (pessimistic) histogram read
         "metric": "online_event_to_servable_p95_s",
@@ -1745,6 +1820,16 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
         "events_folded": folded,
         "ingest_events_per_s": round(total_sent / duration_s, 1),
         "fold_p95_s": _hist_pctl(fold_h, *fold_base, 0.95),
+        # the server's histogram p95 ("value" above) cross-checked
+        # against probe events clocked by the bench's own stopwatch via
+        # the swap-time invalidation fan-out — within 10% or the
+        # histogram read itself is suspect
+        "external": {
+            "p95_s": ext_p95,
+            "probes": len(ext_samples),
+            "server_p95_s": p95,
+            "crosscheck_pass": crosscheck,
+        },
         "poll_interval_s": interval_s,
         "writers": writers,
         "query_clients": query_clients,

@@ -1,6 +1,6 @@
 """Rule pack (d): coverage rules.
 
-Two "the receipts must keep existing" checks:
+Three "the receipts must keep existing" checks:
 
 - ``coverage-fault-site``: every ``faults.inject("<site>")`` call site
   in the package must be referenced (armed) by some test or gate —
@@ -13,6 +13,11 @@ Two "the receipts must keep existing" checks:
   somewhere an operator will find it — a dashboard panel
   (``tools/**``) or a doc table (``docs/**``). Telemetry nobody can
   see regresses silently.
+
+- ``coverage-span-stage``: every lineage stage name recorded via
+  ``record_stage(ctx, "<stage>")`` must appear in the stage glossary
+  in ``docs/observability.md`` — an undocumented stage shows up in
+  assembled timelines with no explanation of what it measures.
 """
 
 from __future__ import annotations
@@ -131,3 +136,54 @@ def coverage_metric_docs(project: Project) -> Iterable[Finding]:
             symbol=name, severity="warning",
             hint="add it to the metrics reference table in "
                  "docs/observability.md (or a tools/ dashboard panel)")
+
+
+def _stage_literal(call: ast.Call) -> str:
+    """The stage argument of record_stage(ctx, "<stage>", ...)."""
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "stage" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return ""
+
+
+@rule("coverage-span-stage",
+      "every lineage stage recorded via record_stage() must appear in "
+      "the docs stage glossary")
+def coverage_span_stage(project: Project) -> Iterable[Finding]:
+    recorded: List[Tuple[str, int, str]] = []
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.terminal_name(node) != "record_stage":
+                continue
+            stage = _stage_literal(node)
+            if stage:
+                recorded.append((mod.rel, node.lineno, stage))
+    if not recorded:
+        return
+    glossary = "\n".join(
+        text for rel, text in project.text_files("docs", (".md",))
+        if rel.endswith("observability.md"))
+    seen = set()
+    for file, line, stage in sorted(recorded):
+        if stage in seen:
+            continue
+        seen.add(stage)
+        if f"`{stage}`" in glossary:
+            continue
+        yield Finding(
+            "coverage-span-stage", file, line,
+            f"lineage stage {stage!r} is recorded here but missing from "
+            f"the stage glossary in docs/observability.md — an assembled "
+            f"timeline would show a stage no runbook explains",
+            symbol=stage,
+            hint="add a `"
+                 f"{stage}` row to the lineage stage glossary in "
+                 "docs/observability.md")

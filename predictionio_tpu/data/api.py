@@ -36,7 +36,8 @@ import time
 from typing import Optional
 from urllib.parse import parse_qs
 
-from predictionio_tpu.telemetry import spans
+from predictionio_tpu.telemetry import lineage, spans, tracing
+from predictionio_tpu.telemetry.middleware import DEBUG_HEADER
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils import fastjson
 from predictionio_tpu.utils.http import HttpService
@@ -208,9 +209,17 @@ class _EventRoutes:
             self.plugins.on_event(d, app_id, channel_id)
         return event
 
-    def _insert_event(self, d: dict, access_key, app_id: int, channel_id) -> str:
+    def _insert_event(self, d: dict, access_key, app_id: int, channel_id,
+                      debug: bool = False) -> str:
         with spans.span("eventserver.insert_event"):
             event = self._validate_event(d, access_key, app_id, channel_id)
+            # Causal lineage is born here: AFTER validate_event (which
+            # rejects client pio_* property keys, so the envelope can't
+            # be spoofed), BEFORE the write plane (which records the
+            # commit stage and persists the context with the event).
+            ctx = lineage.mint(debug=debug)
+            event.lineage_ctx = ctx
+            lineage.LINEAGE.record_stage(ctx, "ingest")
             le = self.storage.l_events()
             try:
                 # through the write plane: coalesced with concurrent
@@ -289,7 +298,8 @@ class _EventRoutes:
         access_key, app_id, channel_id = auth
         try:
             d = fastjson.loads(req.body or b"{}")
-            eid = self._insert_event(d, access_key, app_id, channel_id)
+            eid = self._insert_event(d, access_key, app_id, channel_id,
+                                     debug=bool(req.headers.get(DEBUG_HEADER)))
         except IngestOverload as e:
             return self._shed(app_id, e)
         except PluginRejection as e:
@@ -321,10 +331,20 @@ class _EventRoutes:
         # store the valid ones in ONE transaction via insert_batch
         results: list = []
         prepared: list[tuple[int, Event]] = []
+        batch_debug = bool(req.headers.get(DEBUG_HEADER))
+        # one lineage timeline per EVENT, not per request: row i of a
+        # batch gets the request trace id suffixed with its index, so
+        # the per-event timelines stay distinct but remain findable
+        # from the request's own trace id
+        batch_trace = tracing.current_trace_id()
         for i, d in enumerate(items):
             try:
                 event = self._validate_event(d, access_key, app_id,
                                              channel_id)
+                event.lineage_ctx = lineage.mint(
+                    trace_id=f"{batch_trace}-{i}" if batch_trace else None,
+                    debug=batch_debug)
+                lineage.LINEAGE.record_stage(event.lineage_ctx, "ingest")
                 prepared.append((i, event))
                 results.append(None)  # filled after the batch insert
             except PluginRejection as e:
@@ -363,6 +383,7 @@ class _EventRoutes:
                     results[i] = {"status": 500, "message": str(eid)}
                     continue
                 results[i] = {"status": 201, "eventId": eid}
+                lineage.LINEAGE.record_stage(event.lineage_ctx, "commit")
                 if self.stats:
                     self.stats.update(app_id, event.event, 201)
             self.ingest.notify_committed(
@@ -391,7 +412,8 @@ class _EventRoutes:
                 raise ValueError("webhook payload must be a JSON object")
             event_dict = connector.to_event_dict(payload)
             eid = self._insert_event(event_dict, access_key, app_id,
-                                     channel_id)
+                                     channel_id,
+                                     debug=bool(req.headers.get(DEBUG_HEADER)))
         except IngestOverload as e:
             return self._shed(app_id, e)
         except PluginRejection as e:

@@ -33,6 +33,7 @@ from predictionio_tpu.experiment.metrics import (
     EXPERIMENT_REWARDS,
 )
 from predictionio_tpu.ingest.tailer import OVERLAP, StoreTailer  # noqa: F401
+from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
 
 log = logging.getLogger(__name__)
 
@@ -63,4 +64,8 @@ class RewardTailer(StoreTailer):
         EXPERIMENT_REWARDS.labels(variant=variant).inc()
         EXPERIMENT_POSTERIOR_MEAN.labels(variant=variant).set(
             self.bandit.posterior_mean(variant))
+        # a $reward's terminal stage is the posterior update, not a fold
+        lctx = context_of(e)
+        LINEAGE.record_stage(lctx, "reward", detail=variant)
+        LINEAGE.complete(lctx)
         return True

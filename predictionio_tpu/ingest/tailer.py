@@ -33,6 +33,8 @@ import threading
 from datetime import timedelta
 from typing import List, Optional
 
+from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
+
 log = logging.getLogger(__name__)
 
 # how far behind the watermark each poll re-reads; must exceed the gap
@@ -79,6 +81,11 @@ class StoreTailer:
     def poll_once(self) -> int:
         """One tail pass. Returns the number of events newly applied."""
         fresh = self._collect()
+        for e in fresh:
+            # re-attached by the storage read path; the pickup lag IS the
+            # watermark lag (origin → this poll) for that event
+            LINEAGE.record_stage(context_of(e), "tailer_pickup",
+                                 detail=self.name)
         applied = self._process(fresh)
         self._prune_seen()
         return applied

@@ -48,6 +48,7 @@ from typing import Callable, List, Optional, Tuple
 
 from predictionio_tpu.ingest.invalidation import BUS
 from predictionio_tpu.telemetry import spans
+from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
 from predictionio_tpu.telemetry.registry import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -314,7 +315,10 @@ class GroupCommitWriter:
         with spans.span("ingest.commit"):
             t0 = time.perf_counter()
             eid = self.insert_fn(event, app_id, channel_id)
-            _COMMIT_SECONDS.observe(time.perf_counter() - t0)
+            commit_s = time.perf_counter() - t0
+            _COMMIT_SECONDS.observe(commit_s)
+        LINEAGE.record_stage(context_of(event), "commit",
+                             duration_s=commit_s)
         self.notify_committed((event,))
         return eid
 
@@ -396,6 +400,8 @@ class GroupCommitWriter:
         except BaseException as e:  # noqa: BLE001 — isolate, then redo per item
             if len(group) == 1:
                 group[0].commit_s = time.perf_counter() - t0
+                LINEAGE.record_stage(context_of(group[0].item[0]), "commit",
+                                     duration_s=group[0].commit_s, error=True)
                 group[0].finish(error=e)
                 return
             # per-item fallback: the shared transaction rolled back
@@ -409,16 +415,24 @@ class GroupCommitWriter:
                 try:
                     r = self.insert_fn(*p.item)
                     p.commit_s = time.perf_counter() - t_item
+                    LINEAGE.record_stage(context_of(p.item[0]), "commit",
+                                         duration_s=p.commit_s)
                     # invalidate BEFORE acknowledging: the waiter's 201
                     # must imply the cache no longer serves stale answers
                     self.notify_committed((p.item[0],))
                     p.finish(result=r)
                 except BaseException as item_e:  # noqa: BLE001
                     p.commit_s = time.perf_counter() - t_item
+                    LINEAGE.record_stage(context_of(p.item[0]), "commit",
+                                         duration_s=p.commit_s, error=True)
                     p.finish(error=item_e)
             return
         commit_s = time.perf_counter() - t0
         _COMMIT_SECONDS.observe(commit_s)
+        now = time.time()
+        for p in group:
+            LINEAGE.record_stage(context_of(p.item[0]), "commit",
+                                 duration_s=commit_s, now=now)
         self.notify_committed([p.item[0] for p in group])
         for p, eid in zip(group, ids):
             p.commit_s = commit_s

@@ -44,7 +44,7 @@ ONLINE_EVENT_TO_SERVABLE = REGISTRY.histogram(
     "online_event_to_servable_seconds",
     "North star: event_time → served-model swap latency, one observation "
     "per folded event",
-    buckets=_E2S_BUCKETS)
+    buckets=_E2S_BUCKETS, exemplars=True)
 ONLINE_LAG = REGISTRY.gauge(
     "online_lag_seconds",
     "Age of the fold watermark at the end of the latest poll")

@@ -49,6 +49,8 @@ from predictionio_tpu.online.metrics import (
 )
 from predictionio_tpu.online.swap import DeltaSwapper, StaleState
 from predictionio_tpu.ops.als import ALSConfig
+from predictionio_tpu.telemetry import slo, tracing
+from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
 from predictionio_tpu.utils import faults
 
 log = logging.getLogger(__name__)
@@ -372,26 +374,59 @@ class OnlinePlane:
                     continue
                 try:
                     models = list(state.models)
+                    t_fold = time.perf_counter()
                     for idx, cfg in ctx.als:
                         models[idx], _ = foldin.fold_model(
                             models[idx], cfg, user_hist, item_hist)
+                    fold_s = time.perf_counter() - t_fold
+                    t_swap = time.perf_counter()
                     self._swapper.swap(ctx.variant, state, models,
                                        sorted(user_hist))
+                    swap_s = time.perf_counter() - t_swap
                     folded_any = True
+                    # the swap call also publishes the invalidations, so
+                    # the invalidate stage lands at the same instant; its
+                    # detail is the touched-user fan-out
+                    now_s = time.time()
+                    n_touched = str(len(user_hist))
+                    for e in model_events:
+                        lctx = context_of(e)
+                        LINEAGE.record_stage(lctx, "fold",
+                                             duration_s=fold_s, now=now_s)
+                        LINEAGE.record_stage(lctx, "swap",
+                                             duration_s=swap_s,
+                                             detail=ctx.variant, now=now_s)
+                        LINEAGE.record_stage(lctx, "invalidate",
+                                             detail=n_touched, now=now_s)
                 except StaleState:
                     # a full /reload landed mid-fold; re-resolve and make
                     # the tailer replay this batch against the new state
                     raise
                 except Exception:
                     ONLINE_FOLD_ERRORS.inc()
+                    for e in model_events:
+                        LINEAGE.record_stage(context_of(e), "fold",
+                                             error=True)
                     log.exception("online: fold failed for variant %r; "
                                   "batch will replay", ctx.variant)
                     raise
         if folded_any:
             now = datetime.now(timezone.utc)
+            samples = []
             for e in model_events:
-                age = (now - _aware(e.event_time)).total_seconds()
-                ONLINE_EVENT_TO_SERVABLE.observe(max(0.0, age))
+                age = max(0.0,
+                          (now - _aware(e.event_time)).total_seconds())
+                lctx = context_of(e)
+                if lctx is not None:
+                    # an open trace during observe() links the histogram
+                    # bucket to this trace id as an exemplar
+                    with tracing.trace(lctx.trace_id):
+                        ONLINE_EVENT_TO_SERVABLE.observe(age)
+                else:
+                    ONLINE_EVENT_TO_SERVABLE.observe(age)
+                samples.append((200, age))
+                LINEAGE.complete(lctx, freshness_s=age)
+            slo.observe_many("online", "event_to_servable", samples)
             ONLINE_EVENTS_FOLDED.inc(len(model_events))
             self.events_folded += len(model_events)
         ONLINE_FOLDIN_SECONDS.observe(time.perf_counter() - t0)

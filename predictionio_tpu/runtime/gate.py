@@ -52,6 +52,7 @@ from predictionio_tpu.serving import (
     ServingPlane,
     ShedLoad,
 )
+from predictionio_tpu.telemetry import lineage
 from predictionio_tpu.utils.faults import FaultInjected
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
 
@@ -117,6 +118,11 @@ class StubPredictionServer(HttpService):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if self.path == "/queries.json":
+                    # one lineage stage per handled query, so the fleet
+                    # drill can assert the supervisor's merged stage
+                    # counts equal the per-worker rings exactly
+                    lineage.LINEAGE.record_stage(
+                        lineage.mint(), "ingest", detail="gate-stub")
                     if server._burn_ms:
                         _gate_cpu_burn(server._burn_ms)
                     try:

@@ -622,6 +622,9 @@ class Supervisor:
         # …and its /debug/profile.json serves the fleet-merged flamegraph
         telemetry_middleware.set_profile_renderer(
             "supervisor", self._render_fleet_profile)
+        # …and its /debug/lineage routes serve the fleet-merged timelines
+        telemetry_middleware.set_lineage_renderer(
+            "supervisor", self._render_fleet_lineage)
 
         if self.cfg.control_port is not None:
             try:
@@ -663,6 +666,7 @@ class Supervisor:
             self._reservation.close()
             telemetry_middleware.set_metrics_renderer("supervisor", None)
             telemetry_middleware.set_profile_renderer("supervisor", None)
+            telemetry_middleware.set_lineage_renderer("supervisor", None)
             if self._control is not None:
                 try:
                     self._control.shutdown()
@@ -1116,6 +1120,28 @@ class Supervisor:
             parts.append((str(snap.get("worker", "?")),
                           snap.get("profile")))
         return profiler.filter_merged(profiler.merge_profiles(parts), route)
+
+    def _render_fleet_lineage(self, trace_id=None, limit: int = 100) -> tuple:
+        """The control endpoint's /debug/lineage routes: every worker's
+        lineage export (riding the same snapshot fetch as the metric
+        merge) plus the supervisor's own, merged by lineage.merge_lineage
+        — stage counts sum exactly and the per-worker totals ship in the
+        same payload, so ``sum(stages.values()) ==
+        sum(workers.values())`` is checkable from one fetch."""
+        from predictionio_tpu.telemetry import lineage
+        parts = [("supervisor", lineage.export_state())]
+        for snap in self._worker_snapshots():
+            parts.append((str(snap.get("worker", "?")),
+                          snap.get("lineage")))
+        merged = lineage.merge_lineage(parts, limit=limit)
+        if trace_id is None:
+            return 200, merged
+        entry = lineage.find_in_merged(merged, trace_id)
+        if entry is None:
+            return telemetry_middleware.error_payload(
+                404, "trace not in the fleet lineage view",
+                trace_id=trace_id, evicted=False)
+        return 200, entry
 
     def fleet_summary(self) -> dict:
         """Per-worker and fleet-total request counters for /status.json —

@@ -24,6 +24,7 @@ from typing import Iterable, Optional, Sequence
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.events import Event, format_time, parse_time
 from predictionio_tpu.storage import base
+from predictionio_tpu.telemetry import lineage
 from predictionio_tpu.utils import faults
 from predictionio_tpu.storage.base import (
     AccessKey,
@@ -774,6 +775,17 @@ class SQLiteLEvents(base.LEvents):
     def _row_of(event: Event, app_id: int, channel_id: Optional[int]) -> tuple:
         eid = event.event_id or uuid.uuid4().hex
         event.event_id = eid
+        # The causal-lineage context (attached by the event server after
+        # validate_event, which rejects client-supplied pio_* property
+        # keys) rides inside the properties JSON — no schema change, and
+        # _event_from_row strips it symmetrically on every read path.
+        ctx = getattr(event, "lineage_ctx", None)
+        if ctx is None:
+            props_json = event.properties.to_json()
+        else:
+            props = event.properties.to_dict()
+            props[lineage.ENVELOPE_KEY] = ctx.to_dict()
+            props_json = json.dumps(props, sort_keys=True)
         return (
             eid,
             app_id,
@@ -783,7 +795,7 @@ class SQLiteLEvents(base.LEvents):
             event.entity_id,
             event.target_entity_type,
             event.target_entity_id,
-            event.properties.to_json(),
+            props_json,
             format_time(event.event_time),
             json.dumps(event.tags),
             event.pr_id,
@@ -835,19 +847,28 @@ class SQLiteLEvents(base.LEvents):
 
     @staticmethod
     def _event_from_row(row: sqlite3.Row) -> Event:
-        return Event(
+        properties = DataMap.from_json(row["properties"])
+        ctx = None
+        if lineage.ENVELOPE_KEY in properties:
+            ctx = lineage.CausalContext.from_dict(
+                properties[lineage.ENVELOPE_KEY])
+            properties = properties.drop((lineage.ENVELOPE_KEY,))
+        event = Event(
             event=row["event"],
             entity_type=row["entity_type"],
             entity_id=row["entity_id"],
             target_entity_type=row["target_entity_type"],
             target_entity_id=row["target_entity_id"],
-            properties=DataMap.from_json(row["properties"]),
+            properties=properties,
             event_time=parse_time(row["event_time"]),
             tags=json.loads(row["tags"]),
             pr_id=row["pr_id"],
             creation_time=parse_time(row["creation_time"]),
             event_id=row["id"],
         )
+        if ctx is not None:
+            event.lineage_ctx = ctx
+        return event
 
     @staticmethod
     def _channel_clause(channel_id: Optional[int]) -> tuple[str, list]:

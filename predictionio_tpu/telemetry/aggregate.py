@@ -98,8 +98,16 @@ def snapshot_registry(registry: MetricsRegistry = REGISTRY,
         profile = profiler.export_state()
     except Exception:  # noqa: BLE001 — snapshots must not break on this
         profile = None
+    # The lineage recorder's timelines + exact stage counts ride along the
+    # same way, so the supervisor's fleet lineage view needs no extra hop.
+    try:
+        from predictionio_tpu.telemetry import lineage as _lineage
+        lineage = _lineage.export_state()
+    except Exception:  # noqa: BLE001 — snapshots must not break on this
+        lineage = None
     return {"worker": worker or worker_label(), "pid": os.getpid(),
-            "ts": time.time(), "families": families, "profile": profile}
+            "ts": time.time(), "families": families, "profile": profile,
+            "lineage": lineage}
 
 
 class SnapshotServer:

@@ -206,6 +206,14 @@ def default_rules() -> List[AlertRule]:
                   metric="slo_error_budget_burn_rate",
                   labels={"window": "1h"}, stat="max",
                   value=6.0, window_s=300.0, severity="ticket"),
+        # Freshness burn: the online plane's event→servable SLO. Its
+        # burn-rate series only exists once the plane folds events, so
+        # measure() returns None (silent) on deployments without it.
+        AlertRule(name="freshness-burn-5m", kind="burn_rate",
+                  metric="slo_error_budget_burn_rate",
+                  labels={"window": "5m", "server": "online",
+                          "route": "event_to_servable"},
+                  stat="max", value=14.4, window_s=60.0, severity="page"),
     ]
 
 

@@ -44,6 +44,10 @@ Run via `python quality.py --telemetry-gate`. Six layers:
    `/debug/profile.json` must be sum-exact (total == per-worker counts
    from the same payload), with all five samplers running and a seeded
    per-request CPU burn as the top `/queries.json` self-time frame.
+   It also checks the fleet lineage view: the control endpoint's
+   `/debug/lineage.json` stage counts must EXACTLY equal the sum of the
+   per-worker lineage rings, and match the per-worker totals shipped in
+   the same payload.
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
@@ -192,6 +196,7 @@ def _span_coverage_check() -> list[str]:
     their flight-recorder timelines carry the stage spans."""
     import http.client
     import json
+    import time
 
     from predictionio_tpu.data.api import EventServer, EventServerConfig
     from predictionio_tpu.serving import ServingPlane
@@ -206,15 +211,21 @@ def _span_coverage_check() -> list[str]:
     def fetch_timeline(port: int, trace_id) -> tuple:
         if not trace_id:
             return None, "response carried no X-PIO-Trace-Id"
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
-        conn.request("GET", f"/debug/requests/{trace_id}.json")
-        r = conn.getresponse()
-        body = r.read()
-        conn.close()
-        if r.status != 200:
-            return None, (f"/debug/requests/{trace_id}.json answered "
-                          f"{r.status} (timeline not retrievable)")
-        return json.loads(body), None
+        # The recorder offer runs in the handler's finally block, after the
+        # response bytes flush — a fast GET can race it. Retry briefly.
+        deadline = time.monotonic() + 2.0
+        while True:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            conn.request("GET", f"/debug/requests/{trace_id}.json")
+            r = conn.getresponse()
+            body = r.read()
+            conn.close()
+            if r.status == 200:
+                return json.loads(body), None
+            if time.monotonic() >= deadline:
+                return None, (f"/debug/requests/{trace_id}.json answered "
+                              f"{r.status} (timeline not retrievable)")
+            time.sleep(0.05)
 
     def require_spans(entry: dict, label: str, required: dict) -> None:
         names = {s["name"] for s in entry.get("spans", ())}
@@ -628,6 +639,32 @@ def _fleet_drill() -> list[str]:
             problems.append(
                 "fleet: burn frame's route breakdown lost the "
                 "/queries.json label")
+
+        # -- fleet lineage on the control endpoint: merged stage counts
+        # must EXACTLY equal the sum of the per-worker rings. The stub
+        # records one stage per handled query and the load has stopped,
+        # so the earlier snapshot fetch and this one see the same counts.
+        lin = _get_json(ctl_port, "/debug/lineage.json", timeout_s=5.0)
+        per_worker_stages: dict = {}
+        for s in snaps:
+            part = s.get("lineage") or {}
+            for stage, n in part.get("stages", {}).items():
+                per_worker_stages[stage] = \
+                    per_worker_stages.get(stage, 0) + int(n)
+        if not per_worker_stages:
+            problems.append("fleet: no lineage stages recorded by the "
+                            "stub workers")
+        merged_stages = {k: int(v) for k, v in lin.get("stages", {}).items()}
+        if merged_stages != per_worker_stages:
+            problems.append(
+                f"fleet: merged lineage stage counts {merged_stages} != "
+                f"sum of per-worker rings {per_worker_stages}")
+        worker_sum = sum(int(v) for v in lin.get("workers", {}).values())
+        if sum(merged_stages.values()) != worker_sum:
+            problems.append(
+                f"fleet: merged lineage stages sum "
+                f"{sum(merged_stages.values())} != per-worker totals in "
+                f"the same payload {worker_sum}")
     finally:
         if load is not None:
             load.stop_evt.set()

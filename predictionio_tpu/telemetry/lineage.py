@@ -1,0 +1,494 @@
+"""Causal event lineage: cross-plane trace timelines + always-on freshness.
+
+The flight recorder (telemetry/recorder.py) answers "what happened inside
+this *request*"; this module answers "what happened to this *event* after
+the request was acked". A `CausalContext` is minted when the event server
+admits a write, rides through the group-commit plane into the durable
+store as a `pio_lineage` properties envelope (stripped again on read, so
+clients never see it), and is re-attached by `StoreTailer` — from there
+every asynchronous stage the event causes reports back here:
+
+    ingest → commit → tailer_pickup → fold → swap → invalidate
+                                   └→ reward          ($reward events)
+
+Per-event timelines live in a bounded `LineageRecorder`, tail-sampled
+like the flight recorder: the keep/drop decision runs at *completion*
+(the fold that made the event servable), so slow, failed and
+`X-PIO-Debug` traces are always kept and only the healthy rest is
+sampled. Stage *counts* are exact regardless of sampling —
+`lineage_stages_total{stage}` increments for every record, and the
+recorder keeps its own plain-int mirror so fleet merges riding PR 9's
+snapshot channel stay sum-exact per worker.
+
+Served by telemetry/middleware.py:
+
+    GET /debug/lineage.json                  newest-first timeline dump
+    GET /debug/lineage/<trace_id>.json       one assembled timeline
+
+and fleet-merged on the supervisor control endpoint via
+:func:`merge_lineage` (worker-labelled, built so a future host label can
+nest outside the worker label without changing the sum semantics).
+
+Sizing knobs (environment, read at recorder construction):
+
+    PIO_LINEAGE          "0" disables stage recording        (default on)
+    PIO_LINEAGE_LIVE     live/sampled ring slots             (default 512)
+    PIO_LINEAGE_PINNED   pinned ring slots                   (default 256)
+    PIO_LINEAGE_SAMPLE   healthy completed-trace keep rate   (default 1.0)
+    PIO_LINEAGE_SLOW_S   freshness pin threshold, seconds    (default 5.0)
+
+The 5.0 s default slow bar is bench.py's FRESHNESS_BAR_S — an event that
+missed the online plane's p95 target is exactly the trace an operator
+wants held.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from predictionio_tpu.telemetry.registry import REGISTRY
+
+# The properties key the storage layer smuggles the context under. Safe
+# against spoofing: validate_event rejects any client-supplied property
+# key starting with "pio_", so only the server-side attach point can set
+# it, and _event_from_row strips it before an event reaches a client.
+ENVELOPE_KEY = "pio_lineage"
+
+# Canonical stage vocabulary (assembled timelines sort unknown stages
+# after these, by timestamp). Every name recorded through record_stage
+# must appear in docs/observability.md's stage glossary — enforced by
+# pio-lint's coverage-span-stage rule.
+STAGES = ("ingest", "commit", "tailer_pickup", "fold", "swap",
+          "invalidate", "reward")
+_STAGE_ORDER = {s: i for i, s in enumerate(STAGES)}
+
+_MAX_STAGES_PER_TRACE = 32
+
+LINEAGE_STAGES = REGISTRY.counter(
+    "lineage_stages_total",
+    "Lineage stage records, by stage (exact; unaffected by sampling)",
+    labelnames=("stage",))
+LINEAGE_TRACES = REGISTRY.counter(
+    "lineage_traces_total", "Lineage timelines opened in this process")
+LINEAGE_DISCARDED = REGISTRY.counter(
+    "lineage_discarded_total",
+    "Healthy completed timelines dropped by the tail sample")
+LINEAGE_EVICTED = REGISTRY.counter(
+    "lineage_evicted_total", "Timelines evicted to make room",
+    labelnames=("kind",))
+LINEAGE_BUFFER = REGISTRY.gauge(
+    "lineage_buffer_entries", "Lineage timelines currently held",
+    labelnames=("kind",))
+LINEAGE_STAGE_LAG = REGISTRY.gauge(
+    "lineage_stage_lag_seconds",
+    "Origin→stage lag of the most recent record, by stage "
+    "(tailer_pickup = watermark lag, fold = queue wait + solve, "
+    "invalidate = swap publish delay)",
+    labelnames=("stage",))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _truthy(v: Optional[str], default: bool = True) -> bool:
+    if v is None:
+        return default
+    return v not in ("0", "false", "off", "no", "")
+
+
+class CausalContext:
+    """The compact per-event coordinates that cross the store boundary.
+
+    A __slots__ class on the ingest hot path (one per admitted event).
+    `origin_wall` is the shared time axis: the writer and the tailer may
+    be different *processes* over one database file, so monotonic clocks
+    don't transfer — `origin_mono` is only meaningful (and only used)
+    inside the minting process. `hop` counts recorded stages, so an
+    assembled timeline can show how far an event travelled even when the
+    stage records themselves were sampled away on another worker."""
+
+    __slots__ = ("trace_id", "origin_wall", "origin_mono", "hop", "debug")
+
+    def __init__(self, trace_id: str, origin_wall: float,
+                 origin_mono: Optional[float] = None, hop: int = 0,
+                 debug: bool = False):
+        self.trace_id = trace_id
+        self.origin_wall = origin_wall
+        self.origin_mono = origin_mono
+        self.hop = hop
+        self.debug = debug
+
+    def to_dict(self) -> dict:
+        # short keys: this rides inside every stored event's properties
+        d = {"t": self.trace_id, "w": self.origin_wall, "h": self.hop}
+        if self.debug:
+            d["d"] = 1
+        return d
+
+    @classmethod
+    def from_dict(cls, d) -> Optional["CausalContext"]:
+        """Parse a stored envelope; None on junk (a hand-edited row must
+        not wedge the tailer)."""
+        try:
+            return cls(trace_id=str(d["t"]), origin_wall=float(d["w"]),
+                       hop=int(d.get("h", 0)), debug=bool(d.get("d")))
+        except (TypeError, KeyError, ValueError):
+            return None
+
+
+def mint(trace_id: Optional[str] = None, debug: bool = False,
+         now: Optional[float] = None) -> CausalContext:
+    """A fresh context at origin time `now` (wall). Joins the active
+    request trace when `trace_id` is None and one is open."""
+    if trace_id is None:
+        from predictionio_tpu.telemetry import tracing
+        trace_id = tracing.current_trace_id() or tracing._new_id()
+    return CausalContext(trace_id=trace_id,
+                         origin_wall=now if now is not None else time.time(),
+                         origin_mono=time.monotonic(), debug=debug)
+
+
+def context_of(event) -> Optional[CausalContext]:
+    """The context attached to an event, if any plane attached one."""
+    return getattr(event, "lineage_ctx", None)
+
+
+class LineageRecorder:
+    """Bounded per-event timelines with completion-time tail sampling.
+
+    Two logical rings (live/sampled and pinned) index one entry dict per
+    trace id. Unlike the flight recorder, entries are *mutable* — stages
+    trickle in over seconds — so the rings hold trace ids and eviction
+    is lazy: a popped id whose entry was pinned or already dropped is
+    simply skipped (each id is popped at most once, so the laziness is
+    amortized O(1) per insert)."""
+
+    def __init__(self, live_slots: Optional[int] = None,
+                 pinned_slots: Optional[int] = None,
+                 sample_rate: Optional[float] = None,
+                 slow_threshold_s: Optional[float] = None):
+        self.enabled = _truthy(os.environ.get("PIO_LINEAGE"), default=True)
+        self.live_slots = live_slots if live_slots is not None \
+            else _env_int("PIO_LINEAGE_LIVE", 512)
+        self.pinned_slots = pinned_slots if pinned_slots is not None \
+            else _env_int("PIO_LINEAGE_PINNED", 256)
+        self.sample_rate = sample_rate if sample_rate is not None \
+            else _env_float("PIO_LINEAGE_SAMPLE", 1.0)
+        self.slow_threshold_s = slow_threshold_s \
+            if slow_threshold_s is not None \
+            else _env_float("PIO_LINEAGE_SLOW_S", 5.0)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self._live_order: deque = deque()     # unpinned trace ids, oldest first
+        self._pinned_order: deque = deque()
+        self._n_unpinned = 0
+        self._n_pinned = 0
+        # ids that were held once but dropped — the "evicted, not never
+        # seen" memory the /debug 404 envelopes branch on. Bounded FIFO.
+        self._evicted_ids: Dict[str, bool] = {}
+        self._evicted_order: deque = deque()
+        self._evicted_slots = 4096
+        # exact per-stage record counts, mirrored off the registry counter
+        # so snapshot payloads are self-contained for the fleet merge
+        self._stage_counts: Dict[str, int] = {}
+        self._rng = random.Random()
+        self._random = self._rng.random
+        # cached label children — .labels() takes the family lock per call
+        self._stage_counters: Dict[str, object] = {}
+        self._lag_gauges: Dict[str, object] = {}
+        self._opened = LINEAGE_TRACES.labels()
+        self._discarded = LINEAGE_DISCARDED.labels()
+        self._evicted_live = LINEAGE_EVICTED.labels(kind="live")
+        self._evicted_pinned = LINEAGE_EVICTED.labels(kind="pinned")
+        self._size_live = LINEAGE_BUFFER.labels(kind="live")
+        self._size_pinned = LINEAGE_BUFFER.labels(kind="pinned")
+
+    # -- ingest ----------------------------------------------------------
+
+    def record_stage(self, ctx: CausalContext, stage: str,
+                     duration_s: float = 0.0, error: bool = False,
+                     detail: Optional[str] = None,
+                     now: Optional[float] = None) -> None:
+        """Append one stage record to the event's timeline. Cheap enough
+        for the ingest hot path: one lock acquisition, two cached metric
+        updates, one dict append."""
+        if not self.enabled or ctx is None:
+            return
+        if now is None:
+            now = time.time()
+        lag = now - ctx.origin_wall
+        if lag < 0.0:
+            lag = 0.0
+        counter = self._stage_counters.get(stage)
+        if counter is None:
+            counter = self._stage_counters[stage] = \
+                LINEAGE_STAGES.labels(stage=stage)
+            self._lag_gauges[stage] = LINEAGE_STAGE_LAG.labels(stage=stage)
+        counter.inc()
+        self._lag_gauges[stage].set(lag)
+        rec = {"stage": stage, "ts": now, "lag_s": lag,
+               "duration_s": duration_s}
+        if error:
+            rec["error"] = True
+        if detail is not None:
+            rec["detail"] = detail
+        tid = ctx.trace_id
+        with self._lock:
+            self._stage_counts[stage] = self._stage_counts.get(stage, 0) + 1
+            entry = self._entries.get(tid)
+            if entry is None:
+                if tid in self._evicted_ids:
+                    # completed-and-dropped (or ring-evicted): keep the
+                    # counts exact but don't resurrect the timeline
+                    return
+                entry = {"trace_id": tid, "origin_ts": ctx.origin_wall,
+                         "debug": ctx.debug, "complete": False,
+                         "kept": None, "stages": []}
+                self._entries[tid] = entry
+                self._live_order.append(tid)
+                self._n_unpinned += 1
+                self._opened.inc()
+                if ctx.debug:
+                    self._pin_locked(entry, "debug")
+                self._evict_locked()
+            if len(entry["stages"]) < _MAX_STAGES_PER_TRACE:
+                entry["stages"].append(rec)
+            ctx.hop += 1
+            if error and entry["kept"] is None:
+                self._pin_locked(entry, "error")
+            self._update_sizes_locked()
+
+    def complete(self, ctx: CausalContext, freshness_s: Optional[float] = None,
+                 error: bool = False) -> None:
+        """The tail-sampling decision point: called when the event became
+        servable (or terminally failed). Slow/failed/debug timelines are
+        promoted to the pinned ring; the healthy rest survives at
+        `sample_rate`."""
+        if not self.enabled or ctx is None:
+            return
+        tid = ctx.trace_id
+        with self._lock:
+            entry = self._entries.get(tid)
+            if entry is None:
+                return
+            entry["complete"] = True
+            if freshness_s is not None:
+                entry["freshness_s"] = freshness_s
+            reason = None
+            if error or any(s.get("error") for s in entry["stages"]):
+                reason = "error"
+            elif freshness_s is not None \
+                    and freshness_s >= self.slow_threshold_s:
+                reason = "slow"
+            elif entry["debug"]:
+                reason = "debug"
+            if reason is not None:
+                if entry["kept"] is None:
+                    self._pin_locked(entry, reason)
+                else:
+                    entry["kept"] = reason if reason != "debug" \
+                        else entry["kept"]
+            elif entry["kept"] is None \
+                    and self._random() >= self.sample_rate:
+                del self._entries[tid]
+                self._n_unpinned -= 1
+                self._remember_evicted_locked(tid)
+                self._discarded.inc()
+            self._update_sizes_locked()
+
+    # -- ring bookkeeping (all under self._lock) -------------------------
+
+    def _pin_locked(self, entry: dict, reason: str) -> None:
+        entry["kept"] = reason
+        self._pinned_order.append(entry["trace_id"])
+        self._n_unpinned -= 1
+        self._n_pinned += 1
+        while self._n_pinned > self.pinned_slots and self._pinned_order:
+            old = self._pinned_order.popleft()
+            victim = self._entries.get(old)
+            if victim is None or victim["kept"] is None:
+                continue   # already dropped (lazy ring)
+            del self._entries[old]
+            self._n_pinned -= 1
+            self._remember_evicted_locked(old)
+            self._evicted_pinned.inc()
+
+    def _evict_locked(self) -> None:
+        while self._n_unpinned > self.live_slots and self._live_order:
+            old = self._live_order.popleft()
+            victim = self._entries.get(old)
+            if victim is None or victim["kept"] is not None:
+                continue   # dropped or promoted since append (lazy ring)
+            del self._entries[old]
+            self._n_unpinned -= 1
+            self._remember_evicted_locked(old)
+            self._evicted_live.inc()
+
+    def _remember_evicted_locked(self, tid: str) -> None:
+        if tid not in self._evicted_ids:
+            self._evicted_ids[tid] = True
+            self._evicted_order.append(tid)
+            while len(self._evicted_order) > self._evicted_slots:
+                del self._evicted_ids[self._evicted_order.popleft()]
+
+    def _update_sizes_locked(self) -> None:
+        self._size_live.set(self._n_unpinned)
+        self._size_pinned.set(self._n_pinned)
+
+    # -- retrieval -------------------------------------------------------
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        """The assembled timeline: stages in canonical order (then by
+        timestamp), per-stage lag off the origin wall clock."""
+        with self._lock:
+            entry = self._entries.get(trace_id)
+            if entry is None:
+                return None
+            return _assemble(entry)
+
+    def was_evicted(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._evicted_ids
+
+    def knows(self, trace_id: str) -> bool:
+        """Held now, or held once and since dropped — the 'not a ghost'
+        check the flight recorder's 404 envelope borrows."""
+        with self._lock:
+            return trace_id in self._entries or trace_id in self._evicted_ids
+
+    def snapshot(self, limit: int = 50, stage: Optional[str] = None,
+                 kept: Optional[str] = None) -> List[dict]:
+        """Newest-first assembled timelines (by last stage timestamp)."""
+        with self._lock:
+            entries = [_assemble(e) for e in self._entries.values()
+                       if (stage is None
+                           or any(s["stage"] == stage for s in e["stages"]))
+                       and (kept is None or e["kept"] == kept)]
+        entries.sort(key=lambda e: e["last_ts"], reverse=True)
+        return entries[:max(0, limit)]
+
+    def sizes(self) -> Dict[str, int]:
+        with self._lock:
+            return {"live": self._n_unpinned, "pinned": self._n_pinned,
+                    "evicted_remembered": len(self._evicted_ids)}
+
+    def stage_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stage_counts)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._live_order.clear()
+            self._pinned_order.clear()
+            self._evicted_ids.clear()
+            self._evicted_order.clear()
+            self._stage_counts.clear()
+            self._n_unpinned = self._n_pinned = 0
+            self._size_live.set(0)
+            self._size_pinned.set(0)
+
+
+def _assemble(entry: dict) -> dict:
+    stages = sorted(entry["stages"],
+                    key=lambda s: (_STAGE_ORDER.get(s["stage"], len(STAGES)),
+                                   s["ts"]))
+    out = {"trace_id": entry["trace_id"], "origin_ts": entry["origin_ts"],
+           "debug": entry["debug"], "complete": entry["complete"],
+           "kept": entry["kept"], "stages": stages,
+           "last_ts": stages[-1]["ts"] if stages else entry["origin_ts"]}
+    if "freshness_s" in entry:
+        out["freshness_s"] = entry["freshness_s"]
+    return out
+
+
+# Process-wide recorder, mirroring telemetry.recorder.RECORDER: every
+# plane in the process reports to (and every HttpService serves) the
+# same rings.
+LINEAGE = LineageRecorder()
+
+
+# -- fleet merge ------------------------------------------------------------
+
+
+def export_state() -> Dict:
+    """The per-worker lineage block embedded in aggregate
+    snapshot_registry() payloads — what the supervisor merges. Stage
+    counts are the recorder's own plain-int mirror, so exactness is
+    checkable against the worker's lineage_stages_total family."""
+    return {"stages": LINEAGE.stage_counts(),
+            "held": LINEAGE.sizes(),
+            "entries": LINEAGE.snapshot(limit=32)}
+
+
+def merge_lineage(parts: Iterable[Tuple[str, Optional[Dict]]],
+                  limit: int = 100) -> Dict:
+    """Merge (worker_label, export_state()) pairs into one fleet view.
+    Stage counts are summed exactly — integers, no averaging — and the
+    per-worker totals ship inside the same payload, so
+    ``sum(stages.values()) == sum(workers.values())`` always holds. The
+    worker label is a flat string key; a future multi-host merge nests
+    by prefixing ``host/worker`` without changing the sum semantics."""
+    stages: Dict[str, int] = {}
+    workers: Dict[str, int] = {}
+    entries: List[dict] = []
+    held = {"live": 0, "pinned": 0}
+    for wlabel, part in parts:
+        wlabel = str(wlabel)
+        if part is None:
+            workers.setdefault(wlabel, 0)
+            continue
+        total = 0
+        for stage, count in part.get("stages", {}).items():
+            count = int(count)
+            stages[stage] = stages.get(stage, 0) + count
+            total += count
+        workers[wlabel] = workers.get(wlabel, 0) + total
+        for kind in ("live", "pinned"):
+            held[kind] += int(part.get("held", {}).get(kind, 0))
+        for e in part.get("entries", ()):
+            e = dict(e)
+            e["worker"] = wlabel
+            entries.append(e)
+    entries.sort(key=lambda e: e.get("last_ts", 0.0), reverse=True)
+    return {"stages": stages, "workers": workers, "held": held,
+            "entries": entries[:max(0, limit)]}
+
+
+def find_in_merged(merged: Dict, trace_id: str) -> Optional[dict]:
+    """Locate one trace in a merged view (the supervisor's by-id route)."""
+    for e in merged.get("entries", ()):
+        if e.get("trace_id") == trace_id:
+            return e
+    return None
+
+
+def _reset_after_fork() -> None:
+    # Pool workers fork from the supervisor: inherited timelines (and the
+    # stage-count mirror) belong to the parent — a child re-exporting them
+    # would double-count the fleet merge. Mirrors
+    # aggregate.reset_inherited_counters, which zeroes the registry side.
+    LINEAGE._lock = threading.Lock()
+    LINEAGE.clear()
+    LINEAGE._rng = random.Random()
+    LINEAGE._random = LINEAGE._rng.random
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)

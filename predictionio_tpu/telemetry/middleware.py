@@ -31,6 +31,7 @@ from typing import Type
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.telemetry import history, profiler, slo, spans, tracing
+from predictionio_tpu.telemetry.lineage import LINEAGE
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -47,6 +48,8 @@ _DEBUG_ONE_ROUTE = "/debug/requests/<trace_id>.json"
 _HISTORY_ROUTE = "/debug/history.json"
 _PROFILE_ROUTE = "/debug/profile.json"
 _PROFILE_DEVICE_ROUTE = "/debug/profile/device.json"
+_LINEAGE_LIST_ROUTE = "/debug/lineage.json"
+_LINEAGE_ONE_ROUTE = "/debug/lineage/<trace_id>.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
@@ -68,7 +71,7 @@ HTTP_ERRORS = REGISTRY.counter(
 # templates. Anything else (scanner noise, typos) collapses to "<other>".
 _EXACT_ROUTES = frozenset({
     "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
-    _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE,
+    _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE, _LINEAGE_LIST_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -78,6 +81,7 @@ _PREFIX_ROUTES = (
     ("/events/", ".json", "/events/<id>.json"),
     ("/webhooks/", ".json", "/webhooks/<connector>.json"),
     ("/debug/requests/", ".json", _DEBUG_ONE_ROUTE),
+    ("/debug/lineage/", ".json", _LINEAGE_ONE_ROUTE),
 )
 
 
@@ -218,8 +222,17 @@ def _debug_request_by_id_payload(path: str) -> tuple:
         return error_payload(400, "bad trace id")
     entry = RECORDER.get(trace_id)
     if entry is None:
+        # Two different 404s: an id that was held and fell out of a ring
+        # (go raise the ring sizes / lower the sample rate) vs. one the
+        # recorder never saw (wrong id, or the request predates this
+        # process). The lineage plane may still know an evicted request's
+        # id — its rings are sized and sampled independently.
+        if RECORDER.was_evicted(trace_id) or LINEAGE.knows(trace_id):
+            return error_payload(404, "trace evicted from the flight "
+                                      "recorder ring",
+                                 trace_id=trace_id, evicted=True)
         return error_payload(404, "trace not held by the flight recorder",
-                             trace_id=trace_id)
+                             trace_id=trace_id, evicted=False)
     return 200, entry
 
 
@@ -308,6 +321,79 @@ def _profile_payload(server: str, raw_target: str) -> tuple:
     return profiler.payload_response(route=route, top_n=top_n)
 
 
+# Per-server /debug/lineage* overrides, the /metrics renderer pattern a
+# third time: the supervisor swaps in its fleet-merged lineage view while
+# every worker keeps the process-local rings.
+_LINEAGE_RENDERERS: dict = {}
+
+
+def set_lineage_renderer(server_name: str, renderer) -> None:
+    """Install (renderer(trace_id, limit) -> (status, obj)) for one
+    server's /debug/lineage routes; trace_id None means the list form.
+    None clears."""
+    if renderer is None:
+        _LINEAGE_RENDERERS.pop(server_name, None)
+    else:
+        _LINEAGE_RENDERERS[server_name] = renderer
+
+
+def _lineage_list_payload(server: str, raw_target: str) -> tuple:
+    """GET /debug/lineage.json?limit=&stage=&kept= — lineage ring dump."""
+    params = _query_params(raw_target)
+    try:
+        limit = min(500, int(_one_param(params, "limit") or 50))
+    except ValueError:
+        limit = 50
+    renderer = _LINEAGE_RENDERERS.get(server)
+    if renderer is not None:
+        try:
+            return renderer(None, limit)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "lineage renderer for %s failed; serving process-local "
+                "view", server, exc_info=True)
+    entries = LINEAGE.snapshot(limit=limit,
+                               stage=_one_param(params, "stage"),
+                               kept=_one_param(params, "kept"))
+    return 200, {"entries": entries, "held": LINEAGE.sizes(),
+                 "stages": LINEAGE.stage_counts()}
+
+
+def _lineage_by_id_payload(server: str, path: str) -> tuple:
+    """GET /debug/lineage/<trace_id>.json — one assembled timeline."""
+    trace_id = path[len("/debug/lineage/"):-len(".json")]
+    if not tracing._SAFE_TRACE_ID.match(trace_id):
+        return error_payload(400, "bad trace id")
+    renderer = _LINEAGE_RENDERERS.get(server)
+    if renderer is not None:
+        try:
+            return renderer(trace_id, 1)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "lineage renderer for %s failed; serving process-local "
+                "view", server, exc_info=True)
+    entry = LINEAGE.get(trace_id)
+    if entry is None:
+        if LINEAGE.was_evicted(trace_id):
+            return error_payload(404, "trace evicted from the lineage ring",
+                                 trace_id=trace_id, evicted=True)
+        return error_payload(404, "trace not held by the lineage recorder",
+                             trace_id=trace_id, evicted=False)
+    return 200, entry
+
+
+def serve_debug_lineage(handler, raw_path: str) -> None:
+    status, obj = _lineage_list_payload(
+        getattr(handler, "pio_server_name", ""), raw_path)
+    _serve_json(handler, obj, status=status)
+
+
+def serve_debug_lineage_by_id(handler, path: str) -> None:
+    status, obj = _lineage_by_id_payload(
+        getattr(handler, "pio_server_name", ""), path)
+    _serve_json(handler, obj, status=status)
+
+
 def serve_debug_history(handler, raw_path: str) -> None:
     status, obj = _history_payload(raw_path)
     _serve_json(handler, obj, status=status)
@@ -365,8 +451,12 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_profile(self, self.path)
         elif http_method == "GET" and path == _PROFILE_DEVICE_ROUTE:
             serve_profile_device(self)
+        elif http_method == "GET" and path == _LINEAGE_LIST_ROUTE:
+            serve_debug_lineage(self, self.path)
         elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
             serve_debug_request_by_id(self, path)
+        elif http_method == "GET" and route == _LINEAGE_ONE_ROUTE:
+            serve_debug_lineage_by_id(self, path)
         elif "jax" in sys.modules:
             # The request-level annotation only exists to line the request
             # up with XLA timelines. A bare TraceAnnotation, not
@@ -627,6 +717,22 @@ def _debug_one_route(req):
     return routing.Response.json(status, obj)
 
 
+def _lineage_list_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _lineage_list_payload(
+        req.server_name if hasattr(req, "server_name") else "", req.target)
+    return routing.Response.json(status, obj)
+
+
+def _lineage_one_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _lineage_by_id_payload(
+        req.server_name if hasattr(req, "server_name") else "", req.path)
+    return routing.Response.json(status, obj)
+
+
 def _history_route(req):
     from predictionio_tpu.utils import routing
 
@@ -663,5 +769,8 @@ def register_builtin_routes(router) -> None:
     router.get(_HISTORY_ROUTE, _history_route)
     router.get(_PROFILE_ROUTE, _profile_route, blocking=True)
     router.get(_PROFILE_DEVICE_ROUTE, _profile_device_route)
+    router.get(_LINEAGE_LIST_ROUTE, _lineage_list_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
                       template=_DEBUG_ONE_ROUTE)
+    router.add_prefix("GET", "/debug/lineage/", ".json", _lineage_one_route,
+                      template=_LINEAGE_ONE_ROUTE)

@@ -95,6 +95,11 @@ class FlightRecorder:
         self._sampled: deque = deque()
         # trace_id -> frozen timeline dict; kept in lockstep with the rings
         self._index: Dict[str, dict] = {}
+        # ids that were held once but fell out of a ring — lets the 404
+        # envelope distinguish "evicted" from "never seen". Bounded FIFO.
+        self._evicted_ids: Dict[str, bool] = {}
+        self._evicted_order: deque = deque()
+        self._evicted_slots = 4096
         self._rng = random.Random()
         self._random = self._rng.random
         # cached children: .inc() via the metric re-resolves the child
@@ -174,14 +179,27 @@ class FlightRecorder:
             # drop the index entry if it still points at the evictee
             if self._index.get(old["trace_id"]) is old:
                 del self._index[old["trace_id"]]
+                self._remember_evicted(old["trace_id"])
             evicted_counter.inc()
         ring.append(entry)
+
+    def _remember_evicted(self, trace_id: str) -> None:
+        if trace_id not in self._evicted_ids:
+            self._evicted_ids[trace_id] = True
+            self._evicted_order.append(trace_id)
+            while len(self._evicted_order) > self._evicted_slots:
+                del self._evicted_ids[self._evicted_order.popleft()]
 
     # -- retrieval -------------------------------------------------------
 
     def get(self, trace_id: str) -> Optional[dict]:
         with self._lock:
             return self._index.get(trace_id)
+
+    def was_evicted(self, trace_id: str) -> bool:
+        """Held once, since pushed out — not the same 404 as never-seen."""
+        with self._lock:
+            return trace_id in self._evicted_ids
 
     def snapshot(self, limit: int = 50, route: Optional[str] = None,
                  kind: Optional[str] = None) -> List[dict]:
@@ -208,6 +226,8 @@ class FlightRecorder:
             self._pinned.clear()
             self._sampled.clear()
             self._index.clear()
+            self._evicted_ids.clear()
+            self._evicted_order.clear()
             self._size_pinned.set(0)
             self._size_sampled.set(0)
 

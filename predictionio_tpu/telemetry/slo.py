@@ -288,3 +288,8 @@ if hasattr(os, "register_at_fork"):
 # deployments override via set_objective().
 set_objective("eventserver", "/events.json")
 set_objective("predictionserver", "/queries.json")
+# Freshness SLO for the online-learning plane: event→servable under the
+# 5 s bench bar (bench.py FRESHNESS_BAR_S) for 99% of folded events. Fed
+# by OnlinePlane._fold_batch via observe_many; silent when the plane is
+# off (no samples → underfed windows).
+set_objective("online", "event_to_servable", latency_threshold_s=5.0)

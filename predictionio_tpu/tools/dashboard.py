@@ -14,6 +14,7 @@ from typing import Optional
 
 from predictionio_tpu.storage.registry import Storage
 from predictionio_tpu.telemetry import history as metrics_history
+from predictionio_tpu.telemetry import lineage as event_lineage
 from predictionio_tpu.telemetry import slo
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY, Histogram
@@ -66,6 +67,15 @@ control endpoint — <code>/status.json</code> on the port announced as
 random sample of the rest) — newest first, full JSON at
 <a href="/debug/requests.json">/debug/requests.json</a>.</p>
 {flight}
+<h2>Freshness &amp; lineage</h2>
+<p>Event→servable freshness and the per-event causal timelines behind
+it: stage-lag trends from the metrics history, the slowest held
+timeline, and the lineage rings. Full dumps at
+<a href="/debug/lineage.json">/debug/lineage.json</a>; stage glossary
+and runbook in <code>docs/observability.md</code>. Raw families:
+<code>lineage_*</code>, <code>online_event_to_servable_seconds</code>
+on <a href="/metrics">/metrics</a>.</p>
+{lineage}
 <h2>Profile</h2>
 <p>Always-on wall-clock stack sampler: top frames by self-time with the
 route split each frame's samples came from. Collapsed stacks and
@@ -402,6 +412,61 @@ def _experiment_table(registry=REGISTRY) -> str:
     return "".join(out)
 
 
+def _lineage_table(registry=REGISTRY) -> str:
+    sizes = event_lineage.LINEAGE.sizes()
+    counts = event_lineage.LINEAGE.stage_counts()
+    if not counts:
+        return ("<p>No lineage timelines yet (the online plane records "
+                "them per folded event — <code>PIO_ONLINE=1</code>; "
+                "<code>PIO_LINEAGE=0</code> disables the recorder).</p>")
+    out = []
+    fresh = registry.get("online_event_to_servable_seconds")
+    if isinstance(fresh, Histogram):
+        for _key, (_, total, count) in fresh.collect():
+            if count:
+                out.append(
+                    "<p>Freshness: %d folded events, mean %.2fs "
+                    "event→servable.</p>" % (count, total / count))
+            break
+    out.append(f"<p>Timelines held: {sizes['live']} live, "
+               f"{sizes['pinned']} pinned. Stage records: "
+               + ", ".join(f"{html.escape(s)}={counts[s]}"
+                           for s in event_lineage.STAGES if s in counts)
+               + ".</p>")
+    hist = metrics_history.get_history()
+    rows = []
+    if hist is not None:
+        for stage in event_lineage.STAGES:
+            pts = hist.series("lineage_stage_lag_seconds",
+                              labels={"stage": stage}, window_s=120.0,
+                              agg="max")
+            vals = [v for _t, v in pts][-60:]
+            if len(vals) >= 2:
+                rows.append((stage, _sparkline(vals), vals[-1]))
+    if rows:
+        out.append("<table><tr><th>Stage lag</th><th>Trend</th>"
+                   "<th>Latest</th></tr>")
+        for stage, spark, latest in rows:
+            out.append(f"<tr><td><code>{html.escape(stage)}</code></td>"
+                       f"<td><code>{spark}</code></td>"
+                       f"<td>{latest:.3g}s</td></tr>")
+        out.append("</table>")
+    worst = None
+    for e in event_lineage.LINEAGE.snapshot(limit=100):
+        f = e.get("freshness_s")
+        if f is not None and (worst is None or f > worst.get("freshness_s")):
+            worst = e
+    if worst is not None:
+        tid = worst["trace_id"]
+        out.append(
+            f"<p>Slowest held timeline: "
+            f"<a href='/debug/lineage/{html.escape(tid)}.json'>"
+            f"{html.escape(tid[:16])}…</a> at "
+            f"{worst['freshness_s']:.2f}s event→servable "
+            f"(kept: {html.escape(str(worst.get('kept') or 'sampled'))}).</p>")
+    return "".join(out)
+
+
 def _profile_table() -> str:
     from predictionio_tpu.telemetry import profiler
 
@@ -485,6 +550,7 @@ class Dashboard(HttpService):
                     history=_history_section(),
                     supervisor=_supervisor_table(),
                     flight=_flight_table(),
+                    lineage=_lineage_table(),
                     profile=_profile_table(),
                     experiment=_experiment_table(),
                     hotpath=_hotpath_table(),

@@ -7,6 +7,7 @@ import http.client
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from predictionio_tpu.data.api import EventServer, EventServerConfig
@@ -270,6 +271,47 @@ class TestShedTraceContract:
         key501 = ('{server="eventserver",method="<other>",'
                   'route="<other>",status="501"}')
         assert fams["http_requests_total"].get(key501, 0) >= 1
+
+
+class TestEvicted404Envelope:
+    def test_404_distinguishes_evicted_from_never_seen(self, memory_storage):
+        """The 404 body says whether the ring once held the trace
+        (`evicted: true`) or never saw it — a missing timeline should
+        never read like the request never happened."""
+        from predictionio_tpu.telemetry import lineage
+
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="Ev404App"))
+        memory_storage.meta_access_keys().insert(AccessKey.generate(app_id))
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          memory_storage)
+        srv.start()
+
+        def get404(tid):
+            url = f"http://127.0.0.1:{srv.port}/debug/requests/{tid}.json"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    raise AssertionError(
+                        f"expected 404, got {resp.status}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                return json.loads(e.read())
+
+        try:
+            assert get404("neverseen404xyz")["evicted"] is False
+            # once held, then pushed out by a flood of pin-worthy traffic
+            RECORDER.offer(_tl("ev404victim", status=500))
+            for i in range(RECORDER.pinned_slots + 50):
+                RECORDER.offer(_tl(f"ev404flood{i}", status=500))
+            assert RECORDER.get("ev404victim") is None
+            assert get404("ev404victim")["evicted"] is True
+            # known to the lineage plane but sampled away by the flight
+            # recorder: the rings are sized independently, so lineage
+            # memory also counts as "this trace existed"
+            lineage.LINEAGE.record_stage(
+                lineage.mint(trace_id="ev404lineageonly"), "ingest")
+            assert get404("ev404lineageonly")["evicted"] is True
+        finally:
+            srv.shutdown()
 
 
 class TestDebugCapture:

@@ -1,0 +1,310 @@
+"""Causal lineage plane (ISSUE 13): the CausalContext envelope across
+the store boundary, the bounded tail-sampled LineageRecorder, the
+/debug/lineage HTTP surface, the fleet merge's sum-exact stage counts,
+and freshness exemplars linking histogram buckets back to timelines."""
+
+import json
+import urllib.error
+import urllib.request
+
+from predictionio_tpu.data.api import EventServer, EventServerConfig
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.storage.base import AccessKey, App
+from predictionio_tpu.telemetry import lineage, tracing
+from predictionio_tpu.telemetry.lineage import (
+    _MAX_STAGES_PER_TRACE,
+    CausalContext,
+    LineageRecorder,
+    find_in_merged,
+    merge_lineage,
+    mint,
+)
+from predictionio_tpu.telemetry.registry import REGISTRY, parse_exemplars
+
+
+def _get_json(port, path):
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get_404(port, path):
+    try:
+        _get_json(port, path)
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        return json.loads(e.read())
+    raise AssertionError(f"expected 404 from {path}")
+
+
+class TestCausalContext:
+    def test_envelope_roundtrip(self):
+        ctx = CausalContext("lin0123456789ab", origin_wall=1000.5, hop=3,
+                            debug=True)
+        d = ctx.to_dict()
+        assert d == {"t": "lin0123456789ab", "w": 1000.5, "h": 3, "d": 1}
+        back = CausalContext.from_dict(d)
+        assert back.trace_id == ctx.trace_id
+        assert back.origin_wall == ctx.origin_wall
+        assert back.hop == 3 and back.debug is True
+        # monotonic origin never crosses the envelope (process-local)
+        assert back.origin_mono is None
+
+    def test_debug_bit_omitted_when_clear(self):
+        d = CausalContext("abc", origin_wall=1.0).to_dict()
+        assert "d" not in d
+        assert CausalContext.from_dict(d).debug is False
+
+    def test_junk_envelope_parses_to_none(self):
+        assert CausalContext.from_dict(None) is None
+        assert CausalContext.from_dict("garbage") is None
+        assert CausalContext.from_dict({"t": "x"}) is None  # missing wall
+        assert CausalContext.from_dict({"t": "x", "w": "NaNope"}) is None
+
+    def test_mint_joins_open_trace(self):
+        with tracing.trace("minted0trace0id"):
+            ctx = mint()
+        assert ctx.trace_id == "minted0trace0id"
+        assert ctx.origin_mono is not None
+
+
+class TestLineageRecorder:
+    def test_ring_bounded_with_eviction_memory(self):
+        rec = LineageRecorder(live_slots=4, pinned_slots=2, sample_rate=1.0)
+        for i in range(10):
+            rec.record_stage(mint(trace_id=f"lr{i}"), "ingest")
+        assert rec.sizes()["live"] == 4
+        assert rec.get("lr0") is None
+        assert rec.was_evicted("lr0")
+        assert rec.knows("lr0")          # evicted, not a ghost
+        assert not rec.knows("never-seen")
+        assert rec.get("lr9") is not None
+
+    def test_completion_time_tail_sampling(self):
+        rec = LineageRecorder(live_slots=16, pinned_slots=16,
+                              sample_rate=0.0, slow_threshold_s=1.0)
+        err = mint(trace_id="lrerr")
+        rec.record_stage(err, "fold", error=True)
+        assert rec.get("lrerr")["kept"] == "error"
+
+        slow = mint(trace_id="lrslow")
+        rec.record_stage(slow, "ingest")
+        rec.complete(slow, freshness_s=2.0)
+        assert rec.get("lrslow")["kept"] == "slow"
+        assert rec.get("lrslow")["freshness_s"] == 2.0
+
+        dbg = mint(trace_id="lrdbg", debug=True)
+        rec.record_stage(dbg, "ingest")
+        assert rec.get("lrdbg")["kept"] == "debug"  # pinned immediately
+
+        healthy = mint(trace_id="lrhealthy")
+        rec.record_stage(healthy, "ingest")
+        rec.complete(healthy, freshness_s=0.1)
+        assert rec.get("lrhealthy") is None  # sample_rate 0 drops it
+        assert rec.was_evicted("lrhealthy")
+        # exact counts are unaffected by what sampling kept
+        assert rec.stage_counts() == {"fold": 1, "ingest": 3}
+
+    def test_stage_cap_keeps_counts_exact(self):
+        rec = LineageRecorder(live_slots=8, pinned_slots=8, sample_rate=1.0)
+        ctx = mint(trace_id="lrcap")
+        for _ in range(_MAX_STAGES_PER_TRACE + 8):
+            rec.record_stage(ctx, "fold")
+        assert len(rec.get("lrcap")["stages"]) == _MAX_STAGES_PER_TRACE
+        assert rec.stage_counts()["fold"] == _MAX_STAGES_PER_TRACE + 8
+        assert ctx.hop == _MAX_STAGES_PER_TRACE + 8
+
+    def test_assembled_timeline_orders_stages_canonically(self):
+        rec = LineageRecorder(live_slots=8, pinned_slots=8, sample_rate=1.0)
+        ctx = mint(trace_id="lrorder", now=100.0)
+        # recorded out of order; assembly sorts by pipeline position
+        rec.record_stage(ctx, "swap", now=103.0)
+        rec.record_stage(ctx, "ingest", now=100.0)
+        rec.record_stage(ctx, "fold", duration_s=0.5, now=103.0)
+        entry = rec.get("lrorder")
+        assert [s["stage"] for s in entry["stages"]] == \
+            ["ingest", "fold", "swap"]
+        by_stage = {s["stage"]: s for s in entry["stages"]}
+        assert by_stage["swap"]["lag_s"] == 3.0
+        assert by_stage["fold"]["duration_s"] == 0.5
+
+    def test_none_context_is_a_noop(self):
+        rec = LineageRecorder(live_slots=4, pinned_slots=4)
+        rec.record_stage(None, "ingest")
+        rec.complete(None)
+        assert rec.stage_counts() == {}
+
+
+class TestStorageEnvelope:
+    def test_sqlite_roundtrip_reattaches_context(self, tmp_path):
+        from predictionio_tpu.storage.registry import (
+            SourceConfig, Storage, StorageConfig,
+        )
+
+        src = SourceConfig(name="LIN", type="sqlite",
+                           path=str(tmp_path / "lineage.db"))
+        storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                        eventdata=src))
+        try:
+            ev = Event(event="rate", entity_type="user", entity_id="u1",
+                       target_entity_type="item", target_entity_id="i1",
+                       properties=DataMap({"rating": 4.0}))
+            ev.lineage_ctx = CausalContext("sqliteroundtrip1",
+                                           origin_wall=123.25, hop=2)
+            storage.l_events().insert(ev, app_id=7)
+            bare = Event(event="rate", entity_type="user", entity_id="u2",
+                         properties=DataMap({"rating": 1.0}))
+            storage.l_events().insert(bare, app_id=7)
+
+            got = storage.l_events().find(app_id=7, entity_id="u1")
+            assert len(got) == 1
+            ctx = got[0].lineage_ctx
+            assert ctx is not None
+            assert ctx.trace_id == "sqliteroundtrip1"
+            assert ctx.origin_wall == 123.25 and ctx.hop == 2
+            # the envelope never leaks into what clients read back
+            assert lineage.ENVELOPE_KEY not in got[0].properties.keyset()
+            assert got[0].to_dict()["properties"] == {"rating": 4.0}
+            # an event without a context stays context-free
+            plain = storage.l_events().find(app_id=7, entity_id="u2")
+            assert getattr(plain[0], "lineage_ctx", None) is None
+        finally:
+            storage.close()
+
+    def test_client_cannot_spoof_the_envelope(self, memory_storage):
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="SpoofApp"))
+        key = AccessKey.generate(app_id)
+        memory_storage.meta_access_keys().insert(key)
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          memory_storage)
+        srv.start()
+        try:
+            body = json.dumps({
+                "event": "rate", "entityType": "user", "entityId": "u1",
+                "properties": {lineage.ENVELOPE_KEY: {"t": "forged"}},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/events.json"
+                f"?accessKey={key.key}",
+                body, {"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("spoofed pio_lineage was accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.shutdown()
+
+
+class TestLineageHttp:
+    def test_event_post_resolves_at_debug_lineage(self, memory_storage):
+        """The acceptance path: one real POST /events.json, then its
+        assembled ingest→commit timeline at /debug/lineage/<id>.json."""
+        app_id = memory_storage.meta_apps().insert(App(id=0, name="LinApp"))
+        key = AccessKey.generate(app_id)
+        memory_storage.meta_access_keys().insert(key)
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                          memory_storage)
+        srv.start()
+        tid = "lineagee2e0001"
+        try:
+            body = json.dumps({
+                "event": "rate", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1",
+                "properties": {"rating": 5.0}}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/events.json"
+                f"?accessKey={key.key}",
+                body, {"Content-Type": "application/json",
+                       "X-PIO-Trace-Id": tid, "X-PIO-Debug": "1"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 201
+                assert resp.headers.get("X-PIO-Trace-Id") == tid
+
+            status, entry = _get_json(srv.port, f"/debug/lineage/{tid}.json")
+            assert status == 200
+            assert entry["trace_id"] == tid
+            assert entry["kept"] == "debug"  # X-PIO-Debug pinned it
+            stages = [s["stage"] for s in entry["stages"]]
+            assert stages[:2] == ["ingest", "commit"]
+            commit = entry["stages"][1]
+            assert commit["lag_s"] >= 0.0 and not commit.get("error")
+
+            # the list dump carries it plus the recorder's own sizes
+            status, dump = _get_json(
+                srv.port, "/debug/lineage.json?kept=debug&limit=500")
+            assert status == 200
+            assert any(e["trace_id"] == tid for e in dump["entries"])
+            assert dump["stages"]["ingest"] >= 1
+            assert set(dump["held"]) >= {"live", "pinned"}
+
+            # 404 envelope: never-seen vs once-held
+            assert _get_404(
+                srv.port, "/debug/lineage/neverheld42.json")["evicted"] \
+                is False
+        finally:
+            srv.shutdown()
+
+
+class TestMergeLineage:
+    def test_sum_exact_merge_and_worker_attribution(self):
+        p1 = {"stages": {"ingest": 3, "commit": 3},
+              "held": {"live": 2, "pinned": 1},
+              "entries": [{"trace_id": "a", "last_ts": 5.0}]}
+        p2 = {"stages": {"ingest": 2, "fold": 1},
+              "held": {"live": 1, "pinned": 0},
+              "entries": [{"trace_id": "b", "last_ts": 7.0}]}
+        merged = merge_lineage([("w0", p1), ("w1", p2), ("w2", None)])
+        assert merged["stages"] == {"ingest": 5, "commit": 3, "fold": 1}
+        assert merged["workers"] == {"w0": 6, "w1": 3, "w2": 0}
+        # the structural invariant the fleet drill asserts over HTTP
+        assert sum(merged["stages"].values()) == \
+            sum(merged["workers"].values())
+        assert merged["held"] == {"live": 3, "pinned": 1}
+        assert [e["trace_id"] for e in merged["entries"]] == ["b", "a"]
+        assert find_in_merged(merged, "a")["worker"] == "w0"
+        assert find_in_merged(merged, "zz") is None
+
+    def test_counts_stay_exact_when_sampling_drops_timelines(self):
+        """Two recorders, one sampling everything away: the merged stage
+        counts still equal the true record totals — exactness must not
+        depend on which timelines survived."""
+        keep = LineageRecorder(live_slots=8, pinned_slots=8,
+                               sample_rate=1.0)
+        drop = LineageRecorder(live_slots=8, pinned_slots=8,
+                               sample_rate=0.0)
+        for i in range(5):
+            c = mint(trace_id=f"mk{i}")
+            keep.record_stage(c, "ingest")
+            keep.complete(c, freshness_s=0.01)
+        for i in range(7):
+            c = mint(trace_id=f"md{i}")
+            drop.record_stage(c, "ingest")
+            drop.complete(c, freshness_s=0.01)
+        assert not drop.snapshot()  # everything was sampled away
+        parts = [(w, {"stages": r.stage_counts(), "held": r.sizes(),
+                      "entries": r.snapshot(limit=32)})
+                 for w, r in (("w0", keep), ("w1", drop))]
+        merged = merge_lineage(parts)
+        assert merged["stages"] == {"ingest": 12}
+        assert merged["workers"] == {"w0": 5, "w1": 7}
+
+
+class TestFreshnessExemplars:
+    def test_event_to_servable_exemplar_roundtrip(self):
+        """An observe inside an open trace lands a trace-id exemplar on
+        the freshness histogram, and parse_exemplars reads it back off
+        the rendered exposition — the bucket→timeline investigation
+        path."""
+        from predictionio_tpu.online.metrics import ONLINE_EVENT_TO_SERVABLE
+
+        tid = "exemplarlineage1"
+        with tracing.trace(tid):
+            ONLINE_EVENT_TO_SERVABLE.labels().observe(0.42)
+        ex = parse_exemplars(REGISTRY.render())
+        mine = {series: info for series, info in ex.items()
+                if series.startswith("online_event_to_servable_seconds_bucket")
+                and info["labels"].get("trace_id") == tid}
+        assert mine, "no exemplar carried the open trace id"
+        assert all(info["value"] == 0.42 for info in mine.values())

@@ -216,13 +216,15 @@ class TestBusyRetry:
             HttpService, JsonRequestHandler,
         )
 
-        script = {"responses": [], "hits": []}
+        script = {"responses": [], "hits": [], "trace_ids": []}
 
         class Handler(JsonRequestHandler):
             def do_POST(self):
                 self.read_body()
                 script["hits"].append((self.path.split("?")[0],
                                        _time.monotonic()))
+                script["trace_ids"].append(
+                    self.headers.get("X-PIO-Trace-Id"))
                 if script["responses"]:
                     status, headers = script["responses"].pop(0)
                 else:
@@ -292,6 +294,26 @@ class TestBusyRetry:
                               entity_id="u1", event_id="caller-key-1")
         assert eid == "e-1"  # the stub's answer after the replay
         assert len(script["hits"]) == 2
+
+    def test_busy_replay_reuses_the_original_trace_id(self, scripted):
+        """An idempotent replay is the SAME logical request: every
+        attempt must carry the X-PIO-Trace-Id minted for the first one,
+        or the server-side lineage of the event that finally commits
+        can't be stitched back to the request that created it."""
+        svc, script = scripted
+        script["responses"] = [(503, {"Retry-After": "0.01"}),
+                               (503, None)]  # 503, 503, then the 201-ish 200
+        ec = EventClient(access_key="k",
+                         url=f"http://127.0.0.1:{svc.port}",
+                         **self._fast())
+        eid = ec.create_event(event="rate", entity_type="user",
+                              entity_id="u1", event_id="trace-reuse-1")
+        assert eid == "e-1"
+        assert len(script["hits"]) == 3
+        tids = script["trace_ids"]
+        assert tids[0], "first attempt carried no trace id"
+        assert len(set(tids)) == 1, (
+            f"busy replays minted fresh trace ids: {tids}")
 
     def test_busy_retries_zero_restores_fail_fast(self, scripted):
         svc, script = scripted
