@@ -25,6 +25,14 @@ storage/event layers remain usable in processes that never touch a device.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("PIO_LOCKSAN"):
+    # opt-in lock-order sanitizer: patch threading.Lock/RLock before
+    # any plane module creates its locks (utils/locksan.py)
+    from predictionio_tpu.utils import locksan as _locksan
+    _locksan.maybe_install()
+
 from predictionio_tpu.data.events import Event  # noqa: F401
 from predictionio_tpu.data.datamap import DataMap, PropertyMap  # noqa: F401
 from predictionio_tpu.data.bimap import BiMap  # noqa: F401

@@ -152,6 +152,31 @@ def registrations(tree: ast.AST) -> Iterator[Tuple[str, str, str, ast.AST]]:
         yield reg.method, reg.path, reg.handler_name, reg.handler_node
 
 
+def qualname_index(tree: ast.AST) -> Dict[int, str]:
+    """id(def-node) → full qualname path for every function/class in the
+    module, Python-spelled: methods are ``Class.method``, functions
+    nested in functions are ``outer.<locals>.inner``. The full path is
+    what makes Finding symbols collision-free when two same-named
+    nested functions live in one module."""
+    out: Dict[int, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[id(child)] = q
+                visit(child, f"{q}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}{child.name}"
+                out[id(child)] = q
+                visit(child, f"{q}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
 def function_defs(tree: ast.AST) -> dict:
     """name → FunctionDef for every function in the module (module level
     and inside classes; last definition wins on collisions)."""

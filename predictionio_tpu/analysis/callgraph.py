@@ -1,0 +1,439 @@
+"""Project-wide symbol table and call graph for the analysis engine.
+
+Everything in `astutil` is deliberately module-local — the PR-12 rules
+assert per file. This module is the whole-program layer on top: one
+pass over the Project's parsed-AST cache builds
+
+- a **symbol table**: every function/method in every module, keyed by
+  ``<rel-path>::<qualname>`` (qualnames are full paths —
+  ``Class.method``, ``outer.<locals>.inner`` — so two same-named
+  nested functions are distinct symbols);
+- an **import table** per module: ``import a.b as x`` /
+  ``from a.b import c as d`` (including relative imports) resolved to
+  in-project modules, so ``x.f()`` and ``d()`` become cross-module
+  call edges;
+- **self-typed attributes**: ``self.store = SqliteStore(...)`` records
+  ``store → SqliteStore`` on the class, so a later
+  ``self.store.find()`` resolves to ``SqliteStore.find`` even three
+  modules away;
+- the **call graph**: per-function resolved call edges with line
+  numbers, plus a bounded-depth ``reachable()`` that preserves the
+  witness call chain (who called whom, at which line) so a finding can
+  print the exact route → helper → sqlite path it proved.
+
+Syntax-error modules (``Module.tree is None``) are simply absent from
+the graph — the scan proceeds, the broken module just contributes no
+symbols (the engine's gate rules already flag unparseable files).
+
+Dynamic dispatch (a callable stored in a dict, a subscriber list, a
+``route.fn``) is out of scope by design: the graph only contains edges
+it can prove, which is what lets the blocking-call rule say "this
+route provably reaches sqlite" without drowning in speculation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import Project
+
+# bounded resolution depths: local-alias chasing and base-class walks
+_ALIAS_DEPTH = 3
+_MRO_DEPTH = 4
+# default reachability bound — deep enough for route → plane → storage
+# chains, bounded so a pathological cycle can't hang the scan
+DEFAULT_DEPTH = 8
+
+
+@dataclasses.dataclass
+class FuncSym:
+    """One function/method in the project."""
+
+    fid: str                 # "<rel>::<qualname>"
+    rel: str                 # module rel path, '/'-separated
+    qualname: str            # full path, e.g. "Plane.handle.<locals>.go"
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]       # immediately-enclosing class name, if a method
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+@dataclasses.dataclass
+class ClassSym:
+    cid: str                               # "<rel>::<ClassName>"
+    rel: str
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, FuncSym]
+    bases: List[ast.AST]                   # raw base expressions
+    attr_types: Dict[str, str]             # self.<attr> → class cid
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: str              # fid
+    line: int
+    call: Optional[ast.Call] = None   # the call expression itself
+
+
+def module_dotted(rel: str) -> str:
+    """'predictionio_tpu/utils/faults.py' → 'predictionio_tpu.utils.faults';
+    '__init__.py' files name their package."""
+    path = rel[:-3] if rel.endswith(".py") else rel
+    parts = path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _own_body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own body, NOT descending into nested function/
+    class definitions (those are separate symbols with their own edges).
+    The nested def node itself is yielded so callers can index it."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    """The whole-program symbol table + resolved call edges."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.funcs: Dict[str, FuncSym] = {}
+        self.classes: Dict[str, ClassSym] = {}
+        # dotted module name → rel path (only parseable project modules)
+        self.module_rel: Dict[str, str] = {}
+        # rel → {local alias → ("module", dotted) | ("symbol", dotted, name)}
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        # rel → {top-level/class-level name → fid/cid} for quick lookup
+        self._mod_funcs: Dict[str, Dict[str, FuncSym]] = {}
+        self._mod_classes: Dict[str, Dict[str, ClassSym]] = {}
+        self.edges: Dict[str, List[CallSite]] = {}
+        # id(Call node) → enclosing FuncSym fid (for context lookups)
+        self.call_owner: Dict[int, str] = {}
+        self._qualnames: Dict[str, Dict[int, str]] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------------
+
+    def _build(self) -> None:
+        mods = [m for m in self.project.modules() if m.tree is not None]
+        for mod in mods:
+            self.module_rel[module_dotted(mod.rel)] = mod.rel
+        for mod in mods:
+            self._index_module(mod)
+        for mod in mods:
+            self._resolve_attr_types(mod)
+        for mod in mods:
+            self._build_edges(mod)
+
+    def _index_module(self, mod) -> None:
+        qn = astutil.qualname_index(mod.tree)
+        self._qualnames[mod.rel] = qn
+        self.imports[mod.rel] = self._import_table(mod)
+        mod_funcs: Dict[str, FuncSym] = {}
+        mod_classes: Dict[str, ClassSym] = {}
+
+        def visit(node: ast.AST, cls: Optional[ClassSym]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    fs = FuncSym(f"{mod.rel}::{qn[id(child)]}", mod.rel,
+                                 qn[id(child)], child,
+                                 cls.name if cls else None)
+                    self.funcs[fs.fid] = fs
+                    if cls is not None:
+                        cls.methods.setdefault(child.name, fs)
+                    elif "." not in fs.qualname:
+                        mod_funcs[child.name] = fs
+                    # nested defs index under their parent's scope only
+                    visit(child, None)
+                elif isinstance(child, ast.ClassDef):
+                    cs = ClassSym(f"{mod.rel}::{child.name}", mod.rel,
+                                  child.name, child, {}, list(child.bases),
+                                  {})
+                    self.classes[cs.cid] = cs
+                    if "." not in qn[id(child)]:
+                        mod_classes[child.name] = cs
+                    visit(child, cs)
+                else:
+                    visit(child, cls)
+
+        visit(mod.tree, None)
+        self._mod_funcs[mod.rel] = mod_funcs
+        self._mod_classes[mod.rel] = mod_classes
+
+    def _import_table(self, mod) -> Dict[str, Tuple]:
+        table: Dict[str, Tuple] = {}
+        pkg_parts = module_dotted(mod.rel).split(".")
+        if not mod.rel.endswith("/__init__.py"):
+            pkg_parts = pkg_parts[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        table[alias.asname] = ("module", alias.name)
+                    else:
+                        # `import a.b.c` binds "a"; attribute chains
+                        # resolve through _module_of_expr
+                        table[alias.name.split(".")[0]] = (
+                            "module", alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base)
+                    src = (f"{prefix}.{node.module}" if node.module
+                           else prefix)
+                else:
+                    src = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    # `from a.b import c`: c may itself be a module
+                    if f"{src}.{alias.name}" in self.module_rel:
+                        table[bound] = ("module", f"{src}.{alias.name}")
+                    else:
+                        table[bound] = ("symbol", src, alias.name)
+        return table
+
+    def _resolve_attr_types(self, mod) -> None:
+        """self.<attr> = ClassName(...) — record the attribute's class so
+        `self.<attr>.method()` resolves across modules."""
+        for cs in self._mod_classes[mod.rel].values():
+            for node in ast.walk(cs.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                target_cls = self._class_of_expr(node.value.func, mod.rel)
+                if target_cls is not None:
+                    cs.attr_types[tgt.attr] = target_cls.cid
+
+    # -- name resolution -----------------------------------------------------
+
+    def _module_of_expr(self, node: ast.AST, rel: str) -> Optional[str]:
+        """Resolve an expression naming a module (Name or dotted
+        Attribute chain) to a project module rel path."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        target = self.imports.get(rel, {}).get(node.id)
+        if target is None or target[0] != "module":
+            return None
+        dotted = ".".join([target[1]] + list(reversed(parts)))
+        return self.module_rel.get(dotted)
+
+    def _class_of_expr(self, node: ast.AST, rel: str) -> Optional[ClassSym]:
+        """Resolve an expression naming a class: local class, imported
+        symbol, or `mod.Class` attribute."""
+        if isinstance(node, ast.Name):
+            local = self._mod_classes.get(rel, {}).get(node.id)
+            if local is not None:
+                return local
+            target = self.imports.get(rel, {}).get(node.id)
+            if target is not None and target[0] == "symbol":
+                src_rel = self.module_rel.get(target[1])
+                if src_rel is not None:
+                    return self._mod_classes.get(src_rel, {}).get(target[2])
+            return None
+        if isinstance(node, ast.Attribute):
+            src_rel = self._module_of_expr(node.value, rel)
+            if src_rel is not None:
+                return self._mod_classes.get(src_rel, {}).get(node.attr)
+        return None
+
+    def _func_in_module(self, rel: str, name: str) -> Optional[FuncSym]:
+        return self._mod_funcs.get(rel, {}).get(name)
+
+    def resolve_method(self, cls: ClassSym, name: str,
+                       _depth: int = _MRO_DEPTH) -> Optional[FuncSym]:
+        """`name` on `cls` or (bounded) its project base classes."""
+        fs = cls.methods.get(name)
+        if fs is not None or _depth <= 0:
+            return fs
+        for base_expr in cls.bases:
+            base = self._class_of_expr(base_expr, cls.rel)
+            if base is not None and base.cid != cls.cid:
+                fs = self.resolve_method(base, name, _depth - 1)
+                if fs is not None:
+                    return fs
+        return None
+
+    def class_of_attr(self, cls: ClassSym, attr: str) -> Optional[ClassSym]:
+        cid = cls.attr_types.get(attr)
+        if cid is None:
+            for base_expr in cls.bases:
+                base = self._class_of_expr(base_expr, cls.rel)
+                if base is not None and base.cid != cls.cid:
+                    cid = base.attr_types.get(attr)
+                    if cid:
+                        break
+        return self.classes.get(cid) if cid else None
+
+    def _resolve_call(self, call: ast.Call, caller: FuncSym,
+                      local_aliases: Dict[str, ast.AST],
+                      nested: Dict[str, FuncSym]) -> Optional[FuncSym]:
+        fn = call.func
+        fn = astutil.resolve_alias(fn, local_aliases, depth=_ALIAS_DEPTH)
+        rel = caller.rel
+        if isinstance(fn, ast.Name):
+            if fn.id in nested:
+                return nested[fn.id]
+            local = self._func_in_module(rel, fn.id)
+            if local is not None:
+                return local
+            cls = self._class_of_expr(fn, rel)
+            if cls is not None:                        # ClassName(...)
+                return self.resolve_method(cls, "__init__")
+            target = self.imports.get(rel, {}).get(fn.id)
+            if target is not None and target[0] == "symbol":
+                src_rel = self.module_rel.get(target[1])
+                if src_rel is not None:
+                    return self._func_in_module(src_rel, target[2])
+            return None
+        if isinstance(fn, ast.Attribute):
+            value = fn.value
+            # self.method(...) — enclosing class (incl. project bases)
+            if (isinstance(value, ast.Name) and value.id == "self"
+                    and caller.cls is not None):
+                cls = self._mod_classes.get(rel, {}).get(caller.cls)
+                if cls is not None:
+                    return self.resolve_method(cls, fn.attr)
+                return None
+            # self.field.method(...) — self-typed attribute
+            if (isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "self"
+                    and caller.cls is not None):
+                cls = self._mod_classes.get(rel, {}).get(caller.cls)
+                if cls is not None:
+                    field_cls = self.class_of_attr(cls, value.attr)
+                    if field_cls is not None:
+                        return self.resolve_method(field_cls, fn.attr)
+                return None
+            # mod.func(...) / pkg.mod.func(...)
+            src_rel = self._module_of_expr(value, rel)
+            if src_rel is not None:
+                fs = self._func_in_module(src_rel, fn.attr)
+                if fs is not None:
+                    return fs
+                cls = self._mod_classes.get(src_rel, {}).get(fn.attr)
+                if cls is not None:
+                    return self.resolve_method(cls, "__init__")
+                return None
+            # var.method(...) where var = ClassName(...) locally
+            if isinstance(value, ast.Name):
+                aliased = local_aliases.get(value.id)
+                if isinstance(aliased, ast.Call):
+                    cls = self._class_of_expr(aliased.func, rel)
+                    if cls is not None:
+                        return self.resolve_method(cls, fn.attr)
+        return None
+
+    def _build_edges(self, mod) -> None:
+        qn = self._qualnames[mod.rel]
+        for fs in [f for f in self.funcs.values() if f.rel == mod.rel]:
+            local_aliases: Dict[str, ast.AST] = {}
+            nested: Dict[str, FuncSym] = {}
+            calls: List[ast.Call] = []
+            for node in _own_body_walk(fs.node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nfid = f"{mod.rel}::{qn[id(node)]}"
+                    nfs = self.funcs.get(nfid)
+                    if nfs is not None:
+                        nested[node.name] = nfs
+                elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    local_aliases[node.targets[0].id] = node.value
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+            sites: List[CallSite] = []
+            for call in calls:
+                self.call_owner[id(call)] = fs.fid
+                callee = self._resolve_call(call, fs, local_aliases, nested)
+                if callee is not None and callee.fid != fs.fid:
+                    sites.append(CallSite(callee.fid, call.lineno, call))
+            if sites:
+                self.edges[fs.fid] = sites
+
+    # -- queries -------------------------------------------------------------
+
+    def func(self, fid: str) -> Optional[FuncSym]:
+        return self.funcs.get(fid)
+
+    def module_funcs(self, rel: str) -> Dict[str, FuncSym]:
+        return self._mod_funcs.get(rel, {})
+
+    def module_classes(self, rel: str) -> Dict[str, ClassSym]:
+        return self._mod_classes.get(rel, {})
+
+    def owner_of_call(self, call: ast.Call) -> Optional[FuncSym]:
+        fid = self.call_owner.get(id(call))
+        return self.funcs.get(fid) if fid else None
+
+    def reachable(self, root_fid: str, max_depth: int = DEFAULT_DEPTH
+                  ) -> List[Tuple[FuncSym, Tuple[Tuple[str, int], ...]]]:
+        """BFS closure of `root_fid` (root included, empty chain). Each
+        result carries its witness chain: ((caller_fid, call_line), ...)
+        from the root down to the function, shortest-first."""
+        root = self.funcs.get(root_fid)
+        if root is None:
+            return []
+        out: List[Tuple[FuncSym, Tuple[Tuple[str, int], ...]]] = []
+        seen: Set[str] = {root_fid}
+        frontier: List[Tuple[str, Tuple[Tuple[str, int], ...]]] = [
+            (root_fid, ())]
+        out.append((root, ()))
+        for _ in range(max_depth):
+            nxt: List[Tuple[str, Tuple[Tuple[str, int], ...]]] = []
+            for fid, chain in frontier:
+                for site in self.edges.get(fid, ()):
+                    if site.callee in seen:
+                        continue
+                    seen.add(site.callee)
+                    callee = self.funcs[site.callee]
+                    new_chain = chain + ((fid, site.line),)
+                    out.append((callee, new_chain))
+                    nxt.append((site.callee, new_chain))
+            if not nxt:
+                break
+            frontier = nxt
+        return out
+
+    def render_chain(self, chain: Tuple[Tuple[str, int], ...],
+                     last: Optional[FuncSym] = None) -> str:
+        """Human chain: 'a.py::f:12 → b.py::g:34 → c.py::h'."""
+        parts = [f"{self.funcs[fid].qualname} ({fid.split('::')[0]}:{line})"
+                 for fid, line in chain]
+        if last is not None:
+            parts.append(last.qualname)
+        return " → ".join(parts)
+
+
+def get(project: Project) -> CallGraph:
+    """The project's call graph, built once and cached on the Project."""
+    graph = project.__dict__.get("_callgraph")
+    if graph is None:
+        graph = CallGraph(project)
+        project.__dict__["_callgraph"] = graph
+    return graph

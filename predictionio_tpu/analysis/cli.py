@@ -5,9 +5,12 @@
     python bin/pio-lint --rules race-shared-state,race-lock-order
     python bin/pio-lint --list-rules
     python bin/pio-lint --no-baseline   # show grandfathered findings too
+    python bin/pio-lint --changed main  # only modules touched vs a ref
 
 Exit 0 when every finding is baselined (conf/analysis-baseline.json)
 or inline-suppressed; 1 on any new finding or a malformed baseline.
+``--changed`` narrows *reporting* to touched modules; the analysis
+itself (call graph, lock graph) stays whole-program.
 """
 
 from __future__ import annotations
@@ -15,10 +18,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from predictionio_tpu.analysis import engine
+
+
+def _changed_modules(root: str, ref: str) -> Set[str]:
+    """Repo-relative .py paths touched relative to ``ref``: committed,
+    staged, and unstaged changes since merge-base(ref, HEAD) — what a
+    pre-push hook cares about. ``git diff <ref>...`` gives exactly
+    that in one call."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", f"{ref}..."],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"git diff failed: {e}")
+    if diff.returncode != 0:
+        raise RuntimeError(
+            f"git diff {ref!r} failed: {diff.stderr.strip()}")
+    return {line.strip().replace(os.sep, "/")
+            for line in diff.stdout.splitlines()
+            if line.strip().endswith(".py")}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -45,6 +68,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="print the rule catalog and exit")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="JSON output")
+    p.add_argument("--changed", metavar="GIT_REF", default=None,
+                   help="report only findings in modules touched "
+                        "relative to GIT_REF (committed + staged + "
+                        "unstaged); the call/lock graphs stay "
+                        "whole-program, only reporting narrows")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -67,6 +95,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"pio-lint: {e.args[0]}", file=sys.stderr)
         return 2
 
+    changed = None
+    if args.changed is not None:
+        try:
+            changed = _changed_modules(args.root, args.changed)
+        except RuntimeError as e:
+            print(f"pio-lint: --changed: {e}", file=sys.stderr)
+            return 2
+        # the scan above was still whole-program — cross-module rules
+        # already saw every path; we only narrow what gets reported
+        findings = [f for f in findings if f.file in changed]
+
     baseline_path = args.baseline or os.path.join(
         args.root, engine.DEFAULT_BASELINE)
     baseline = {}
@@ -77,6 +116,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (engine.BaselineError, ValueError) as e:
             baseline_error = str(e)
     new, grandfathered, stale = engine.partition(findings, baseline)
+    if changed is not None:
+        # a filtered view can't judge staleness — entries for untouched
+        # modules are invisible here, not stale
+        stale = []
 
     if args.as_json:
         print(json.dumps({
@@ -88,6 +131,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "baselined": len(grandfathered),
             "stale_baseline": stale,
             "baseline_error": baseline_error,
+            "changed_filter": (sorted(changed) if changed is not None
+                               else None),
         }, indent=2))
     else:
         for f in new:

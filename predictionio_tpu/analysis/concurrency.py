@@ -632,52 +632,3 @@ def race_global_rmw(project: Project) -> Iterable[Finding]:
                         symbol=name,
                         hint="build a local dict and publish it with "
                              "one atomic rebind")
-
-
-# -- rule: race-lock-order --------------------------------------------------
-
-
-@rule("race-lock-order",
-      "lock acquisition order must be consistent (no A→B vs B→A)")
-def race_lock_order(project: Project) -> Iterable[Finding]:
-    for mod in project.modules():
-        if mod.tree is None:
-            continue
-        ms = _scan(project, mod)
-        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
-        acq_closure: Dict[str, Set[str]] = {}
-        for name, fn in ms.defs.items():
-            fs = ms.scans[id(fn)]
-            closure = set(fs.acquires)
-            assert mod.tree is not None
-            for r in astutil.reachable_functions(mod.tree, [fn],
-                                                 max_depth=3):
-                rs = ms.scans.get(id(r))
-                if rs is not None:
-                    closure |= rs.acquires
-            acq_closure[name] = closure
-        for name, fn in ms.defs.items():
-            fs = ms.scans[id(fn)]
-            for outer, inner, line in fs.with_edges:
-                edges.setdefault((outer, inner), (line, fs.name))
-            for held, callee, line in fs.calls_while_held:
-                for inner in acq_closure.get(callee, ()):
-                    for outer in held:
-                        if outer != inner:
-                            edges.setdefault((outer, inner),
-                                             (line, fs.name))
-        reported = set()
-        for (a, b), (line, fn_name) in sorted(edges.items(),
-                                              key=lambda kv: kv[1][0]):
-            if (b, a) in edges and frozenset((a, b)) not in reported:
-                reported.add(frozenset((a, b)))
-                other_line, other_fn = edges[(b, a)]
-                yield Finding(
-                    "race-lock-order", mod.rel, max(line, other_line),
-                    f"lock order inversion: {a} → {b} in {fn_name}() "
-                    f"(line {line}) but {b} → {a} in {other_fn}() "
-                    f"(line {other_line}) — two threads taking opposite "
-                    f"orders deadlock",
-                    symbol=f"{a}/{b}",
-                    hint="pick one acquisition order and hold it "
-                         "everywhere")

@@ -1,20 +1,25 @@
-"""Rule pack (b): the event-loop blocking-call rule.
+"""Rule pack (b): the event-loop blocking-call rule (interprocedural).
 
 The selector transport (utils/httploop.py) runs routes registered
 ``blocking=False`` (the default) INLINE on the loop thread: one slow
 call there stalls every connection the process owns. Routes doing real
 work must register ``blocking=True`` to run on the worker pool.
 
-The rule finds, per module, every non-blocking Router registration,
-resolves the handler and its same-module call closure, and flags any
-reachable call that can block:
+Since PR 14 the rule is whole-program: the closure of a non-blocking
+handler is computed on the project call graph (`analysis/callgraph.py`),
+so a route that reaches sqlite through two helper *modules* is flagged
+just like one that blocks inline — with the witness call chain printed
+in the finding ("via Plane.handle → helpers.load → store.query"). The
+flagged vocabulary:
 
 - ``time.sleep``, ``subprocess.*``, ``os.fsync``/``fdatasync``/
-  ``os.system``
+  ``os.system``/``os.replace``, ``shutil.copytree``/``rmtree``
 - sqlite/DB-API surface: ``.execute``/``.executemany``/
-  ``.executescript``/``.commit``/``.fetchall``/``.fetchone``
-- blocking socket/HTTP calls: ``.sendall``, ``urlopen``,
-  ``http.client`` requests via ``.getresponse``
+  ``.executescript``/``.commit``/``.fetchall``/``.fetchone``/
+  ``.fetchmany``
+- blocking socket/HTTP calls: ``.sendall``, ``.connect``,
+  ``socket.create_connection``, ``urlopen``, ``http.client`` requests
+  via ``.getresponse``
 - the storage accessors (``l_events``/``meta_apps``/
   ``meta_access_keys``/``meta_channels``/``p_events``) — each returns a
   sqlite-backed DAO, so touching one from the loop thread puts disk I/O
@@ -24,14 +29,18 @@ reachable call that can block:
 The loop driver itself (any function calling ``.select(...)``) and its
 closure are held to the same list, so loop-internal helpers can't grow
 a blocking call either.
+
+Finding symbols carry the *qualname* of the function containing the
+blocking call (``GET /fast.json:FixtureAPI._settle``), so two
+same-named nested helpers produce distinct baseline keys.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set, Tuple
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
 
-from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis import astutil, callgraph
 from predictionio_tpu.analysis.engine import Finding, Project, rule
 
 # module-qualified calls that block: (module name, attr) — None attr
@@ -41,13 +50,16 @@ _MODULE_CALLS = {
     ("os", "fsync"),
     ("os", "fdatasync"),
     ("os", "system"),
+    ("os", "replace"),
     ("subprocess", None),
     ("shutil", "copytree"),
+    ("shutil", "rmtree"),
+    ("socket", "create_connection"),
 }
 # DB-API / blocking-socket method names (on any object)
 _BLOCKING_ATTRS = {
     "execute", "executemany", "executescript", "commit", "fetchall",
-    "fetchone", "sendall", "getresponse",
+    "fetchone", "fetchmany", "sendall", "getresponse", "connect",
 }
 # storage accessors returning sqlite-backed DAOs
 _STORAGE_ACCESSORS = {
@@ -86,68 +98,115 @@ def _blocking_calls(fn: ast.AST) -> List[Tuple[int, str]]:
     return hits
 
 
-def _loop_drivers(tree: ast.AST) -> List[ast.AST]:
-    """Functions that drive a selector loop (call ``.select(...)``)."""
-    out = []
-    for name, fn in astutil.function_defs(tree).items():
-        for node in ast.walk(fn):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "select"):
-                out.append(fn)
-                break
-    return out
+def _resolve_handler(cg: callgraph.CallGraph, mod_rel: str,
+                     reg: astutil.RouteReg) -> Optional[callgraph.FuncSym]:
+    """The FuncSym a registration hands the Router: `self._handle`
+    resolves on the registering class (project bases included), bare
+    names on the module; last resort is any same-named def in the
+    module (the old name-based behaviour)."""
+    owner = cg.owner_of_call(reg.call)
+    if (owner is not None and owner.cls is not None
+            and isinstance(reg.handler_node, ast.Attribute)):
+        cls = cg.module_classes(mod_rel).get(owner.cls)
+        if cls is not None:
+            fs = cg.resolve_method(cls, reg.handler_name)
+            if fs is not None:
+                return fs
+    fs = cg.module_funcs(mod_rel).get(reg.handler_name)
+    if fs is not None:
+        return fs
+    candidates = sorted(
+        (f for f in cg.funcs.values()
+         if f.rel == mod_rel and f.name == reg.handler_name),
+        key=lambda f: f.fid)
+    return candidates[0] if candidates else None
 
 
-def _fn_name(fn: ast.AST) -> str:
-    return getattr(fn, "name", "<lambda>")
+def _chain_suffix(cg: callgraph.CallGraph,
+                  chain: Tuple[Tuple[str, int], ...],
+                  leaf: callgraph.FuncSym) -> str:
+    if not chain:
+        return ""
+    return f" via {cg.render_chain(chain, leaf)}"
 
 
 @rule("loop-blocking-call",
       "non-blocking route handlers and the selector loop must not "
       "reach blocking calls (sqlite, sleep, fsync, subprocess, "
-      "sendall)")
+      "sendall) — checked across module boundaries")
 def loop_blocking_call(project: Project) -> Iterable[Finding]:
+    cg = callgraph.get(project)
+    # one blocking line is flagged once, by the first root that proves
+    # a path to it — global, so cross-module findings don't repeat per
+    # referencing route
+    seen: Set[Tuple[str, int, str]] = set()
+
+    def _flag(root_desc: str, root_fid: str,
+              symbol_prefix: str) -> Iterator[Finding]:
+        for fs, chain in cg.reachable(root_fid):
+            for lineno, what in _blocking_calls(fs.node):
+                key = (fs.rel, lineno, what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    "loop-blocking-call", fs.rel, lineno,
+                    f"{fs.qualname}() (reachable from {root_desc}"
+                    f"{_chain_suffix(cg, chain, fs)}) calls {what} on "
+                    f"the event-loop thread — one slow call here "
+                    f"stalls every connection",
+                    symbol=f"{symbol_prefix}:{fs.qualname}",
+                    hint="register the route blocking=True (worker "
+                         "pool) or move the call off the loop "
+                         "thread")
+
+    def _flag_lambda(root_desc: str, handler: ast.Lambda, mod_rel: str,
+                     symbol_prefix: str) -> Iterator[Finding]:
+        for lineno, what in _blocking_calls(handler):
+            key = (mod_rel, lineno, what)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                "loop-blocking-call", mod_rel, lineno,
+                f"<lambda> (registered as {root_desc}) calls {what} on "
+                f"the event-loop thread — one slow call here stalls "
+                f"every connection",
+                symbol=f"{symbol_prefix}:<lambda>:{lineno}",
+                hint="register the route blocking=True (worker pool) "
+                     "or move the call off the loop thread")
+        # names a lambda calls still get the whole-program closure
+        for node in ast.walk(handler):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                fs = cg.module_funcs(mod_rel).get(node.func.id)
+                if fs is not None:
+                    yield from _flag(root_desc, fs.fid, symbol_prefix)
+
     for mod in project.modules():
         if mod.tree is None:
             continue
-        tree = mod.tree
-        defs = astutil.function_defs(tree)
-        seen: Set[Tuple[int, str]] = set()
-
-        def _flag(root_desc: str, roots: List[ast.AST],
-                  symbol: str) -> Iterable[Finding]:
-            for fn in astutil.reachable_functions(tree, roots):
-                for lineno, what in _blocking_calls(fn):
-                    if (lineno, what) in seen:
-                        continue
-                    seen.add((lineno, what))
-                    yield Finding(
-                        "loop-blocking-call", mod.rel, lineno,
-                        f"{_fn_name(fn)}() (reachable from {root_desc}) "
-                        f"calls {what} on the event-loop thread — one "
-                        f"slow call here stalls every connection",
-                        symbol=symbol,
-                        hint="register the route blocking=True (worker "
-                             "pool) or move the call off the loop "
-                             "thread")
-
-        for reg in astutil.registration_details(tree):
+        for reg in astutil.registration_details(mod.tree):
             if reg.blocking:
                 continue
-            handler = reg.handler_node
-            roots: List[ast.AST]
-            if isinstance(handler, ast.Lambda):
-                roots = [handler]
-            elif reg.handler_name in defs:
-                roots = [defs[reg.handler_name]]
-            else:
+            desc = f"non-blocking route {reg.method} {reg.path}"
+            prefix = f"{reg.method} {reg.path}"
+            if isinstance(reg.handler_node, ast.Lambda):
+                yield from _flag_lambda(desc, reg.handler_node, mod.rel,
+                                        prefix)
                 continue
-            yield from _flag(
-                f"non-blocking route {reg.method} {reg.path}", roots,
-                symbol=f"{reg.method} {reg.path}")
-        drivers = _loop_drivers(tree)
-        if drivers:
-            yield from _flag(
-                f"the selector loop ({', '.join(sorted(_fn_name(d) for d in drivers))})",
-                drivers, symbol="<loop>")
+            fs = _resolve_handler(cg, mod.rel, reg)
+            if fs is not None:
+                yield from _flag(desc, fs.fid, prefix)
+        # loop drivers: any function in this module calling .select(...)
+        for fs in (f for f in cg.funcs.values() if f.rel == mod.rel):
+            drives = any(
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "select"
+                for node in callgraph._own_body_walk(fs.node)
+                if isinstance(node, ast.Call))
+            if drives:
+                yield from _flag(
+                    f"the selector loop ({fs.qualname})", fs.fid,
+                    "<loop>")

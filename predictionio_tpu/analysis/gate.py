@@ -1,23 +1,101 @@
-"""Analysis gate — CI wrapper over the pio-lint engine.
+"""Analysis gate — CI wrapper over the pio-lint engine + lock sanitizer.
 
-Run via ``python quality.py --analysis-gate``. Fails on any finding not
-grandfathered in ``conf/analysis-baseline.json`` (whose every entry
-must carry a reviewed ``reason``) and not inline-suppressed. No
-imports of the scanned code, no jax — pure AST.
+Run via ``python quality.py --analysis-gate``. Two halves:
+
+1. **Static**: the full rule set over the package. Fails on any finding
+   not grandfathered in ``conf/analysis-baseline.json`` (whose every
+   entry must carry a reviewed ``reason``) and not inline-suppressed.
+   The machine-readable result (the same shape as ``pio-lint --json``)
+   is written to ``$PIO_LINT_ARTIFACT`` (default:
+   ``<tmpdir>/pio-lint.json``) so CI can diff finding deltas across
+   runs. No imports of the scanned code, no jax — pure AST.
+
+2. **Sanitizer drill**: installs `utils/locksan.py`, then runs a
+   cross-plane concurrent workload over the real runtime objects —
+   ingest group-commit writer, serving result cache, the invalidation
+   bus wiring them, telemetry counters underneath — and asserts
+   (a) the observed dynamic lock-order graph has no cycle, and
+   (b) every dynamic edge between package lock sites exists in the
+   static lock graph (`analysis/lockgraph.py`) or carries a reviewed
+   entry in ``conf/lockorder-baseline.json``. A dynamic-only edge is a
+   static-resolution bug; a dynamic cycle is a deadlock the static
+   model must already have flagged. The drill imports the workload
+   modules *after* installing the sanitizer so their locks are born
+   wrapped — which is why it must run before anything else drags the
+   runtime in (quality.py's gate dispatch imports lazily for exactly
+   this reason).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
+import tempfile
+import time
+from typing import Dict, List, Tuple
 
 from predictionio_tpu.analysis import engine
 
+LOCKORDER_BASELINE = os.path.join("conf", "lockorder-baseline.json")
 
-def run_gate() -> int:
+
+def _artifact_path() -> str:
+    return os.environ.get("PIO_LINT_ARTIFACT") or os.path.join(
+        tempfile.gettempdir(), "pio-lint.json")
+
+
+def load_lockorder_baseline(path: str) -> Dict[str, str]:
+    """'<label> -> <label>' → reason; every entry needs a reviewed
+    reason, same discipline as the findings baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, str] = {}
+    for e in data.get("edges", []):
+        if not isinstance(e, dict) or not e.get("edge"):
+            raise engine.BaselineError(
+                f"lockorder baseline entry missing 'edge': {e!r}")
+        if not str(e.get("reason", "")).strip():
+            raise engine.BaselineError(
+                f"lockorder baseline edge {e['edge']!r} has no reason — "
+                f"entries must be reviewed and commented")
+        out[" -> ".join(p.strip() for p in e["edge"].split("->"))] = \
+            e["reason"]
+    return out
+
+
+def _sync_static_metrics(n_modules: int, n_new: int, n_baselined: int,
+                         scan_s: float) -> None:
+    """Publish the scan's shape as analysis_* gauges so CI dashboards
+    can trend scan time and finding counts across runs."""
+    try:
+        from predictionio_tpu.telemetry.registry import REGISTRY
+        REGISTRY.gauge(
+            "analysis_scan_seconds",
+            "wall time of the last whole-program pio-lint scan").set(scan_s)
+        REGISTRY.gauge(
+            "analysis_modules_scanned",
+            "modules parsed by the last pio-lint scan").set(float(n_modules))
+        REGISTRY.gauge(
+            "analysis_findings_new",
+            "unbaselined findings from the last pio-lint scan").set(
+            float(n_new))
+        REGISTRY.gauge(
+            "analysis_findings_baselined",
+            "grandfathered findings from the last pio-lint scan").set(
+            float(n_baselined))
+    except Exception:   # metrics are best-effort in the gate
+        pass
+
+
+def run_static() -> Tuple[int, "engine.Project"]:
+    t0 = time.perf_counter()
     project = engine.Project(engine.default_root(),
                              subdirs=engine.DEFAULT_SUBDIRS)
     findings = engine.run_rules(project)
+    scan_s = time.perf_counter() - t0
     baseline_path = os.path.join(engine.default_root(),
                                  engine.DEFAULT_BASELINE)
     problems = []
@@ -26,14 +104,172 @@ def run_gate() -> int:
     except (engine.BaselineError, ValueError) as e:
         baseline = {}
         problems.append(f"baseline: {e}")
-    new, grandfathered, _stale = engine.partition(findings, baseline)
+    new, grandfathered, stale = engine.partition(findings, baseline)
     problems.extend(f.render() for f in new)
+    for key in stale:
+        problems.append(f"stale baseline entry {key!r} no longer fires — "
+                        f"remove it")
+    artifact = _artifact_path()
+    try:
+        with open(artifact, "w", encoding="utf-8") as f:
+            json.dump({
+                "root": project.root,
+                "modules": len(project.modules()),
+                "scan_seconds": round(scan_s, 3),
+                "findings": [dict(fi.to_dict(),
+                                  baselined=(fi.key in baseline))
+                             for fi in findings],
+                "new": len(new),
+                "baselined": len(grandfathered),
+                "stale_baseline": stale,
+            }, f, indent=2)
+    except OSError as e:
+        problems.append(f"artifact: cannot write {artifact}: {e}")
+    _sync_static_metrics(len(project.modules()), len(new),
+                         len(grandfathered), scan_s)
     for p in problems:
         print(p, file=sys.stderr)
-    print(f"analysis gate: {'FAIL' if problems else 'OK'} "
+    print(f"analysis gate [static]: {'FAIL' if problems else 'OK'} "
           f"({len(problems)} problem(s), {len(grandfathered)} baselined, "
-          f"{len(project.modules())} module(s) scanned)")
+          f"{len(project.modules())} module(s) scanned in {scan_s:.1f}s, "
+          f"artifact: {artifact})")
+    return (1 if problems else 0), project
+
+
+def _drill_workload() -> None:
+    """Hammer the cross-plane surfaces concurrently: ingest group
+    commit, serving result cache, the invalidation bus between them,
+    metric counters under every lock. Shapes mirror the chaos/online
+    drills, sized to finish in ~a second."""
+    import threading
+
+    from predictionio_tpu.ingest.invalidation import InvalidationBus
+    from predictionio_tpu.ingest.writer import GroupCommitWriter, \
+        IngestConfig
+    from predictionio_tpu.serving.result_cache import ResultCache
+
+    bus = InvalidationBus()
+    cache = ResultCache(max_entries=256, ttl_s=30.0)
+    bus.subscribe(cache.invalidate_entities)
+
+    def insert_fn(event, app_id, channel_id=None):
+        return f"e-{id(event)}"
+
+    def grouped_fn(items):
+        return [f"g-{i}" for i, _ in enumerate(items)]
+
+    writer = GroupCommitWriter(insert_fn, grouped_fn,
+                               IngestConfig(max_wait_ms=1, max_queue=256),
+                               name="locksan-drill")
+    errors: List[BaseException] = []
+
+    def serve(worker: int) -> None:
+        try:
+            for i in range(120):
+                user = f"u{(worker * 7 + i) % 5}"
+                q = {"user": user, "num": 4}
+                if cache.get(q, variant="a") is not None:
+                    pass
+                cache.put(q, {"scores": [i]}, variant="a")
+                if i % 17 == 0:
+                    cache.invalidate_variant("a")
+        except BaseException as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+
+    def ingest(worker: int) -> None:
+        try:
+            for i in range(60):
+                writer.submit({"entityId": f"u{i % 5}"}, app_id=1)
+                bus.publish([f"u{i % 5}"], variant=None)
+        except BaseException as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [
+        *[threading.Thread(target=serve, args=(w,)) for w in range(3)],
+        *[threading.Thread(target=ingest, args=(w,)) for w in range(3)],
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    close = getattr(writer, "close", None)
+    if callable(close):
+        close()
+    if errors:
+        raise errors[0]
+
+
+def run_locksan_drill() -> int:
+    from predictionio_tpu.utils import locksan
+
+    locksan.install()
+    locksan.reset()
+    problems: List[str] = []
+    try:
+        _drill_workload()
+    except BaseException as e:
+        problems.append(f"drill workload failed: {e!r}")
+    # static model + reviewed dynamic-edge baseline
+    project = engine.Project(engine.default_root(),
+                             subdirs=engine.DEFAULT_SUBDIRS)
+    from predictionio_tpu.analysis import lockgraph
+    lg = lockgraph.get(project)
+    static_edges = lg.edge_set()
+    try:
+        baseline = load_lockorder_baseline(
+            os.path.join(engine.default_root(), LOCKORDER_BASELINE))
+    except engine.BaselineError as e:
+        baseline = {}
+        problems.append(f"lockorder baseline: {e}")
+
+    def _package_site(site) -> bool:
+        return site[0].startswith("predictionio_tpu/")
+
+    dyn = {k: v for k, v in locksan.edges(repo_only=True).items()
+           if _package_site(k[0]) and _package_site(k[1])}
+    matched = baselined = 0
+    used_baseline = set()
+    for (a, b), count in sorted(dyn.items()):
+        la = lg.site_label.get(a, f"{a[0]}:{a[1]}")
+        lb = lg.site_label.get(b, f"{b[0]}:{b[1]}")
+        key = f"{la} -> {lb}"
+        if (la, lb) in static_edges:
+            matched += 1
+        elif key in baseline:
+            baselined += 1
+            used_baseline.add(key)
+        else:
+            problems.append(
+                f"dynamic lock-order edge {key} (seen {count}x) is "
+                f"missing from the static lock graph — static "
+                f"resolution bug, or add a reviewed entry to "
+                f"{LOCKORDER_BASELINE}")
+    for cyc in locksan.cycles():
+        if all(_package_site(s) for s in cyc):
+            chain = " -> ".join(
+                lg.site_label.get(s, f"{s[0]}:{s[1]}") for s in cyc)
+            problems.append(
+                f"dynamic lock-order CYCLE observed: {chain} — this is "
+                f"a deadlock, not a baseline candidate")
+    sites, _edges_all, acquires = locksan.snapshot()
+    locksan.payload()           # refresh locksan_* gauges
+    locksan.uninstall()
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"analysis gate [locksan drill]: "
+          f"{'FAIL' if problems else 'OK'} "
+          f"({acquires} acquisitions over {len(sites)} lock site(s), "
+          f"{len(dyn)} package edge(s): {matched} static-matched, "
+          f"{baselined} baselined, {len(problems)} problem(s))")
     return 1 if problems else 0
+
+
+def run_gate() -> int:
+    # drill first: its imports must happen before anything else pulls
+    # the runtime modules in unwrapped
+    drill_rc = run_locksan_drill()
+    static_rc, _project = run_static()
+    return 1 if (drill_rc or static_rc) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,545 @@
+"""Rule pack (f): the whole-program lock-order graph.
+
+PR 12's ``race-lock-order`` saw ABBA inversions only when both
+acquisitions were textually in one file. With five async planes
+sharing locks across module boundaries (serving result cache →
+registry, online fold-in → cache invalidation → ingest bus) a deadlock
+is more likely to span three files than one. This pack builds the
+global graph and flags *cycles*, the general form of the inversion:
+
+- **Lock identities** are creation-site-qualified: every
+  ``threading.Lock()``/``RLock()``/``Condition()``/``Semaphore()``
+  assigned to ``self.<attr>`` or a module global becomes one node,
+  labelled ``<rel>:<Class>.<attr>`` or ``<rel>:<GLOBAL>``, anchored at
+  the ctor call's (file, line). That site is exactly what the runtime
+  sanitizer (`utils/locksan.py`) records, so static and dynamic graphs
+  join on it. Two *instances* of one class share a label — which is
+  why self-edges (label → itself) are not reported: ``a._lock`` held
+  while touching ``b._lock`` of a sibling instance is indistinguishable
+  from reentrancy at this granularity.
+- **Edges** come from lexically nested ``with`` blocks, ``.acquire()``
+  while held, and — via the project call graph — any function called
+  while a lock is held whose (bounded-depth) closure acquires another
+  lock, even three modules away.
+- **Cycles**: Tarjan SCCs over the label digraph; every non-trivial
+  SCC is one ``race-lock-order`` finding, with a representative cycle
+  path and the witness (file, line, holder) for each edge.
+
+Acquisitions the resolver can't tie to a known definition still get a
+node when their name looks lockish (``...lock``/``mutex``/``cond``),
+labelled with a ``?`` marker — a module-local inversion in fixture
+code stays visible even when the lock object came from outside the
+project.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from predictionio_tpu.analysis import astutil, callgraph
+from predictionio_tpu.analysis.engine import Finding, Project, rule
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_LOCKISH = ("lock", "mutex", "cond", "sem")
+# how deep a call-while-held chases the callee's acquisition closure
+_CLOSURE_DEPTH = 4
+
+
+def _lockish_name(name: Optional[str]) -> bool:
+    return bool(name) and any(t in name.lower() for t in _LOCKISH)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    label: str           # "<rel>:<Class>.<attr>" | "<rel>:<NAME>"
+    rel: str
+    line: int            # the Lock()/RLock() ctor call line
+    kind: str            # ctor name
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeWitness:
+    rel: str             # module where the ordered acquisition happens
+    line: int
+    holder: str          # qualname of the function holding the outer lock
+    detail: str          # "nested with" | "acquire while held" | chain
+
+
+class LockGraph:
+    """Whole-program lock nodes + ordered-acquisition edges."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.cg = callgraph.get(project)
+        self.defs: Dict[str, LockDef] = {}
+        # (rel, ctor line) → label: the join key with utils/locksan.py
+        self.site_label: Dict[Tuple[str, int], str] = {}
+        # class cid → {attr → label}; module rel → {global name → label}
+        self._class_locks: Dict[str, Dict[str, str]] = {}
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+        # fid → [(label, line)] direct acquisitions
+        self.fn_acquires: Dict[str, List[Tuple[str, int]]] = {}
+        self.edges: Dict[Tuple[str, str], EdgeWitness] = {}
+        self._collect_defs()
+        self._index_attr_names()
+        self._bind_injected_locks()
+        self._scan_functions()
+        self._close_over_calls()
+
+    # -- lock definitions ----------------------------------------------------
+
+    def _ctor_kind(self, call: ast.AST, rel: str) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "threading"):
+            return f.attr
+        if isinstance(f, ast.Name) and f.id in _LOCK_CTORS:
+            target = self.cg.imports.get(rel, {}).get(f.id)
+            if target == ("symbol", "threading", f.id):
+                return f.id
+        return None
+
+    def _collect_defs(self) -> None:
+        for mod in self.project.modules():
+            if mod.tree is None:
+                continue
+            self._module_locks.setdefault(mod.rel, {})
+            # module globals: walk top-level statements only (if-blocks
+            # included), never descending into defs/classes
+            stack: List[ast.AST] = list(mod.tree.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    kind = self._ctor_kind(node.value, mod.rel)
+                    if kind:
+                        name = node.targets[0].id
+                        self._add_def(f"{mod.rel}:{name}", mod.rel,
+                                      node.value.lineno, kind)
+                        self._module_locks[mod.rel][name] = \
+                            f"{mod.rel}:{name}"
+                stack.extend(ast.iter_child_nodes(node))
+            # instance/class attributes
+            for cs in self.cg.module_classes(mod.rel).values():
+                attrs = self._class_locks.setdefault(cs.cid, {})
+                for node in ast.walk(cs.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    kind = self._ctor_kind(node.value, mod.rel)
+                    if not kind:
+                        continue
+                    tgt = node.targets[0]
+                    attr = None
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        attr = tgt.attr
+                    elif isinstance(tgt, ast.Name):
+                        attr = tgt.id          # class-level shared lock
+                    if attr:
+                        label = f"{mod.rel}:{cs.name}.{attr}"
+                        self._add_def(label, mod.rel, node.value.lineno,
+                                      kind)
+                        attrs[attr] = label
+
+    def _add_def(self, label: str, rel: str, line: int, kind: str) -> None:
+        self.defs.setdefault(label, LockDef(label, rel, line, kind))
+        self.site_label[(rel, line)] = label
+
+    def _index_attr_names(self) -> None:
+        """attr name → labels, across every class lock in the project.
+        A lock attribute whose name is unique project-wide can be
+        resolved on an object we can't type (``server._state_lock``) —
+        the instance-aliasing approximation."""
+        self._by_attr: Dict[str, List[str]] = {}
+        for attrs in self._class_locks.values():
+            for attr, label in attrs.items():
+                self._by_attr.setdefault(attr, []).append(label)
+
+    def _unique_attr(self, attr: str) -> Optional[str]:
+        labels = self._by_attr.get(attr, ())
+        return labels[0] if len(labels) == 1 else None
+
+    def _bind_injected_locks(self) -> None:
+        """Constructor-injected locks: ``self._lock = lock`` in
+        ``__init__`` binds a ctor *parameter*; at every resolved ctor
+        call site, resolving the matching argument in the caller's
+        context gives the injected lock's real label. Iterated a few
+        times so a lock injected through two constructors still lands.
+        First resolved call site wins — instances already share labels
+        at this granularity."""
+        # (cid, attr) → (param name, positional index excluding self)
+        injected: List[Tuple[str, str, str, int]] = []
+        init_fids: Dict[str, str] = {}   # __init__ fid → cid
+        for cid, cs in self.cg.classes.items():
+            init = cs.methods.get("__init__")
+            if init is None:
+                continue
+            init_fids[init.fid] = cid
+            params = [a.arg for a in init.node.args.args]
+            for node in ast.walk(init.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in params):
+                    continue
+                attr = node.targets[0].attr
+                if not _lockish_name(attr) and not _lockish_name(
+                        node.value.id):
+                    continue
+                idx = params.index(node.value.id) - 1   # drop self
+                injected.append((cid, attr, node.value.id, idx))
+        if not injected:
+            return
+        # ctor call sites, from the call graph
+        calls: Dict[str, List[Tuple[callgraph.FuncSym, ast.Call]]] = {}
+        for fid, sites in self.cg.edges.items():
+            caller = self.cg.funcs[fid]
+            for site in sites:
+                cid = init_fids.get(site.callee)
+                if cid is not None and site.call is not None:
+                    calls.setdefault(cid, []).append((caller, site.call))
+        for _ in range(3):
+            changed = False
+            for cid, attr, pname, idx in injected:
+                if attr in self._class_locks.get(cid, {}):
+                    continue
+                for caller, call in calls.get(cid, ()):
+                    arg: Optional[ast.AST] = None
+                    for kw in call.keywords:
+                        if kw.arg == pname:
+                            arg = kw.value
+                    if arg is None and 0 <= idx < len(call.args):
+                        arg = call.args[idx]
+                    if arg is None:
+                        continue
+                    label = self.resolve_lock(arg, caller)
+                    if label and not label.split(":", 1)[-1].startswith(
+                            "?"):
+                        self._class_locks.setdefault(cid, {})[attr] = label
+                        changed = True
+                        break
+            if not changed:
+                break
+
+    # -- acquisition resolution ----------------------------------------------
+
+    def _class_lock(self, cid: str, attr: str,
+                    _depth: int = 4) -> Optional[str]:
+        label = self._class_locks.get(cid, {}).get(attr)
+        if label is not None or _depth <= 0:
+            return label
+        cs = self.cg.classes.get(cid)
+        if cs is None:
+            return None
+        for base_expr in cs.bases:
+            base = self.cg._class_of_expr(base_expr, cs.rel)
+            if base is not None and base.cid != cid:
+                label = self._class_lock(base.cid, attr, _depth - 1)
+                if label is not None:
+                    return label
+        return None
+
+    def resolve_lock(self, expr: ast.AST,
+                     fs: callgraph.FuncSym) -> Optional[str]:
+        rel = fs.rel
+        # self.X
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and fs.cls is not None):
+            cls = self.cg.module_classes(rel).get(fs.cls)
+            if cls is not None:
+                label = self._class_lock(cls.cid, expr.attr)
+                if label:
+                    return label
+            label = self._unique_attr(expr.attr)
+            if label:
+                return label
+            if _lockish_name(expr.attr):
+                return f"{rel}:?{fs.cls}.{expr.attr}"
+            return None
+        # self.field.X — lock owned by a self-typed component
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Attribute)
+                and isinstance(expr.value.value, ast.Name)
+                and expr.value.value.id == "self" and fs.cls is not None):
+            cls = self.cg.module_classes(rel).get(fs.cls)
+            if cls is not None:
+                field_cls = self.cg.class_of_attr(cls, expr.value.attr)
+                if field_cls is not None:
+                    return self._class_lock(field_cls.cid, expr.attr)
+            return None
+        # bare global / imported lock
+        if isinstance(expr, ast.Name):
+            label = self._module_locks.get(rel, {}).get(expr.id)
+            if label:
+                return label
+            target = self.cg.imports.get(rel, {}).get(expr.id)
+            if target is not None and target[0] == "symbol":
+                src_rel = self.cg.module_rel.get(target[1])
+                if src_rel is not None:
+                    label = self._module_locks.get(src_rel,
+                                                   {}).get(target[2])
+                    if label:
+                        return label
+            if _lockish_name(expr.id):
+                return f"{rel}:?{expr.id}"
+            return None
+        # mod.NAME
+        if isinstance(expr, ast.Attribute):
+            src_rel = self.cg._module_of_expr(expr.value, rel)
+            if src_rel is not None:
+                label = self._module_locks.get(src_rel, {}).get(expr.attr)
+                if label:
+                    return label
+            # untypeable owner, but the attr names exactly one lock
+            # project-wide (``server._state_lock``)
+            return self._unique_attr(expr.attr)
+        return None
+
+    # -- per-function scan ---------------------------------------------------
+
+    def _scan_functions(self) -> None:
+        # fid → {call line → held labels} for the cross-function pass
+        self._held_calls: Dict[str, Dict[int, Tuple[str, ...]]] = {}
+        for fs in self.cg.funcs.values():
+            acquires: List[Tuple[str, int]] = []
+            held_calls: Dict[int, Tuple[str, ...]] = {}
+
+            def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda, ast.ClassDef)):
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired: List[str] = []
+                    for item in node.items:
+                        label = self.resolve_lock(item.context_expr, fs)
+                        if label:
+                            acquired.append(label)
+                            acquires.append((label, node.lineno))
+                            for outer in held:
+                                self._edge(outer, label, fs, node.lineno,
+                                           "nested with")
+                    inner = held + tuple(acquired)
+                    for stmt in node.body:
+                        walk(stmt, inner)
+                    return
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr == "acquire"):
+                        label = self.resolve_lock(f.value, fs)
+                        if label:
+                            acquires.append((label, node.lineno))
+                            for outer in held:
+                                self._edge(outer, label, fs, node.lineno,
+                                           "acquire while held")
+                    elif held:
+                        held_calls[node.lineno] = held
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+
+            for stmt in getattr(fs.node, "body", []):
+                walk(stmt, ())
+            if acquires:
+                self.fn_acquires[fs.fid] = acquires
+            if held_calls:
+                self._held_calls[fs.fid] = held_calls
+
+    def _edge(self, outer: str, inner: str, fs: callgraph.FuncSym,
+              line: int, detail: str) -> None:
+        if outer == inner:
+            return
+        self.edges.setdefault(
+            (outer, inner), EdgeWitness(fs.rel, line, fs.qualname, detail))
+
+    # -- interprocedural closure ---------------------------------------------
+
+    def _close_over_calls(self) -> None:
+        for fid, by_line in self._held_calls.items():
+            fs = self.cg.funcs[fid]
+            for site in self.cg.edges.get(fid, ()):
+                held = by_line.get(site.line)
+                if not held:
+                    continue
+                callee = self.cg.funcs[site.callee]
+                for sub, chain in self.cg.reachable(site.callee,
+                                                    _CLOSURE_DEPTH):
+                    for label, _al in self.fn_acquires.get(sub.fid, ()):
+                        for outer in held:
+                            self._edge(
+                                outer, label, fs, site.line,
+                                f"calls {callee.qualname}() while held"
+                                + (f" (reaching {sub.qualname})"
+                                   if sub.fid != callee.fid else ""))
+
+    # -- cycles --------------------------------------------------------------
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return set(self.edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Non-trivial SCCs, each rendered as one representative cycle
+        path [a, b, ..., a], deterministic."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for v in adj.values():
+            v.sort()
+        sccs = _tarjan(adj)
+        out: List[List[str]] = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            start = min(comp)
+            path = _cycle_path(start, adj, comp_set)
+            if path:
+                out.append(path)
+        out.sort()
+        return out
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative to stay safe on big graphs
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(pi, len(adj[node])):
+                w = adj[node][i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(comp))
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _cycle_path(start: str, adj: Dict[str, List[str]],
+                comp: Set[str]) -> Optional[List[str]]:
+    """A simple cycle from `start` back to itself inside one SCC."""
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxt = None
+        for w in adj.get(node, ()):
+            if w == start and len(path) > 1:
+                return path + [start]
+            if w in comp and w not in seen:
+                nxt = w
+                break
+        if nxt is None:
+            # backtrack-free greedy failed; do a DFS instead
+            return _cycle_dfs(start, adj, comp)
+        seen.add(nxt)
+        path.append(nxt)
+        node = nxt
+
+
+def _cycle_dfs(start: str, adj: Dict[str, List[str]],
+               comp: Set[str]) -> Optional[List[str]]:
+    stack: List[Tuple[str, List[str]]] = [(start, [start])]
+    while stack:
+        node, path = stack.pop()
+        for w in adj.get(node, ()):
+            if w == start and len(path) > 1:
+                return path + [start]
+            if w in comp and w not in path:
+                stack.append((w, path + [w]))
+    return None
+
+
+def get(project: Project) -> LockGraph:
+    graph = project.__dict__.get("_lockgraph")
+    if graph is None:
+        graph = LockGraph(project)
+        project.__dict__["_lockgraph"] = graph
+    return graph
+
+
+def _short(label: str) -> str:
+    return label.split(":", 1)[1] if ":" in label else label
+
+
+@rule("race-lock-order",
+      "lock acquisition order must be globally consistent — no cycle "
+      "in the whole-program lock graph (deadlock)")
+def race_lock_order(project: Project) -> Iterable[Finding]:
+    lg = get(project)
+    for path in lg.cycles():
+        legs = []
+        max_line, first_rel = 0, None
+        for a, b in zip(path, path[1:]):
+            w = lg.edges.get((a, b))
+            if w is None:
+                continue
+            legs.append(f"{_short(a)} → {_short(b)} in {w.holder}() "
+                        f"({w.rel}:{w.line}, {w.detail})")
+            max_line = max(max_line, w.line)
+            if first_rel is None:
+                first_rel = w.rel
+        if first_rel is None:
+            continue
+        # anchor at the witness in the first edge's module, at the
+        # latest line involved there so suppressions stay targetable
+        anchor = max((w.line for (a, b) in zip(path, path[1:])
+                      if (w := lg.edges.get((a, b))) is not None
+                      and w.rel == first_rel), default=max_line)
+        yield Finding(
+            "race-lock-order", first_rel, anchor,
+            "lock order cycle (potential deadlock): "
+            + "; ".join(legs)
+            + " — threads taking these orders concurrently deadlock",
+            symbol="/".join(sorted(_short(l) for l in path[:-1])),
+            hint="pick one global acquisition order and hold it "
+                 "everywhere, or collapse the locks")

@@ -50,6 +50,7 @@ _PROFILE_ROUTE = "/debug/profile.json"
 _PROFILE_DEVICE_ROUTE = "/debug/profile/device.json"
 _LINEAGE_LIST_ROUTE = "/debug/lineage.json"
 _LINEAGE_ONE_ROUTE = "/debug/lineage/<trace_id>.json"
+_LOCKS_ROUTE = "/debug/locks.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
@@ -72,6 +73,7 @@ HTTP_ERRORS = REGISTRY.counter(
 _EXACT_ROUTES = frozenset({
     "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
     _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE, _LINEAGE_LIST_ROUTE,
+    _LOCKS_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -420,6 +422,22 @@ def serve_profile_device(handler) -> None:
     _serve_json(handler, obj, status=status)
 
 
+def _locks_payload() -> tuple:
+    """GET /debug/locks.json — the lock sanitizer's dynamic order graph."""
+    from predictionio_tpu.utils import locksan
+
+    if not locksan.enabled():
+        return error_payload(
+            503, "lock sanitizer disabled (set PIO_LOCKSAN=1 at process "
+                 "start to record lock-order edges)")
+    return 200, locksan.payload()
+
+
+def serve_debug_locks(handler) -> None:
+    status, obj = _locks_payload()
+    _serve_json(handler, obj, status=status)
+
+
 def _run_instrumented(self, http_method: str, orig) -> None:
     server = self.pio_server_name
     path = urlparse(self.path).path
@@ -453,6 +471,8 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_profile_device(self)
         elif http_method == "GET" and path == _LINEAGE_LIST_ROUTE:
             serve_debug_lineage(self, self.path)
+        elif http_method == "GET" and path == _LOCKS_ROUTE:
+            serve_debug_locks(self)
         elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
             serve_debug_request_by_id(self, path)
         elif http_method == "GET" and route == _LINEAGE_ONE_ROUTE:
@@ -756,6 +776,13 @@ def _profile_device_route(req):
     return routing.Response.json(status, obj)
 
 
+def _locks_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _locks_payload()
+    return routing.Response.json(status, obj)
+
+
 def register_builtin_routes(router) -> None:
     """Every routed service exposes /metrics, the flight-recorder debug
     routes, the metrics-history dump, and the profiler, same as
@@ -770,6 +797,7 @@ def register_builtin_routes(router) -> None:
     router.get(_PROFILE_ROUTE, _profile_route, blocking=True)
     router.get(_PROFILE_DEVICE_ROUTE, _profile_device_route)
     router.get(_LINEAGE_LIST_ROUTE, _lineage_list_route)
+    router.get(_LOCKS_ROUTE, _locks_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
                       template=_DEBUG_ONE_ROUTE)
     router.add_prefix("GET", "/debug/lineage/", ".json", _lineage_one_route,

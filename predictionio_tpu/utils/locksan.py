@@ -1,0 +1,352 @@
+"""Runtime lock-order sanitizer (``PIO_LOCKSAN=1``).
+
+The static lock graph (`analysis/lockgraph.py`) claims the whole
+program has one consistent lock acquisition order. This module keeps
+that claim honest at runtime: when installed, ``threading.Lock()`` and
+``threading.RLock()`` return instrumented wrappers that record, per
+thread, which lock was *held* when another was *acquired* — a
+process-global ordered-acquisition graph, dumpable at
+``/debug/locks.json`` and cross-checked by the analysis gate:
+
+- a **dynamic cycle** is an observed deadlock-shaped order — always a
+  bug;
+- a **dynamic edge missing from the static graph** (and not reviewed
+  in ``conf/lockorder-baseline.json``) is a static-resolution bug —
+  the analyzer failed to see a call path the process just took.
+
+Lock identity is the **creation site** ``(file, line)`` of the
+``Lock()``/``RLock()`` call, relative to the repo root — exactly the
+anchor the static graph attaches to each lock definition, so the two
+graphs join on it. All instances born at one site share an identity
+(same granularity as the static model), which is why site-level
+self-edges are not recorded: sibling-instance nesting is
+indistinguishable from reentrancy here.
+
+Scope: only locks *created after* :func:`install` through the
+``threading.Lock``/``threading.RLock`` module attributes are wrapped.
+``from threading import Lock`` aliases bound earlier, and stdlib
+internals that call ``_thread.allocate_lock`` directly, stay raw —
+repo code consistently spells ``threading.Lock()``, which is the
+surface we audit. Overhead is one dict update per cold acquisition;
+production stays unpatched (``PIO_LOCKSAN`` unset ⇒ import is free).
+
+``threading.Condition`` works with wrapped locks: the wrapper exposes
+``_release_save``/``_acquire_restore``/``_is_owned`` so ``wait()``
+keeps the held-stack bookkeeping balanced while it parks.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+Site = Tuple[str, int]      # (repo-relative file, creation line)
+
+_HERE = os.path.abspath(__file__)
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
+
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+
+_installed = False
+_tls = threading.local()
+# bookkeeping mutex is always a RAW lock — the sanitizer never records
+# itself
+_mutex = _orig_lock()
+_sites: Dict[Site, Dict[str, object]] = {}
+_edges: Dict[Tuple[Site, Site], int] = {}
+_acquires_total = 0
+
+
+_THREADING_FILE = os.path.abspath(threading.__file__)
+
+
+def _creation_site() -> Tuple[Site, bool]:
+    """(site, in_repo) for the frame that called threading.Lock().
+    Frames inside threading.py itself are skipped so the RLock a
+    ``threading.Condition()`` creates internally is attributed to the
+    Condition call in repo code — the site the static graph knows."""
+    depth = 2
+    while True:
+        try:
+            frame = sys._getframe(depth)
+        except ValueError:
+            return (("<unknown>", 0), False)
+        fname = os.path.abspath(frame.f_code.co_filename)
+        if fname != _HERE and fname != _THREADING_FILE:
+            break
+        depth += 1
+    rel = os.path.relpath(fname, _ROOT).replace(os.sep, "/")
+    if rel.startswith(".."):
+        return ((fname, frame.f_lineno), False)
+    return ((rel, frame.f_lineno), True)
+
+
+def _held_stack() -> List["_SanLock"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _record_acquire(obj: "_SanLock") -> None:
+    if getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        held = _held_stack()
+        reentrant = any(h is obj for h in held)
+        if not reentrant:
+            global _acquires_total
+            with _mutex:
+                _acquires_total += 1
+                info = _sites.get(obj.site)
+                if info is not None:
+                    info["acquires"] = int(info["acquires"]) + 1  # type: ignore[arg-type]
+                outer_sites = []
+                for h in held:
+                    if h.site != obj.site and h.site not in outer_sites:
+                        outer_sites.append(h.site)
+                for s in outer_sites:
+                    key = (s, obj.site)
+                    _edges[key] = _edges.get(key, 0) + 1
+        held.append(obj)
+    finally:
+        _tls.busy = False
+
+
+def _record_release(obj: "_SanLock") -> None:
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is obj:
+            del held[i]
+            return
+
+
+class _SanLock:
+    """Instrumented Lock/RLock: inner primitive + order bookkeeping."""
+
+    def __init__(self, inner, site: Site, kind: str, in_repo: bool):
+        self._inner = inner
+        self.site = site
+        self.kind = kind
+        self.in_repo = in_repo
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _record_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # Condition protocol — keep the held stack balanced across wait()
+    def _release_save(self):
+        saver = getattr(self._inner, "_release_save", None)
+        n = 0
+        held = getattr(_tls, "held", [])
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                n += 1
+        state = saver() if saver is not None else self._inner.release()
+        return (state, n)
+
+    def _acquire_restore(self, saved) -> None:
+        state, n = saved
+        restorer = getattr(self._inner, "_acquire_restore", None)
+        if restorer is not None:
+            restorer(state)
+        else:
+            self._inner.acquire()
+        _record_acquire(self)
+        held = _held_stack()
+        for _ in range(max(0, n - 1)):
+            held.append(self)
+
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        # plain Lock fallback, mirroring threading.Condition's own
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return (f"<locksan.{self.kind} site={self.site[0]}:{self.site[1]} "
+                f"{self._inner!r}>")
+
+
+def _register_site(site: Site, kind: str, in_repo: bool) -> None:
+    with _mutex:
+        if site not in _sites:
+            _sites[site] = {"file": site[0], "line": site[1],
+                            "kind": kind, "in_repo": in_repo,
+                            "acquires": 0}
+
+
+def _make_lock():
+    site, in_repo = _creation_site()
+    _register_site(site, "Lock", in_repo)
+    return _SanLock(_orig_lock(), site, "Lock", in_repo)
+
+
+def _make_rlock():
+    site, in_repo = _creation_site()
+    _register_site(site, "RLock", in_repo)
+    return _SanLock(_orig_rlock(), site, "RLock", in_repo)
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``threading.RLock``. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock          # type: ignore[misc,assignment]
+    threading.RLock = _make_rlock        # type: ignore[misc,assignment]
+    if hasattr(os, "register_at_fork"):
+        os.register_at_fork(after_in_child=reset)
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the raw primitives (already-wrapped locks keep working)."""
+    global _installed
+    threading.Lock = _orig_lock          # type: ignore[misc]
+    threading.RLock = _orig_rlock        # type: ignore[misc]
+    _installed = False
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def maybe_install() -> bool:
+    """Install iff ``PIO_LOCKSAN`` is set to a truthy value."""
+    if os.environ.get("PIO_LOCKSAN", "").lower() in ("1", "true", "yes"):
+        install()
+    return _installed
+
+
+def reset() -> None:
+    """Drop recorded edges/counters (sites persist — the locks still
+    exist). Used by tests and the post-fork child."""
+    global _acquires_total
+    with _mutex:
+        _edges.clear()
+        _acquires_total = 0
+        for info in _sites.values():
+            info["acquires"] = 0
+
+
+def snapshot() -> Tuple[Dict[Site, Dict[str, object]],
+                        Dict[Tuple[Site, Site], int], int]:
+    with _mutex:
+        return (dict(_sites), dict(_edges), _acquires_total)
+
+
+def edges(repo_only: bool = True) -> Dict[Tuple[Site, Site], int]:
+    """Observed ordered-acquisition edges; by default only those whose
+    endpoints are both repo creation sites (what the static graph can
+    ever know about)."""
+    sites, es, _ = snapshot()
+    if not repo_only:
+        return es
+    return {k: v for k, v in es.items()
+            if bool(sites.get(k[0], {}).get("in_repo"))
+            and bool(sites.get(k[1], {}).get("in_repo"))}
+
+
+def cycles(repo_only: bool = True) -> List[List[Site]]:
+    """Simple cycles in the observed order graph (DFS, deterministic)."""
+    es = edges(repo_only)
+    adj: Dict[Site, List[Site]] = {}
+    for a, b in es:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    for v in adj.values():
+        v.sort()
+    out: List[List[Site]] = []
+    seen_cycles = set()
+    for start in sorted(adj):
+        stack: List[Tuple[Site, List[Site]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(path + [start])
+                elif nxt in adj and nxt not in path and nxt > start:
+                    stack.append((nxt, path + [nxt]))
+    out.sort()
+    return out
+
+
+def _sync_metrics() -> None:
+    # imported lazily so a bare `import locksan` stays dependency-free
+    from predictionio_tpu.telemetry.registry import REGISTRY
+    sites, es, total = snapshot()
+    c = REGISTRY.counter(
+        "locksan_acquires_total",
+        "cold lock acquisitions observed by the lock sanitizer")
+    # counters only move forward; publish the delta since last sync
+    prev = getattr(_sync_metrics, "_published", 0)
+    if total > prev:
+        c.inc(total - prev)
+        _sync_metrics._published = total  # type: ignore[attr-defined]
+    REGISTRY.gauge(
+        "locksan_lock_sites",
+        "distinct lock creation sites seen by the sanitizer").set(
+        float(len(sites)))
+    REGISTRY.gauge(
+        "locksan_order_edges",
+        "distinct dynamic lock-order edges recorded").set(float(len(es)))
+    REGISTRY.gauge(
+        "locksan_cycles_detected",
+        "cycles currently present in the dynamic lock-order graph").set(
+        float(len(cycles(repo_only=False))))
+
+
+def _fmt_site(site: Site) -> str:
+    return f"{site[0]}:{site[1]}"
+
+
+def payload() -> Dict[str, object]:
+    """The ``/debug/locks.json`` body (also refreshes locksan_* gauges)."""
+    sites, es, total = snapshot()
+    try:
+        _sync_metrics()
+    except Exception:
+        pass
+    return {
+        "enabled": _installed,
+        "acquires_total": total,
+        "sites": [dict(info, site=_fmt_site(s))
+                  for s, info in sorted(sites.items())],
+        "edges": [{"from": _fmt_site(a), "to": _fmt_site(b), "count": n}
+                  for (a, b), n in sorted(es.items())],
+        "cycles": [[_fmt_site(s) for s in cyc] for cyc in cycles()],
+    }

@@ -71,14 +71,24 @@ def main():
                         "converges ≥80% of traffic onto the better arm, "
                         "and the experiment_* telemetry renders")
     p.add_argument("--analysis-gate", action="store_true",
-                   help="run the static-analysis CI gate (no jax, no "
-                        "imports of the scanned code): the pio-lint "
-                        "engine's full rule set — concurrency race "
-                        "detector, event-loop blocking-call rule, jit "
-                        "shape discipline, coverage rules, and the "
-                        "migrated serving/ingest/hotpath static gates — "
-                        "fails on any finding not inline-suppressed or "
-                        "grandfathered in conf/analysis-baseline.json")
+                   help="run the concurrency-analysis CI gate, two "
+                        "halves: (1) a lock-sanitizer drill — "
+                        "cross-plane concurrent workload under "
+                        "instrumented locks (PIO_LOCKSAN machinery) "
+                        "asserting no dynamic lock-order cycle and that "
+                        "every observed edge matches the static lock "
+                        "graph or a reviewed conf/lockorder-baseline.json "
+                        "entry; (2) the pio-lint engine's full rule set "
+                        "(no imports of the scanned code) — "
+                        "interprocedural event-loop blocking-call rule, "
+                        "whole-program lock-order deadlock detection, "
+                        "race detector, jit shape discipline, coverage "
+                        "rules, and the migrated serving/ingest/hotpath "
+                        "static gates — failing on any finding not "
+                        "inline-suppressed or grandfathered in "
+                        "conf/analysis-baseline.json, with the "
+                        "pio-lint --json artifact written to "
+                        "$PIO_LINT_ARTIFACT for CI diffing")
     p.add_argument("--online-gate", action="store_true",
                    help="run the online-learning CI gate (jax on the local "
                         "backend, in-memory data): trains a small engine, "

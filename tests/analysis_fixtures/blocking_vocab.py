@@ -1,0 +1,44 @@
+"""Fixture: the blocking-call vocabulary added in PR 14 —
+shutil.rmtree, os.replace, cursor.fetchmany, socket.create_connection,
+sock.connect — each flagged on a non-blocking route, and each legal on
+the blocking=True route (worker pool)."""
+
+import os
+import shutil
+import socket
+
+
+class VocabAPI:
+    def router(self, r):
+        r.get("/rm.json", self._handle_rm)
+        r.get("/swap.json", self._handle_swap)
+        r.get("/rows.json", self._handle_rows)
+        r.get("/dial.json", self._handle_dial)
+        r.post("/bulk.json", self._handle_bulk, blocking=True)
+        return r
+
+    def _handle_rm(self, req):
+        shutil.rmtree("/tmp/fixture-cache")
+        return req
+
+    def _handle_swap(self, req):
+        os.replace("/tmp/a", "/tmp/b")
+        return req
+
+    def _handle_rows(self, req, cursor=None):
+        return cursor.fetchmany(64)
+
+    def _handle_dial(self, req):
+        conn = socket.create_connection(("localhost", 9))
+        raw = socket.socket()
+        raw.connect(("localhost", 9))
+        return conn
+
+    def _handle_bulk(self, req, cursor=None):
+        # legal: registered blocking=True, so this runs on the pool
+        shutil.rmtree("/tmp/fixture-cache")
+        os.replace("/tmp/a", "/tmp/b")
+        cursor.fetchmany(64)
+        conn = socket.create_connection(("localhost", 9))
+        conn.connect(("localhost", 9))
+        return req

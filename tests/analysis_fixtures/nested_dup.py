@@ -1,0 +1,21 @@
+"""Fixture: two same-named nested loop drivers. Their findings must
+carry distinct qualname-anchored keys — with name-anchored symbols the
+keys collided, so one baseline entry silently covered both."""
+
+import time
+
+
+def spawn_fast(selector):
+    def run():
+        while True:
+            selector.select(0.01)
+            time.sleep(0.001)
+    return run
+
+
+def spawn_slow(selector):
+    def run():
+        while True:
+            selector.select(0.5)
+            time.sleep(0.1)
+    return run

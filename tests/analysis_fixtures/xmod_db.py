@@ -1,0 +1,10 @@
+"""Leaf of the cross-module blocking fixture: the sqlite calls the
+route two modules up must be blamed for."""
+
+import sqlite3
+
+
+def fetch_rows(table):
+    conn = sqlite3.connect(":memory:")
+    cur = conn.execute("select * from t")
+    return cur.fetchall()

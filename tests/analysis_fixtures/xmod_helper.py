@@ -1,0 +1,9 @@
+"""Middle hop of the cross-module blocking fixture — no blocking call
+of its own, just the bridge from the route module to the db module."""
+
+from xmod_db import fetch_rows
+
+
+def load_report(table):
+    rows = fetch_rows(table)
+    return {"rows": rows}

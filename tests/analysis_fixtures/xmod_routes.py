@@ -1,0 +1,15 @@
+"""Fixture: a route whose blocking calls live two modules away —
+routes → helper → db. This module itself contains nothing blocking, so
+the PR 12 same-module closure rule provably missed it; the
+whole-program rule must flag the db module with the witness chain."""
+
+import xmod_helper
+
+
+class XModAPI:
+    def router(self, r):
+        r.get("/report.json", self._handle_report)
+        return r
+
+    def _handle_report(self, req):
+        return xmod_helper.load_report("users")

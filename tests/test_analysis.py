@@ -12,6 +12,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 import pytest
 
@@ -57,6 +58,51 @@ class TestEngine:
     def test_unknown_rule_is_an_error(self):
         with pytest.raises(KeyError):
             engine.run_rules(Project(FIXTURES), ["no-such-rule"])
+
+    def test_suppression_text_inside_fstring_is_not_a_suppression(self):
+        # suppressions come from the token stream, so a string that
+        # merely *contains* the magic text must not disable anything
+        src = ('msg = f"use  # pio-lint: disable=rule-a  inline"\n'
+               "y = 2\n")
+        m = Module("f.py", "f.py", src)
+        assert not m.suppressed("rule-a", 1)
+        assert not m.suppressed("rule-a", 2)
+
+    def test_suppression_on_line_continuation(self):
+        src = ("x = 1 + \\\n"
+               "    2  # pio-lint: disable=rule-a\n"
+               "# pio-lint: disable=rule-b\n"
+               "y = (3 +\n"
+               "     4)\n")
+        m = Module("f.py", "f.py", src)
+        # trailing comment binds to the physical line it sits on
+        assert m.suppressed("rule-a", 2)
+        assert not m.suppressed("rule-a", 1)
+        # standalone comment covers the next line even when that
+        # statement continues past it
+        assert m.suppressed("rule-b", 4)
+        assert not m.suppressed("rule-b", 5)
+
+    def test_syntax_error_module_skipped_not_fatal(self, tmp_path):
+        (tmp_path / "bad.py").write_text("def broken(:\n")
+        (tmp_path / "good.py").write_text(
+            "import time\n"
+            "class API:\n"
+            "    def router(self, r):\n"
+            "        r.get('/x.json', self._handle)\n"
+            "        return r\n"
+            "    def _handle(self, req):\n"
+            "        time.sleep(1)\n"
+            "        return req\n")
+        proj = Project(str(tmp_path))
+        # the scan survives and still flags the parsable module
+        findings = engine.run_rules(proj, ["loop-blocking-call"])
+        assert any(f.file == "good.py" for f in findings)
+        # the call graph excludes the broken module instead of dying
+        from predictionio_tpu.analysis import callgraph
+        cg = callgraph.get(proj)
+        assert all(fs.rel != "bad.py" for fs in cg.funcs.values())
+        assert any(fs.rel == "good.py" for fs in cg.funcs.values())
 
     def test_baseline_entry_requires_reason(self, tmp_path):
         p = tmp_path / "baseline.json"
@@ -110,8 +156,52 @@ class TestLoopBlockingRule:
         whats = " ".join(f.message for f in hits)
         assert ".execute()" in whats and "time.sleep" in whats
         # the blocking=True route's sleep is legal: all findings anchor
-        # to the non-blocking route
-        assert {f.symbol for f in hits} == {"GET /fast.json"}
+        # to the non-blocking route, with the containing qualname
+        assert {f.symbol for f in hits} == {
+            "GET /fast.json:FixtureAPI._handle_fast",
+            "GET /fast.json:FixtureAPI._settle",
+        }
+        # the helper reached through the handler prints its chain
+        settle = [f for f in hits if f.symbol.endswith("._settle")]
+        assert settle and "via FixtureAPI._handle_fast" in settle[0].message
+
+    def test_new_vocabulary_flagged_only_off_the_pool(self):
+        findings = engine.run_rules(Project(FIXTURES),
+                                    ["loop-blocking-call"])
+        hits = [f for f in findings if f.file == "blocking_vocab.py"]
+        whats = " ".join(f.message for f in hits)
+        for what in ("shutil.rmtree", "os.replace", ".fetchmany()",
+                     "socket.create_connection", ".connect()"):
+            assert what in whats, what
+        # the blocking=True bulk route makes the same calls legally
+        assert not [f for f in hits if "/bulk.json" in f.symbol]
+
+    def test_cross_module_chain_flagged(self):
+        # the route module itself has nothing blocking — the PR 12
+        # same-module rule had nothing to anchor to...
+        from predictionio_tpu.analysis.eventloop import _blocking_calls
+        proj = Project(FIXTURES)
+        assert not _blocking_calls(proj.module("xmod_routes.py").tree)
+        assert not _blocking_calls(proj.module("xmod_helper.py").tree)
+        # ...but the whole-program rule blames the db module on the
+        # route, witness chain included
+        findings = engine.run_rules(proj, ["loop-blocking-call"])
+        hits = [f for f in findings if f.file == "xmod_db.py"]
+        assert hits and all(
+            f.symbol == "GET /report.json:fetch_rows" for f in hits)
+        assert "via XModAPI._handle_report" in hits[0].message
+        assert "load_report" in hits[0].message
+
+    def test_same_named_nested_functions_get_distinct_keys(self):
+        findings = engine.run_rules(Project(FIXTURES),
+                                    ["loop-blocking-call"])
+        hits = [f for f in findings if f.file == "nested_dup.py"]
+        keys = {f.key for f in hits}
+        assert len(keys) == len(hits) == 2, hits
+        assert {f.symbol for f in hits} == {
+            "<loop>:spawn_fast.<locals>.run",
+            "<loop>:spawn_slow.<locals>.run",
+        }
 
     def test_live_stats_route_is_blocking(self):
         # regression for the finding that started this: GET /stats.json
@@ -161,13 +251,18 @@ class TestGateRules:
 
 
 class TestSelfScan:
-    def test_live_tree_scans_clean_modulo_baseline(self):
+    def test_live_tree_scans_clean_modulo_baseline_within_budget(self):
+        t0 = time.perf_counter()
         proj = Project(REPO_ROOT, subdirs=engine.DEFAULT_SUBDIRS)
         findings = engine.run_rules(proj)
+        elapsed = time.perf_counter() - t0
         baseline = engine.load_baseline(
             os.path.join(REPO_ROOT, engine.DEFAULT_BASELINE))
         new, _old, _stale = engine.partition(findings, baseline)
         assert not new, "\n".join(f.render() for f in new)
+        # the whole-package scan (call graph + lock graph included)
+        # must stay inside the pre-push budget
+        assert elapsed <= 10.0, f"package scan took {elapsed:.1f}s"
 
     def test_cli_json_exit_zero(self, capsys):
         rc = lint_main(["--root", REPO_ROOT, "--json"])
@@ -186,6 +281,21 @@ class TestSelfScan:
                     "race-lock-order", "race-global-rmw"):
             assert rid in listed
         assert lint_main(["--rules", "bogus"]) == 2
+
+    def test_cli_changed_filters_reporting(self, capsys):
+        # against HEAD the filter is the worktree delta — a clean tree
+        # reports zero either way, and the payload carries the filter
+        rc = lint_main(["--root", REPO_ROOT, "--changed", "HEAD",
+                        "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert isinstance(payload["changed_filter"], list)
+        assert all(f["file"] in payload["changed_filter"]
+                   for f in payload["findings"])
+        # an unknown ref is a usage error, not a crash
+        assert lint_main(["--root", REPO_ROOT,
+                          "--changed", "no-such-ref-xyz"]) == 2
+        capsys.readouterr()
 
 
 # -- concurrency-fix regressions --------------------------------------------

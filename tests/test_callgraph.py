@@ -1,0 +1,199 @@
+"""The project call graph (analysis/callgraph.py) and the whole-program
+lock graph built on it (analysis/lockgraph.py): symbol/alias/method
+resolution, bounded reachability with witness chains, lock-identity
+resolution including constructor injection, and cycle detection."""
+
+import os
+import textwrap
+
+from predictionio_tpu.analysis import callgraph, engine, lockgraph
+from predictionio_tpu.analysis.engine import Project
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, src):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+
+
+class TestSymbolTable:
+    def test_functions_methods_and_nested_get_qualnames(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            def top():
+                def inner():
+                    pass
+                return inner
+
+            class C:
+                def meth(self):
+                    pass
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        fids = set(cg.funcs)
+        assert "m.py::top" in fids
+        assert "m.py::top.<locals>.inner" in fids
+        assert "m.py::C.meth" in fids
+        assert cg.funcs["m.py::C.meth"].cls == "C"
+        assert "m.py::C" in cg.classes
+
+    def test_graph_is_cached_per_project(self):
+        proj = Project(FIXTURES)
+        assert callgraph.get(proj) is callgraph.get(proj)
+
+
+class TestResolution:
+    def test_cross_module_import_and_alias(self, tmp_path):
+        _write(tmp_path, "db.py", """
+            def query():
+                pass
+        """)
+        _write(tmp_path, "app.py", """
+            import db
+            from db import query as q
+
+            def via_module():
+                db.query()
+
+            def via_alias():
+                q()
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        assert [s.callee for s in cg.edges["app.py::via_module"]] == \
+            ["db.py::query"]
+        assert [s.callee for s in cg.edges["app.py::via_alias"]] == \
+            ["db.py::query"]
+
+    def test_self_method_through_base_class(self, tmp_path):
+        _write(tmp_path, "base.py", """
+            class Base:
+                def helper(self):
+                    pass
+        """)
+        _write(tmp_path, "impl.py", """
+            from base import Base
+
+            class Impl(Base):
+                def run(self):
+                    self.helper()
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        assert [s.callee for s in cg.edges["impl.py::Impl.run"]] == \
+            ["base.py::Base.helper"]
+
+    def test_self_attr_typed_field_method(self, tmp_path):
+        _write(tmp_path, "store.py", """
+            class Store:
+                def load(self):
+                    pass
+        """)
+        _write(tmp_path, "plane.py", """
+            from store import Store
+
+            class Plane:
+                def __init__(self):
+                    self.store = Store()
+                def serve(self):
+                    self.store.load()
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        callees = {s.callee for s in cg.edges["plane.py::Plane.serve"]}
+        assert "store.py::Store.load" in callees
+
+    def test_class_call_resolves_to_init(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            class Thing:
+                def __init__(self):
+                    pass
+
+            def make():
+                return Thing()
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        assert [s.callee for s in cg.edges["m.py::make"]] == \
+            ["m.py::Thing.__init__"]
+
+
+class TestReachability:
+    def test_witness_chain_spans_modules(self):
+        cg = callgraph.get(Project(FIXTURES))
+        root = "xmod_routes.py::XModAPI._handle_report"
+        hits = {fs.fid: chain for fs, chain in cg.reachable(root)}
+        assert root in hits and hits[root] == ()
+        chain = hits["xmod_db.py::fetch_rows"]
+        assert [fid for fid, _line in chain] == \
+            [root, "xmod_helper.py::load_report"]
+        rendered = cg.render_chain(chain, cg.funcs["xmod_db.py::fetch_rows"])
+        assert "XModAPI._handle_report (xmod_routes.py:" in rendered
+        assert rendered.endswith("fetch_rows")
+
+    def test_max_depth_bounds_the_closure(self, tmp_path):
+        _write(tmp_path, "chain.py", """
+            def f0():
+                f1()
+            def f1():
+                f2()
+            def f2():
+                f3()
+            def f3():
+                pass
+        """)
+        cg = callgraph.get(Project(str(tmp_path)))
+        shallow = {fs.name for fs, _ in cg.reachable("chain.py::f0",
+                                                     max_depth=2)}
+        assert shallow == {"f0", "f1", "f2"}
+        deep = {fs.name for fs, _ in cg.reachable("chain.py::f0")}
+        assert deep == {"f0", "f1", "f2", "f3"}
+
+
+class TestLockGraph:
+    def test_fixture_inversion_is_a_cycle(self):
+        lg = lockgraph.get(Project(FIXTURES))
+        cycles = lg.cycles()
+        assert any(
+            all(any(name in lbl for lbl in cyc)
+                for name in ("_lock_a", "_lock_b"))
+            for cyc in cycles), cycles
+
+    def test_cross_module_lock_edge(self, tmp_path):
+        _write(tmp_path, "stock.py", """
+            import threading
+
+            class Stock:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def adjust(self):
+                    with self._lock:
+                        pass
+        """)
+        _write(tmp_path, "orders.py", """
+            import threading
+            from stock import Stock
+
+            class Orders:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stock = Stock()
+                def place(self):
+                    with self._lock:
+                        self.stock.adjust()
+        """)
+        lg = lockgraph.get(Project(str(tmp_path)))
+        assert ("orders.py:Orders._lock", "stock.py:Stock._lock") in \
+            lg.edge_set()
+        assert lg.cycles() == []
+
+    def test_constructor_injected_lock_resolves_to_true_site(self):
+        # DeltaSwapper holds a lock handed in by its creator; the graph
+        # must resolve it to PredictionServer._state_lock, not guess
+        proj = Project(REPO_ROOT, subdirs=engine.DEFAULT_SUBDIRS)
+        lg = lockgraph.get(proj)
+        inners = {b for (a, b) in lg.edge_set()
+                  if "OnlinePlane._fold_lock" in a}
+        assert any("PredictionServer._state_lock" in b for b in inners), \
+            sorted(lg.edge_set())
+
+    def test_live_tree_has_no_lock_cycle(self):
+        proj = Project(REPO_ROOT, subdirs=engine.DEFAULT_SUBDIRS)
+        assert lockgraph.get(proj).cycles() == []
