@@ -697,14 +697,25 @@ def bench_serving_qps(emit: bool = True, ladder=None,
 
     # ladder + span attribution on ONE loop server (the acceptance rung
     # reuses the best-of-3 window above so the record is self-consistent)
+    from predictionio_tpu.telemetry import device as _device
+
+    def _device_counts() -> tuple:
+        st = _device.export_state()
+        return (int(st.get("total_us", 0)),
+                sum(int(f.get("retraces", 0))
+                    for f in st.get("fns", {}).values()))
+
     ladder_out = {}
     server = serve(transport="loop")
     try:
         warm(server.port)
         for n_clients in ladder:
             # the always-on profiler annotates every rung with the
-            # frames whose self-time grew during that rung's window
+            # frames whose self-time grew during that rung's window;
+            # the device clock annotates it with busy-time/utilization
+            # and retrace-count deltas over the same window
             prof_before = _profile_self_counts(server.port)
+            dev_before, dev_t0 = _device_counts(), time.perf_counter()
             if n_clients == accept_at:
                 # numbers come from the best-of-3 A/B window above; a
                 # short re-load on this server gives the rung its own
@@ -722,12 +733,30 @@ def bench_serving_qps(emit: bool = True, ladder=None,
                          "n_requests": n}
             entry["top_stacks"] = _top_stack_delta(
                 prof_before, _profile_self_counts(server.port))
+            dev_after = _device_counts()
+            rung_wall_s = max(time.perf_counter() - dev_t0, 1e-9)
+            busy_us = dev_after[0] - dev_before[0]
+            entry["device"] = {
+                "busy_us": busy_us,
+                # single-device share of the rung's wall window; on the
+                # CPU-backend fallback this is dispatch wall time
+                "utilization": round(busy_us / (rung_wall_s * 1e6), 4),
+                "retraces": dev_after[1] - dev_before[1]}
             ladder_out[str(n_clients)] = entry
         span_breakdown = _span_breakdown(server.port, "/queries.json",
                                          payloads)
         # 1m-rate view of the ladder run from the in-process history
         # store — the record shows the sustained rates, not one endpoint
         history_rates = _scrape_history(server.port)
+        # device-clock cumulative view at the top of the ladder: total
+        # attributed device time plus per-fn compile/retrace counters
+        dev_state = _device.export_state()
+        device_summary = {
+            "total_us": int(dev_state.get("total_us", 0)),
+            "fns": {name: {"compiles": int(f.get("compiles", 0)),
+                           "dispatches": int(f.get("dispatches", 0)),
+                           "retraces": int(f.get("retraces", 0))}
+                    for name, f in dev_state.get("fns", {}).items()}}
     finally:
         server.shutdown()
     missing = [s for s in ("http.parse", "http.dispatch", "http.encode")
@@ -854,6 +883,10 @@ def bench_serving_qps(emit: bool = True, ladder=None,
         # deltas from the same always-on sampler
         "profiler": {"on": prof_ab["on"], "off": prof_ab["off"],
                      "p95_ratio": round(profiler_ratio, 3)},
+        # device-clock attribution over the ladder run: the rungs above
+        # carry per-rung busy_us/utilization/retraces deltas; this is
+        # the cumulative per-fn inventory view
+        "device": device_summary,
         "parity_checked": len(parity["loop"]),
         "saturation": {"statuses": {str(k): v for k, v in
                                     sorted(tally.items())},
@@ -2192,7 +2225,7 @@ def bench_north_star(scale: str = "20m", full: bool = True):
         guarded("serving_qps", project(
             lambda: bench_serving_qps(emit=False),
             ("value", "concurrency", "transports", "ladder",
-             "span_breakdown", "saturation", "vs_baseline",
+             "span_breakdown", "saturation", "device", "vs_baseline",
              "vs_r05_32", "bar")))
         guarded("batch_predict", project(
             lambda: bench_batch_predict(emit=False),

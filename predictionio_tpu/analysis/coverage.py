@@ -1,6 +1,6 @@
 """Rule pack (d): coverage rules.
 
-Three "the receipts must keep existing" checks:
+Four "the receipts must keep existing" checks:
 
 - ``coverage-fault-site``: every ``faults.inject("<site>")`` call site
   in the package must be referenced (armed) by some test or gate —
@@ -18,6 +18,14 @@ Three "the receipts must keep existing" checks:
   ``record_stage(ctx, "<stage>")`` must appear in the stage glossary
   in ``docs/observability.md`` — an undocumented stage shows up in
   assembled timelines with no explanation of what it measures.
+
+- ``coverage-jit-metering``: every ``jax.jit``/``pjit`` call site must
+  go through ``utils/profiling.metered_jit`` — a bare jit boundary is
+  invisible to ``jit_compiles_total``, the device clock, and the
+  ``/debug/jit.json`` inventory, so its retraces and device-seconds
+  are unattributable. Sanctioned bare sites (debug-only paths,
+  identity compiles) carry an inline
+  ``# pio-lint: disable=coverage-jit-metering``.
 """
 
 from __future__ import annotations
@@ -136,6 +144,49 @@ def coverage_metric_docs(project: Project) -> Iterable[Finding]:
             symbol=name, severity="warning",
             hint="add it to the metrics reference table in "
                  "docs/observability.md (or a tools/ dashboard panel)")
+
+
+_JIT_CALL_NAMES = {"jit", "pjit"}
+
+
+@rule("coverage-jit-metering",
+      "every jax.jit/pjit call site must go through metered_jit")
+def coverage_jit_metering(project: Project) -> Iterable[Finding]:
+    """Flags the three bare-jit spellings: direct calls
+    (``jax.jit(fn)``), factory partials (``partial(jax.jit, ...)``),
+    and bare decorators (``@jax.jit``). ``metered_jit(...)`` wraps the
+    same factory and is the sanctioned route."""
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            hits: List[Tuple[int, str]] = []
+            if isinstance(node, ast.Call):
+                t = astutil.terminal_name(node)
+                if t in _JIT_CALL_NAMES:
+                    hits.append((node.lineno, t))
+                elif t == "partial" and node.args:
+                    inner = astutil.terminal_name(node.args[0])
+                    if inner in _JIT_CALL_NAMES:
+                        hits.append((node.lineno, inner))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        continue  # @partial(jax.jit, ...) is the Call case
+                    t = astutil.terminal_name(dec)
+                    if t in _JIT_CALL_NAMES:
+                        hits.append((dec.lineno, t))
+            for line, name in hits:
+                yield Finding(
+                    "coverage-jit-metering", mod.rel, line,
+                    f"bare {name}() call site — this jit boundary is "
+                    f"invisible to jit_compiles_total, the device clock "
+                    f"and the /debug/jit.json inventory; its retraces "
+                    f"and device-seconds are unattributable",
+                    symbol=name,
+                    hint="wrap it with utils/profiling.metered_jit(fn, "
+                         "label=...); suppress inline only for "
+                         "debug-only or identity-compile paths")
 
 
 def _stage_literal(call: ast.Call) -> str:

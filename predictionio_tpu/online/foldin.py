@@ -45,6 +45,7 @@ from predictionio_tpu.ops.als import (
     bucket_ragged,
     resolve_solver,
 )
+from predictionio_tpu.telemetry import device as device_telemetry
 
 
 # fold batches chunk into row-tier-ladder solves — see solve_rows
@@ -54,14 +55,18 @@ MAX_ROWS_PER_SOLVE = 128
 @functools.lru_cache(maxsize=16)
 def _fold_solver(cfg: ALSConfig):
     """One jitted half-epoch solve per (resolved) config; XLA's own jit
-    cache handles the per-bucket-shape retraces under it."""
-    import jax
+    cache handles the per-bucket-shape retraces under it. metered_jit
+    (not bare jax.jit) so every fold solve lands in the jit-cache
+    inventory and the device clock's attribution — a retrace storm here
+    names its changed tier in /debug/jit.json instead of surfacing as
+    ingest-backlog mush."""
+    from predictionio_tpu.utils.profiling import metered_jit
 
-    @functools.partial(jax.jit, static_argnames=("out_rows",))
     def run(opposing, buckets_dev, out_rows):
         return _solve_buckets_device(opposing, out_rows, buckets_dev, cfg)
 
-    return run
+    return metered_jit(run, label="foldin.solve",
+                       static_argnames=("out_rows",))
 
 
 def solve_rows(opposing: np.ndarray,
@@ -164,8 +169,11 @@ def solve_rows(opposing: np.ndarray,
     # Bucket padding rows scatter into row `n` — inside the padded range
     # now, but that scratch row is sliced off with the rest of the pad.
     run = _fold_solver(cfg)
-    out = run(np.ascontiguousarray(opposing), ((br, bc, bv, bm, None),),
-              out_rows=target)
+    # device attribution: fold solves bill to the online plane, tiered by
+    # the row ladder the executable space is keyed on
+    with device_telemetry.attribution("online.foldin", tier=str(target)):
+        out = run(np.ascontiguousarray(opposing),
+                  ((br, bc, bv, bm, None),), out_rows=target)
     return np.asarray(out[:n])
 
 

@@ -759,7 +759,9 @@ def _get_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
         from predictionio_tpu.utils import checks
 
         return checks.checked_jit(run)
-    return jax.jit(run)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(run, label="als.train_steps")
 
 
 def resolve_solver(cfg: ALSConfig) -> ALSConfig:
@@ -972,7 +974,9 @@ def als_train(
         return (jax.device_put(uf, factor_sharding),
                 jax.device_put(itf, factor_sharding))
 
-    replicate = jax.jit(lambda x: x, out_shardings=rep)
+    # identity re-shard, not a compute boundary: metering it would count
+    # a "compile" for a data movement the inventory can't blame
+    replicate = jax.jit(lambda x: x, out_shardings=rep)  # pio-lint: disable=coverage-jit-metering
 
     def factors_to_host():
         """Host [n, K] copies of the live factor arrays.

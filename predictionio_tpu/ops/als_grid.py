@@ -335,7 +335,9 @@ def _get_grid_train_loop(n_users: int, n_items: int, cfg: ALSConfig,
             body, (user_f0, item_f0), xs=jax.numpy.arange(n_steps))
         return user_f, item_f, rmses
 
-    return jax.jit(run)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(run, label="als_grid.train_steps")
 
 
 def als_train_grid(

@@ -282,4 +282,6 @@ def get_train_loop_sharded(
         out_specs=(factor_spec, factor_spec, P()),
         check_vma=False,  # pallas gj solver carries no vma info
     )
-    return jax.jit(shard)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(shard, label="als_sharded.train_steps")

@@ -145,14 +145,13 @@ def cooccurrence_matrix(
             m, m, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    import functools
+    from predictionio_tpu.utils.profiling import metered_jit
 
-    @functools.partial(jax.jit, static_argnums=())
     def run():
         acc0 = jnp.zeros((n_items, n_items), jnp.float32)
         return jax.lax.fori_loop(0, n_chunks, body, acc0)
 
-    return np.asarray(run())
+    return np.asarray(metered_jit(run, label="basket.cooccurrence")())
 
 
 def cooccurrence_matrix_host(

@@ -94,7 +94,9 @@ def _nb_fit(n_classes: int, smoothing: float):
         )
         return log_prior, log_theta
 
-    return jax.jit(fit)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(fit, label="classify.nb_fit")
 
 
 def naive_bayes_train(
@@ -146,7 +148,9 @@ def _nb_fit_grid(n_classes: int):
 
         return jax.vmap(finish)(smoothings)
 
-    return jax.jit(fit)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(fit, label="classify.nb_fit_grid")
 
 
 def naive_bayes_train_grid(
@@ -210,7 +214,9 @@ def _logreg_fit(n_classes: int, n_steps: int, lr: float, reg: float):
         )
         return params, state, losses
 
-    return jax.jit(fit)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(fit, label="classify.logreg_fit")
 
 
 @functools.lru_cache(maxsize=16)
@@ -259,7 +265,9 @@ def _logreg_fit_grid(n_classes: int, n_steps: int):
         return jax.vmap(fit_one, in_axes=(0, 0, 0, None, None, None, None))(
             lrs, regs, n_iters, params0, x, y, w)
 
-    return jax.jit(run)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(run, label="classify.logreg_fit_grid")
 
 
 def logreg_train_grid(

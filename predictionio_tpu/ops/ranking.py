@@ -24,6 +24,7 @@ def _topk_fn(k: int, masked: bool):
     import jax
     import jax.numpy as jnp
 
+    from predictionio_tpu.telemetry.registry import capped_label
     from predictionio_tpu.utils.profiling import metered_jit
 
     def score_topk(u_vecs, item_factors, ex_rows=None, ex_cols=None):
@@ -42,8 +43,14 @@ def _topk_fn(k: int, masked: bool):
 
     # compile activity per (k, masked) variant is visible on /metrics —
     # a recompile storm here (unstable batch shapes defeating the bucket
-    # ladder) used to be diagnosable only as a serving latency cliff
-    return metered_jit(score_topk, label=f"ranking.score_topk_k{k}")
+    # ladder) used to be diagnosable only as a serving latency cliff.
+    # k is caller-controlled (the query's "num"), so the label passes
+    # through its own capped group: the first few distinct k values keep
+    # per-k series, the long tail collapses to score_topk_k<other>
+    # instead of minting one /metrics series per requested k.
+    return metered_jit(
+        score_topk,
+        label=f"ranking.score_topk_k{capped_label('ranking_topk_k', k, cap=8)}")
 
 
 def _exclusion_coo(ids, exclude, n_rows: int):

@@ -217,7 +217,9 @@ def _w2v_train_loop(n_pairs: int, vocab_size: int, cfg: Word2VecConfig,
         )
         return (emb_in, emb_out, key), losses
 
-    return jax.jit(run)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(run, label="text.w2v_train_steps")
 
 
 @functools.lru_cache(maxsize=16)
@@ -303,7 +305,9 @@ def _w2v_train_loop_sharded(n_pairs: int, vocab_size: int,
         out_specs=((rep, rep, rep), rep),
         check_vma=False,  # replicated-in/replicated-out by construction
     )
-    return jax.jit(shard)
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    return metered_jit(shard, label="text.w2v_train_steps_sharded")
 
 
 def word2vec_train(

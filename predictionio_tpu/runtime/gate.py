@@ -52,6 +52,7 @@ from predictionio_tpu.serving import (
     ServingPlane,
     ShedLoad,
 )
+from predictionio_tpu.telemetry import device as device_telemetry
 from predictionio_tpu.telemetry import lineage
 from predictionio_tpu.utils.faults import FaultInjected
 from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
@@ -98,7 +99,16 @@ class StubPredictionServer(HttpService):
             self._burn_ms = 0.0
 
         def _dispatch(queries: List) -> List:
-            return [{"stub": True} for _ in queries]
+            # one simulated jitted dispatch per batch — the serving
+            # plane's attribution context is already open around this
+            # call (batcher or inline path), so the telemetry gate's
+            # fleet drill can assert the supervisor's merged device
+            # view is sum-exact against the per-worker exports
+            t0 = time.perf_counter()
+            out = [{"stub": True} for _ in queries]
+            device_telemetry.record_dispatch(
+                "gate.stub_score", (len(queries),), out=None, t0=t0)
+            return out
 
         self.serving = ServingPlane(
             _dispatch, config=ServingConfig.from_env(),

@@ -625,6 +625,9 @@ class Supervisor:
         # …and its /debug/lineage routes serve the fleet-merged timelines
         telemetry_middleware.set_lineage_renderer(
             "supervisor", self._render_fleet_lineage)
+        # …and its /debug/jit.json serves the fleet-merged device view
+        telemetry_middleware.set_device_renderer(
+            "supervisor", self._render_fleet_device)
 
         if self.cfg.control_port is not None:
             try:
@@ -667,6 +670,7 @@ class Supervisor:
             telemetry_middleware.set_metrics_renderer("supervisor", None)
             telemetry_middleware.set_profile_renderer("supervisor", None)
             telemetry_middleware.set_lineage_renderer("supervisor", None)
+            telemetry_middleware.set_device_renderer("supervisor", None)
             if self._control is not None:
                 try:
                     self._control.shutdown()
@@ -1120,6 +1124,20 @@ class Supervisor:
             parts.append((str(snap.get("worker", "?")),
                           snap.get("profile")))
         return profiler.filter_merged(profiler.merge_profiles(parts), route)
+
+    def _render_fleet_device(self) -> tuple:
+        """The control endpoint's /debug/jit.json: every worker's device
+        attribution export (riding the same snapshot fetch as the metric
+        merge) plus the supervisor's own, merged by device.merge_device —
+        device-microseconds sum exactly and the per-worker totals ship in
+        the same payload, so ``total_us == sum(workers.values())`` is
+        checkable from one fetch."""
+        from predictionio_tpu.telemetry import device
+        parts = [("supervisor", device.export_state())]
+        for snap in self._worker_snapshots():
+            parts.append((str(snap.get("worker", "?")),
+                          snap.get("device")))
+        return 200, device.merge_device(parts)
 
     def _render_fleet_lineage(self, trace_id=None, limit: int = 100) -> tuple:
         """The control endpoint's /debug/lineage routes: every worker's

@@ -59,8 +59,13 @@ from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 from predictionio_tpu.serving.admission import DEADLINE_MISSES, DeadlineExceeded
+from predictionio_tpu.telemetry import device as device_telemetry
 from predictionio_tpu.telemetry import spans
 from predictionio_tpu.telemetry.registry import REGISTRY
+
+# device-plane attribution route for batched predict dispatches (the
+# batcher only ever fronts the predict path)
+_DISPATCH_ROUTE = "/queries.json"
 
 log = logging.getLogger(__name__)
 
@@ -134,7 +139,8 @@ class _Pending:
     # contextvar timelines don't cross threads (telemetry/spans.py).
     # Stamps are written strictly before finish() sets the event.
     __slots__ = ("query", "deadline", "enqueued_at", "done", "result",
-                 "error", "taken_at", "pad_s", "dispatch_s")
+                 "error", "taken_at", "pad_s", "dispatch_s", "host_s",
+                 "device_s")
 
     def __init__(self, query, deadline: Optional[float]):
         self.query = query
@@ -146,6 +152,11 @@ class _Pending:
         self.taken_at: Optional[float] = None
         self.pad_s = 0.0
         self.dispatch_s: Optional[float] = None
+        # host-prep vs device-exec split of dispatch_s, measured by the
+        # device-plane attribution context when the dispatch went through
+        # a metered_jit boundary; None on host-only scoring
+        self.host_s: Optional[float] = None
+        self.device_s: Optional[float] = None
 
     def record_spans(self) -> None:
         """Convert the dispatcher's stage stamps into spans on the calling
@@ -162,6 +173,15 @@ class _Pending:
             start = taken + self.pad_s
             end = start + self.dispatch_s
             spans.record_between("serving.dispatch", start, end)
+            if self.device_s is not None:
+                # host-queue vs device-exec split inside the dispatch
+                # span: nested (they refine serving.dispatch) so the
+                # stage sum doesn't double-bill the window
+                host_end = start + (self.host_s or 0.0)
+                spans.record_between("serving.dispatch.host", start,
+                                     host_end, nested=True)
+                spans.record_between("serving.dispatch.device", host_end,
+                                     host_end + self.device_s, nested=True)
             # dispatch end → this thread actually resuming: pure scheduler
             # wake-up latency, which dominates unattributed wall time on a
             # saturated box — name it so stage sums still account for the
@@ -236,7 +256,17 @@ class MicroBatcher:
                 _BATCH_SIZE.observe(1)
                 _BATCHES.inc()
                 with spans.span("serving.dispatch"):
-                    results = self.dispatch_fn([query])
+                    with device_telemetry.attribution(
+                            _DISPATCH_ROUTE, tier="1") as att:
+                        results = self.dispatch_fn([query])
+                    if att.dispatches:
+                        # split host prep vs device exec inside the
+                        # dispatch span (depth > 0 here → auto-nested)
+                        spans.record_between("serving.dispatch.host",
+                                             att.t_enter,
+                                             att.t_first_dispatch)
+                        spans.record("serving.dispatch.device",
+                                     att.jit_wall_s)
                 if len(results) != 1:
                     raise RuntimeError(
                         f"batched dispatch returned {len(results)} results "
@@ -334,7 +364,15 @@ class MicroBatcher:
         for p in live:
             p.pad_s = pad_s
         try:
-            results = self.dispatch_fn(padded)[:len(queries)]
+            with device_telemetry.attribution(
+                    _DISPATCH_ROUTE, tier=str(len(padded))) as att:
+                results = self.dispatch_fn(padded)[:len(queries)]
+            if att.dispatches:
+                host_s = max(0.0, (att.t_first_dispatch or att.t_enter)
+                             - att.t_enter)
+                for p in live:
+                    p.host_s = host_s
+                    p.device_s = att.jit_wall_s
             if len(results) != len(queries):
                 raise RuntimeError(
                     f"batched dispatch returned {len(results)} results "

@@ -105,9 +105,17 @@ def snapshot_registry(registry: MetricsRegistry = REGISTRY,
         lineage = _lineage.export_state()
     except Exception:  # noqa: BLE001 — snapshots must not break on this
         lineage = None
+    # The device plane's microsecond attribution + jit-cache counts ride
+    # along too — the supervisor's fleet device view is sum-exact because
+    # these are the same integers the workers accumulated.
+    try:
+        from predictionio_tpu.telemetry import device as _device
+        device = _device.export_state()
+    except Exception:  # noqa: BLE001 — snapshots must not break on this
+        device = None
     return {"worker": worker or worker_label(), "pid": os.getpid(),
             "ts": time.time(), "families": families, "profile": profile,
-            "lineage": lineage}
+            "lineage": lineage, "device": device}
 
 
 class SnapshotServer:

@@ -4,8 +4,8 @@ Rules come in three kinds, all reading `telemetry/history.py` series —
 never instantaneous gauges, so a one-sample blip can't page:
 
 - ``threshold`` — a windowed statistic of one family (counter ``rate``,
-  gauge ``mean``/``max``, histogram ``p50``/``p95``/``p99``) compared
-  against a bound.
+  gauge ``mean``/``max``/``min``, histogram ``p50``/``p95``/``p99``)
+  compared against a bound.
 - ``burn_rate`` — sugar over threshold on the
   ``slo_error_budget_burn_rate`` gauge (max across matching routes).
 - ``zscore`` — the latest sample scored against the window's mean/std;
@@ -152,6 +152,11 @@ class AlertRule:
         if self.stat == "max":
             return _series_max(history, self.metric, self.labels,
                                self.window_s)
+        if self.stat == "min":
+            # time-mean of the per-sample minimum child: the most
+            # constrained device/worker is the signal for floor alerts
+            return history.mean(self.metric, self.labels,
+                                window_s=self.window_s, agg="min")
         return history.mean(self.metric, self.labels,
                             window_s=self.window_s)
 
@@ -214,6 +219,16 @@ def default_rules() -> List[AlertRule]:
                   labels={"window": "5m", "server": "online",
                           "route": "event_to_servable"},
                   stat="max", value=14.4, window_s=60.0, severity="page"),
+        # Device HBM headroom burn: pages when the memory sampler's
+        # headroom ratio (free/limit, telemetry/device.py) averages under
+        # 10% across 5 minutes — the high-water families in the history
+        # buffer then show WHICH allocation ate it. The gauge only exists
+        # on accelerator-backed deployments, so measure() returns None
+        # (silent) everywhere else.
+        AlertRule(name="device-headroom-5m", kind="threshold",
+                  metric="device_mem_headroom_ratio",
+                  stat="min", op="<", value=0.10, window_s=300.0,
+                  severity="page"),
     ]
 
 

@@ -1,6 +1,6 @@
 """Telemetry gate — CI check that no HTTP surface escapes the middleware.
 
-Run via `python quality.py --telemetry-gate`. Six layers:
+Run via `python quality.py --telemetry-gate`. Seven layers:
 
 1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
    every HTTP server must go through `utils/http.py`'s HttpService —
@@ -34,7 +34,14 @@ Run via `python quality.py --telemetry-gate`. Six layers:
    load, answer `?seconds=` capture windows, and cost ≤5% p95 on the
    serving hot path (interleaved sampler-on/off A/B, best-of-3).
 
-6. Fleet-aggregation drill: a 4-worker SO_REUSEPORT pool (stub factory,
+6. Device drill: the device plane's contracts, jax-free (the wall-time
+   fallback path): `/debug/jit.json` serves a non-empty inventory under
+   load with internally consistent per-signature counts, an induced
+   retrace carries blame naming the changed dimension,
+   `device_seconds_total` is attributed to the drilled route, and an
+   interleaved clock-on/off A/B holds the ≤5% overhead bar.
+
+7. Fleet-aggregation drill: a 4-worker SO_REUSEPORT pool (stub factory,
    no jax) under sustained load; the supervisor's merged `/metrics`
    counter totals must EXACTLY equal the sum of the per-worker
    registries read over the snapshot sockets, `/debug/history.json` on
@@ -47,7 +54,10 @@ Run via `python quality.py --telemetry-gate`. Six layers:
    It also checks the fleet lineage view: the control endpoint's
    `/debug/lineage.json` stage counts must EXACTLY equal the sum of the
    per-worker lineage rings, and match the per-worker totals shipped in
-   the same payload.
+   the same payload. And the fleet device view: the control endpoint's
+   `/debug/jit.json` merged device-microsecond total must equal the sum
+   of its own per-worker map (one-payload exactness) AND the per-worker
+   exports read over the snapshot sockets.
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
@@ -473,6 +483,178 @@ def _profiler_drill() -> list[str]:
     return problems
 
 
+def _device_drill() -> list[str]:
+    """The device plane's promises, checked live and jax-free — the
+    drill drives `record_dispatch` over the wall-time fallback path
+    (exactly what metered_jit does in a jax-less process): a non-empty
+    `/debug/jit.json` inventory with internally consistent counts, an
+    induced retrace blaming the changed dimension, `device_seconds_total`
+    attributed to the drilled route, and a clock-on/off A/B within the
+    5% overhead bar."""
+    import http.client
+    import json
+    import time
+
+    import numpy as np
+
+    from predictionio_tpu.serving import ServingPlane
+    from predictionio_tpu.telemetry import device
+    from predictionio_tpu.utils.http import HttpService, JsonRequestHandler
+
+    problems = []
+    device.reset_state()
+    clock_was_enabled = device.clock_enabled()
+    device.set_clock_enabled(True)
+
+    # two warmed bucket tiers; every dispatch flows through the real
+    # record_dispatch hook under the serving plane's attribution context
+    tiers = [np.zeros((4, 8), np.float32), np.zeros((16, 8), np.float32)]
+    seen_shapes: set = set()
+    state = {"n": 0}
+
+    def dispatch(queries):
+        x = tiers[state["n"] % len(tiers)]
+        state["n"] += 1
+        compiled = x.shape not in seen_shapes
+        seen_shapes.add(x.shape)
+        t0 = time.perf_counter()
+        device.record_dispatch("gate.score", (x,), out=None, t0=t0,
+                               t1=t0 + 5e-4, compiled=compiled,
+                               compile_s=5e-4 if compiled else 0.0)
+        return [{"scored": True} for _ in queries]
+
+    plane = ServingPlane(dispatch, name="devgateserving")
+
+    class _QueryHandler(JsonRequestHandler):
+        def do_POST(self):
+            body = self.read_body()
+            if self.path != "/queries.json":
+                return self.send_json(404, {"message": "Not Found"})
+            result, _degraded = plane.handle_query(
+                json.loads(body or b"{}"), self.headers)
+            self.send_json(200, result)
+
+    svc = HttpService("127.0.0.1", 0, _QueryHandler,
+                      server_name="devgateserving")
+    svc.start()
+    try:
+        def run_leg(n: int) -> list[float]:
+            conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                              timeout=10)
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                conn.request("POST", "/queries.json", b'{"user": "u"}',
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+                lat.append(time.perf_counter() - t0)
+            conn.close()
+            return lat
+
+        run_leg(60)
+
+        # -- the inventory over HTTP: non-empty, internally consistent
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=5)
+        conn.request("GET", "/debug/jit.json")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        conn.close()
+        if r.status != 200:
+            problems.append(f"device: /debug/jit.json answered {r.status}")
+            body = {}
+        fn = body.get("fns", {}).get("gate.score")
+        if fn is None:
+            problems.append("device: inventory empty after 60 dispatched "
+                            "queries (gate.score missing)")
+        else:
+            if len(fn["signatures"]) != 2:
+                problems.append(
+                    f"device: expected 2 warmed signatures, inventory has "
+                    f"{len(fn['signatures'])}")
+            sig_dispatches = sum(s["dispatches"] for s in fn["signatures"])
+            if sig_dispatches != fn["dispatches_total"]:
+                problems.append(
+                    f"device: per-signature dispatches {sig_dispatches} != "
+                    f"fn total {fn['dispatches_total']}")
+            sig_compiles = sum(s["compiles"] for s in fn["signatures"])
+            if sig_compiles != fn["compiles_total"]:
+                problems.append(
+                    f"device: per-signature compiles {sig_compiles} != "
+                    f"fn total {fn['compiles_total']}")
+            # warming the second tier is itself one retrace (a compile
+            # beyond the first cached signature)
+            if fn["retraces_total"] != 1:
+                problems.append(
+                    f"device: warmed two-tier ladder shows "
+                    f"{fn['retraces_total']} retraces (want exactly 1)")
+
+        # -- induced retrace: a third shape must carry dimension blame
+        with device.attribution("/queries.json", tier="64"):
+            t0 = time.perf_counter()
+            device.record_dispatch(
+                "gate.score", (np.zeros((64, 8), np.float32),), out=None,
+                t0=t0, t1=t0 + 5e-4, compiled=True, compile_s=5e-4)
+        _st, body = device.jit_payload()
+        blames = body["fns"]["gate.score"]["retrace_blame"]
+        if not blames:
+            problems.append("device: induced retrace recorded no blame")
+        else:
+            changed = "; ".join(blames[-1].get("changed", ()))
+            if "dim0" not in changed or "64" not in changed:
+                problems.append(
+                    f"device: retrace blame {changed!r} does not name the "
+                    f"changed dimension (want 'dim0: …→64')")
+
+        # -- route attribution: device seconds must land on the route
+        attributed = [row for row in body.get("device_attribution", ())
+                      if row["route"] == "/queries.json" and row["us"] > 0]
+        if not attributed:
+            problems.append(
+                "device: no device_seconds_total attributed to "
+                "/queries.json after the drill")
+
+        # -- clock on/off A/B, same pooled-median design and retry
+        # policy as the profiler drill (see that comment for why).
+        # Both legs keep calling record_dispatch — inventory and
+        # attribution bookkeeping are metered_jit's baseline — so the
+        # ratio isolates the device clock's own accounting increment,
+        # which is what the ≤5% overhead bar is about.
+        def ab_attempt() -> tuple:
+            pools: dict = {"on": [], "off": []}
+            for rep in range(8):
+                order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+                for leg in order:
+                    device.set_clock_enabled(leg == "on")
+                    run_leg(10)
+                    pools[leg].extend(run_leg(150))
+            device.set_clock_enabled(True)
+            on_pool = sorted(pools["on"])
+            off_pool = sorted(pools["off"])
+            on_ms = on_pool[len(on_pool) // 2] * 1e3
+            off_ms = off_pool[len(off_pool) // 2] * 1e3
+            return (on_ms / off_ms if off_ms > 0 else 1.0, on_ms, off_ms)
+
+        for attempt in range(3):
+            ratio, on_ms, off_ms = ab_attempt()
+            if ratio <= 1.05:
+                break
+        if ratio > 1.05:
+            problems.append(
+                f"device: clock-on pooled median latency {on_ms:.3f}ms is "
+                f"{ratio:.3f}x clock-off {off_ms:.3f}ms (3 attempts, 8 "
+                f"interleaved legs each) — over the 5% overhead bar")
+        else:
+            print(f"device drill: on/off pooled median {on_ms:.3f}/"
+                  f"{off_ms:.3f}ms (ratio {ratio:.3f}, attempt "
+                  f"{attempt + 1})")
+    finally:
+        svc.shutdown()
+        plane.close()
+        device.set_clock_enabled(clock_was_enabled)
+        device.reset_state()
+    return problems
+
+
 def _fleet_drill() -> list[str]:
     """4-worker pool under load: the supervisor's merged scrape must be
     sum-exact against the per-worker registries, with history running
@@ -665,6 +847,44 @@ def _fleet_drill() -> list[str]:
                 f"fleet: merged lineage stages sum "
                 f"{sum(merged_stages.values())} != per-worker totals in "
                 f"the same payload {worker_sum}")
+
+        # -- fleet device view on the control endpoint: the stub records
+        # one device dispatch per handled batch (wall-fallback path), so
+        # the merged device-microsecond total must be sum-exact against
+        # both the payload's own per-worker map AND the per-worker
+        # exports read over the snapshot sockets.
+        dev = _get_json(ctl_port, "/debug/jit.json", timeout_s=5.0)
+        if not dev.get("fleet"):
+            problems.append(
+                "fleet: /debug/jit.json on the control endpoint is not "
+                "the merged fleet view")
+        else:
+            dw = {k: int(v) for k, v in dev.get("workers", {}).items()}
+            if int(dev.get("total_us", -1)) != sum(dw.values()):
+                problems.append(
+                    f"fleet: merged device total_us {dev.get('total_us')} "
+                    f"!= sum of its own per-worker map {sum(dw.values())}")
+            snap_us = {}
+            for s in snaps:
+                part = s.get("device") or {}
+                snap_us[str(s.get("worker", "?"))] = \
+                    int(part.get("total_us", 0))
+            merged_minus_sup = {k: v for k, v in dw.items()
+                               if k != "supervisor"}
+            if merged_minus_sup != snap_us:
+                problems.append(
+                    f"fleet: merged per-worker device map "
+                    f"{merged_minus_sup} != per-worker exports over the "
+                    f"snapshot sockets {snap_us}")
+            if int(dev.get("routes", {}).get("/queries.json", 0)) <= 0:
+                problems.append(
+                    "fleet: merged device view attributes no device time "
+                    "to /queries.json")
+            fns = dev.get("fns", {})
+            if int(fns.get("gate.stub_score", {}).get("dispatches", 0)) <= 0:
+                problems.append(
+                    f"fleet: merged device view lost the stub's "
+                    f"gate.stub_score dispatches (fns: {sorted(fns)})")
     finally:
         if load is not None:
             load.stop_evt.set()
@@ -690,6 +910,10 @@ def run_gate() -> int:
         problems += _profiler_drill()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
         problems.append(f"profiler drill crashed: {e!r}")
+    try:
+        problems += _device_drill()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"device drill crashed: {e!r}")
     try:
         problems += _fleet_drill()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure

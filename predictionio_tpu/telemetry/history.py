@@ -43,7 +43,7 @@ from predictionio_tpu.telemetry.registry import (
 
 DEFAULT_PREFIXES: Tuple[str, ...] = (
     "http_", "serving_", "slo_", "supervisor_", "alert_", "ingest_",
-    "engine_", "experiment_", "lineage_", "online_",
+    "engine_", "experiment_", "lineage_", "online_", "device_",
 )
 
 SAMPLE_SECONDS = REGISTRY.gauge(
@@ -177,7 +177,7 @@ class MetricsHistory:
                window_s: Optional[float] = None, agg: str = "sum"
                ) -> List[Tuple[float, float]]:
         """[(ts, value)] for a counter/gauge family, matching children
-        aggregated per sample (``agg``: sum | max | mean)."""
+        aggregated per sample (``agg``: sum | max | min | mean)."""
         meta = self._meta.get(name)
         if meta is None or meta[0] == "histogram":
             return []
@@ -193,6 +193,8 @@ class MetricsHistory:
                 continue
             if agg == "max":
                 out.append((ts, max(vals)))
+            elif agg == "min":
+                out.append((ts, min(vals)))
             elif agg == "mean":
                 out.append((ts, sum(vals) / len(vals)))
             else:

@@ -30,7 +30,14 @@ import time
 from typing import Type
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.telemetry import history, profiler, slo, spans, tracing
+from predictionio_tpu.telemetry import (
+    device,
+    history,
+    profiler,
+    slo,
+    spans,
+    tracing,
+)
 from predictionio_tpu.telemetry.lineage import LINEAGE
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.telemetry.registry import REGISTRY
@@ -48,6 +55,7 @@ _DEBUG_ONE_ROUTE = "/debug/requests/<trace_id>.json"
 _HISTORY_ROUTE = "/debug/history.json"
 _PROFILE_ROUTE = "/debug/profile.json"
 _PROFILE_DEVICE_ROUTE = "/debug/profile/device.json"
+_JIT_ROUTE = "/debug/jit.json"
 _LINEAGE_LIST_ROUTE = "/debug/lineage.json"
 _LINEAGE_ONE_ROUTE = "/debug/lineage/<trace_id>.json"
 _LOCKS_ROUTE = "/debug/locks.json"
@@ -72,8 +80,8 @@ HTTP_ERRORS = REGISTRY.counter(
 # templates. Anything else (scanner noise, typos) collapses to "<other>".
 _EXACT_ROUTES = frozenset({
     "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
-    _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE, _LINEAGE_LIST_ROUTE,
-    _LOCKS_ROUTE,
+    _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE, _JIT_ROUTE,
+    _LINEAGE_LIST_ROUTE, _LOCKS_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -418,7 +426,44 @@ def serve_profile(handler, raw_path: str) -> None:
 
 
 def serve_profile_device(handler) -> None:
-    status, obj = profiler.device_payload()
+    # envelope and 503-without-jax contract owned by telemetry/device.py
+    # (profiler.device_payload is a compatibility delegate to the same)
+    status, obj = device.memory_payload()
+    _serve_json(handler, obj, status=status)
+
+
+# Per-server /debug/jit.json overrides, the /metrics renderer pattern a
+# fourth time: the supervisor swaps in its fleet-merged device view while
+# every worker keeps the process-local jit-cache inventory.
+_DEVICE_RENDERERS: dict = {}
+
+
+def set_device_renderer(server_name: str, renderer) -> None:
+    """Install (renderer() -> (status, obj)) for one server's
+    /debug/jit.json; None clears."""
+    if renderer is None:
+        _DEVICE_RENDERERS.pop(server_name, None)
+    else:
+        _DEVICE_RENDERERS[server_name] = renderer
+
+
+def _jit_inventory_payload(server: str) -> tuple:
+    """GET /debug/jit.json — per-fn compiled signatures, dispatch counts,
+    retrace blame, and device-time attribution."""
+    renderer = _DEVICE_RENDERERS.get(server)
+    if renderer is not None:
+        try:
+            return renderer()
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "device renderer for %s failed; serving process-local "
+                "view", server, exc_info=True)
+    return device.jit_payload()
+
+
+def serve_debug_jit(handler) -> None:
+    status, obj = _jit_inventory_payload(
+        getattr(handler, "pio_server_name", ""))
     _serve_json(handler, obj, status=status)
 
 
@@ -469,6 +514,8 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_profile(self, self.path)
         elif http_method == "GET" and path == _PROFILE_DEVICE_ROUTE:
             serve_profile_device(self)
+        elif http_method == "GET" and path == _JIT_ROUTE:
+            serve_debug_jit(self)
         elif http_method == "GET" and path == _LINEAGE_LIST_ROUTE:
             serve_debug_lineage(self, self.path)
         elif http_method == "GET" and path == _LOCKS_ROUTE:
@@ -531,6 +578,7 @@ def instrument(handler_cls: Type, server_name: str) -> Type:
     """Build an instrumented subclass of a BaseHTTPRequestHandler class."""
     history.ensure_started()
     profiler.ensure_started()
+    device.ensure_started()
 
     def make_wrapper(method_name: str, orig):
         http_method = method_name[3:]
@@ -772,7 +820,15 @@ def _profile_route(req):
 def _profile_device_route(req):
     from predictionio_tpu.utils import routing
 
-    status, obj = profiler.device_payload()
+    status, obj = device.memory_payload()
+    return routing.Response.json(status, obj)
+
+
+def _jit_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _jit_inventory_payload(
+        req.server_name if hasattr(req, "server_name") else "")
     return routing.Response.json(status, obj)
 
 
@@ -791,11 +847,13 @@ def register_builtin_routes(router) -> None:
     instead of stalling the selector."""
     history.ensure_started()
     profiler.ensure_started()
+    device.ensure_started()
     router.get("/metrics", _metrics_route)
     router.get(_DEBUG_LIST_ROUTE, _debug_list_route)
     router.get(_HISTORY_ROUTE, _history_route)
     router.get(_PROFILE_ROUTE, _profile_route, blocking=True)
     router.get(_PROFILE_DEVICE_ROUTE, _profile_device_route)
+    router.get(_JIT_ROUTE, _jit_route)
     router.get(_LINEAGE_LIST_ROUTE, _lineage_list_route)
     router.get(_LOCKS_ROUTE, _locks_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,

@@ -465,58 +465,13 @@ def capture(seconds: float, hz: float = 99.0,
 
 
 def device_payload() -> Tuple[int, Dict]:
-    """GET /debug/profile/device.json — jax live-buffer and device-memory
-    view. Lazy-import discipline: processes that never loaded jax (event
-    server, tests) answer a 503 envelope instead of paying the import."""
-    if "jax" not in sys.modules:
-        return 503, {"status": 503,
-                     "error": "jax not loaded in this process"}
-    import jax
+    """GET /debug/profile/device.json — compatibility delegate. The
+    implementation (and its 503-without-jax contract) moved to the
+    device-plane subsystem, telemetry/device.py `memory_payload()`; the
+    route and JSON envelope are unchanged."""
+    from predictionio_tpu.telemetry import device as _device
 
-    out: Dict = {"backend": None, "devices": [], "live_buffers": {},
-                 "top_buffers": [], "memory_stats": {}}
-    try:
-        out["backend"] = jax.default_backend()
-        out["devices"] = [str(d) for d in jax.devices()]
-    except Exception:  # noqa: BLE001
-        pass
-    try:
-        per_device: Dict[str, Dict] = {}
-        buffers = []
-        for arr in jax.live_arrays():
-            try:
-                dev = str(next(iter(arr.devices())))
-                nbytes = int(arr.nbytes)
-            except Exception:  # noqa: BLE001
-                continue
-            slot = per_device.setdefault(dev, {"count": 0, "bytes": 0})
-            slot["count"] += 1
-            slot["bytes"] += nbytes
-            buffers.append((nbytes, str(arr.shape), str(arr.dtype), dev))
-        out["live_buffers"] = per_device
-        buffers.sort(key=lambda b: -b[0])
-        out["top_buffers"] = [
-            {"bytes": b, "shape": shape, "dtype": dtype, "device": dev}
-            for b, shape, dtype, dev in buffers[:20]]
-    except Exception:  # noqa: BLE001
-        out["live_buffers_error"] = "live_arrays unavailable"
-    try:
-        prof = jax.profiler.device_memory_profile()
-        out["device_memory_profile_bytes"] = len(prof)
-    except Exception:  # noqa: BLE001
-        out["device_memory_profile_bytes"] = None
-    try:
-        for d in jax.local_devices():
-            stats = getattr(d, "memory_stats", None)
-            if callable(stats):
-                s = stats()
-                if s:
-                    out["memory_stats"][str(d)] = {
-                        k: v for k, v in s.items()
-                        if isinstance(v, (int, float))}
-    except Exception:  # noqa: BLE001
-        pass
-    return 200, out
+    return _device.memory_payload()
 
 
 # -- fleet merge (rides PR 9's snapshot channel) -------------------------------

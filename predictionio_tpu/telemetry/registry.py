@@ -476,6 +476,50 @@ def parse_exemplars(text: str) -> Dict[str, Dict[str, object]]:
     return out
 
 
+# -- label-cardinality capping -------------------------------------------------
+#
+# The registry never drops a child, so an unbounded label value (a per-k
+# function label, a raw URL) grows /metrics forever. The middleware caps
+# route labels by collapsing unknown paths to "<other>"; this is the same
+# discipline as a reusable helper for every other label producer.
+
+LABEL_OVERFLOW = "<other>"
+DEFAULT_LABEL_CAP = 64
+
+_label_caps_lock = threading.Lock()
+_label_caps: Dict[str, set] = {}
+
+
+def capped_label(group: str, value: str,
+                 cap: int = DEFAULT_LABEL_CAP) -> str:
+    """Admit `value` into the named label group until `cap` distinct
+    values exist; later never-seen values collapse to ``<other>`` so the
+    family's cardinality is bounded. Values seen before the cap keep
+    resolving to themselves forever (stable series identity)."""
+    value = str(value)
+    with _label_caps_lock:
+        seen = _label_caps.get(group)
+        if seen is None:
+            seen = _label_caps[group] = set()
+        if value in seen:
+            return value
+        if len(seen) < cap:
+            seen.add(value)
+            return value
+    return LABEL_OVERFLOW
+
+
+def reset_label_caps(group: Optional[str] = None) -> None:
+    """Forget admitted label values (tests; fork hygiene is not needed —
+    children inheriting the parent's admitted set is correct, the series
+    already exist in the inherited registry)."""
+    with _label_caps_lock:
+        if group is None:
+            _label_caps.clear()
+        else:
+            _label_caps.pop(group, None)
+
+
 # The process-wide default registry: every server in one process shares it,
 # so a combined deploy (worker pool forks) still exposes one coherent view.
 REGISTRY = MetricsRegistry()
@@ -486,6 +530,8 @@ def _reinit_locks_after_fork() -> None:
     # handler/scraper threads in the parent may hold family locks; a child
     # inheriting a held lock would deadlock on its first metric touch.
     # Locks only guard intra-process consistency, so fresh ones are safe.
+    global _label_caps_lock
+    _label_caps_lock = threading.Lock()
     REGISTRY._lock = threading.Lock()
     for family in REGISTRY._metrics.values():
         new_lock = threading.Lock()

@@ -204,22 +204,29 @@ if hasattr(os, "register_at_fork"):
 
 def record(name: str, duration_s: float,
            start_s: Optional[float] = None,
-           error: bool = False) -> None:
+           error: bool = False,
+           nested: Optional[bool] = None) -> None:
     """Record a pre-measured span into the active timeline (no-op without
     one — storage ops triggered by untimed work, committer threads).
 
     `start_s` is an offset on the timeline's monotonic axis; when omitted
-    the span is assumed to have just ended."""
+    the span is assumed to have just ended. `nested` defaults to "am I
+    inside a live span context"; cross-thread stamps that refine a stage
+    recorded the same way (the batcher's dispatch host/device split) pass
+    it explicitly, since the stage's span context is long gone by the
+    time the waiting thread copies the stamps."""
     tl = _active.get()
     if tl is None:
         return
     if start_s is None:
         start_s = time.monotonic() - tl.t0 - duration_s
-    tl.record(name, start_s, duration_s, error, nested=tl.depth > 0)
+    tl.record(name, start_s, duration_s, error,
+              nested=tl.depth > 0 if nested is None else nested)
 
 
 def record_between(name: str, start_monotonic: float,
-                   end_monotonic: float) -> None:
+                   end_monotonic: float,
+                   nested: Optional[bool] = None) -> None:
     """Record a span from two absolute `time.monotonic()` stamps — the
     shape cross-thread stages arrive in (enqueued_at / taken_at / done
     stamps on a pending queue entry)."""
@@ -228,7 +235,7 @@ def record_between(name: str, start_monotonic: float,
         return
     tl.record(name, start_monotonic - tl.t0,
               max(0.0, end_monotonic - start_monotonic),
-              nested=tl.depth > 0)
+              nested=tl.depth > 0 if nested is None else nested)
 
 
 class span:

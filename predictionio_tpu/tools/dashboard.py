@@ -83,6 +83,15 @@ capture windows at <a href="/debug/profile.json">/debug/profile.json</a>
 (<code>?route=</code>, <code>?seconds=&amp;hz=</code>); device memory at
 <a href="/debug/profile/device.json">/debug/profile/device.json</a>.</p>
 {profile}
+<h2>Device</h2>
+<p>Device plane: per-dispatch device-time attribution (route × jitted
+fn × batch tier), the jit-cache inventory with retrace blame, and
+device-memory headroom. Full inventory at
+<a href="/debug/jit.json">/debug/jit.json</a>; raw families:
+<code>device_*</code>, <code>jit_*</code> on
+<a href="/metrics">/metrics</a>; runbook in
+<code>docs/observability.md</code>.</p>
+{device}
 <h2>Experiments</h2>
 <p>Experimentation plane: per-variant routed traffic by outcome, the
 sliding-window traffic share, and each arm's Beta reward posterior
@@ -498,6 +507,63 @@ def _profile_table() -> str:
     return "".join(out)
 
 
+def _device_table() -> str:
+    """Device panel: attribution rows from the device clock, the jit
+    inventory totals per fn, and the latest retrace blame lines."""
+    from predictionio_tpu.telemetry import device
+
+    _status, body = device.jit_payload()
+    out = []
+    clock = body.get("clock", {})
+    totals = body.get("totals", {})
+    out.append(
+        "<p>Clock %s (backend <code>%s</code>) — %d compiles, %d "
+        "dispatches, %d retraces across %d jitted fns.</p>" % (
+            "running" if clock.get("running") else
+            ("enabled" if clock.get("enabled") else
+             "disabled (<code>PIO_DEVICE_CLOCK=0</code>)"),
+            html.escape(str(clock.get("backend", "?"))),
+            totals.get("compiles", 0), totals.get("dispatches", 0),
+            totals.get("retraces", 0), len(body.get("fns", {}))))
+    attribution = body.get("device_attribution") or []
+    if attribution:
+        out.append("<table><tr><th>Route</th><th>Fn</th><th>Tier</th>"
+                   "<th>Device</th><th>Device time</th>"
+                   "<th>Dispatches</th></tr>")
+        for row in attribution[:12]:
+            out.append(
+                f"<tr><td>{html.escape(str(row['route']))}</td>"
+                f"<td><code>{html.escape(str(row['fn']))}</code></td>"
+                f"<td>{html.escape(str(row['tier']) or '—')}</td>"
+                f"<td>{html.escape(str(row['device']))}</td>"
+                f"<td>{row['us'] / 1e6:.3f}s</td>"
+                f"<td>{row['dispatches']}</td></tr>")
+        out.append("</table>")
+    else:
+        out.append("<p>No attributed dispatches yet.</p>")
+    blames = []
+    for fn, rec in sorted(body.get("fns", {}).items()):
+        for b in rec.get("retrace_blame", ())[-2:]:
+            blames.append((fn, b))
+    if blames:
+        out.append("<table><tr><th>Fn</th><th>Retrace blame</th></tr>")
+        for fn, b in blames[-8:]:
+            out.append(
+                f"<tr><td><code>{html.escape(fn)}</code></td>"
+                f"<td><code>"
+                f"{html.escape('; '.join(b.get('changed', ())) or '?')}"
+                f"</code></td></tr>")
+        out.append("</table>")
+    mem = REGISTRY.get("device_mem_headroom_ratio")
+    if mem is not None:
+        for key, value in sorted(mem.collect()):
+            out.append(
+                "<p>HBM headroom <code>%s</code>: %.1f%%.</p>"
+                % (html.escape(_label_str(mem.labelnames, key)),
+                   value * 100.0))
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -552,6 +618,7 @@ class Dashboard(HttpService):
                     flight=_flight_table(),
                     lineage=_lineage_table(),
                     profile=_profile_table(),
+                    device=_device_table(),
                     experiment=_experiment_table(),
                     hotpath=_hotpath_table(),
                     telemetry=_telemetry_table(),

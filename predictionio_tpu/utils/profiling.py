@@ -26,8 +26,9 @@ import os
 import time
 from typing import Any, Optional, TextIO
 
+from predictionio_tpu.telemetry import device as device_telemetry
 from predictionio_tpu.telemetry import spans
-from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.telemetry.registry import REGISTRY, capped_label
 
 log = logging.getLogger(__name__)
 
@@ -68,11 +69,20 @@ def metered_jit(fn, label: Optional[str] = None, **jit_kwargs):
     The compile also lands on the calling request's span timeline (when
     one is active) as `jit.compile.<label>` — a latency cliff in the
     flight recorder names its cause instead of looking like a slow
-    dispatch."""
+    dispatch.
+
+    Every dispatch also feeds the device plane
+    (telemetry/device.py): the jit-cache inventory behind
+    /debug/jit.json (per-signature compile/dispatch counts, retrace
+    blame) and the device clock's `device_seconds_total` attribution.
+    Labels pass through `capped_label` so a caller minting one label per
+    runtime value (the old ranking.score_topk_k{k} bug) cannot grow
+    /metrics without bound."""
     import jax
 
-    jitted = jax.jit(fn, **jit_kwargs)
-    name = label or getattr(fn, "__name__", "jit")
+    # the wrapper itself is the metering boundary
+    jitted = jax.jit(fn, **jit_kwargs)  # pio-lint: disable=coverage-jit-metering
+    name = capped_label("jit_fn", label or getattr(fn, "__name__", "jit"))
     compiles = JIT_COMPILES.labels(fn=name)
     seconds = JIT_COMPILE_SECONDS.labels(fn=name)
     cache_size = getattr(jitted, "_cache_size", None)
@@ -97,13 +107,22 @@ def metered_jit(fn, label: Optional[str] = None, **jit_kwargs):
         before = cache_size()
         t0 = time.perf_counter()
         out = jitted(*args, **kwargs)
-        if cache_size() > before:
-            elapsed = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        compiled = cache_size() > before
+        elapsed = t1 - t0
+        if compiled:
             compiles.inc()
             seconds.observe(elapsed)
             spans.record(span_name, elapsed)
             log.info("profiling: %s compiled (cache %d -> %d, %.3fs)",
                      name, before, cache_size(), elapsed)
+        try:
+            device_telemetry.record_dispatch(
+                name, args, kwargs, out=out, t0=t0, t1=t1,
+                compiled=compiled, compile_s=elapsed if compiled else 0.0)
+        except Exception:  # noqa: BLE001 — telemetry must not fail dispatch
+            log.debug("profiling: device record failed for %s", name,
+                      exc_info=True)
         return out
 
     # the underlying jitted callable, for callers that need .lower() /

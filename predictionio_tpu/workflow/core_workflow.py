@@ -27,6 +27,7 @@ from predictionio_tpu.controller.evaluation import (
     MetricEvaluator,
 )
 from predictionio_tpu.storage.base import EngineInstance, EvaluationInstance, Model
+from predictionio_tpu.telemetry import device as device_telemetry
 from predictionio_tpu.telemetry import spans, tracing
 from predictionio_tpu.telemetry.recorder import RECORDER
 from predictionio_tpu.workflow.workflow_utils import (
@@ -93,7 +94,10 @@ class CoreWorkflow:
 
         p_rank = persist_rank() if jax.process_count() > 1 else 0
         if jax.process_count() > 1 and jax.process_index() != p_rank:
-            models = engine.train(ctx, engine_params, sanity_check=sanity_check)
+            with device_telemetry.attribution("workflow.train",
+                                              tier="train"):
+                models = engine.train(ctx, engine_params,
+                                      sanity_check=sanity_check)
             log.info("CoreWorkflow.run_train: rank %d trained %d model(s); "
                      "rank %d persists", jax.process_index(), len(models),
                      p_rank)
@@ -136,11 +140,18 @@ class CoreWorkflow:
             with tracked_instance(instances, instance,
                                   label="CoreWorkflow.run_train"):
                 with spans.span("workflow.train"):
-                    models = engine.train(ctx, engine_params,
-                                          sanity_check=sanity_check)
+                    # device attribution: every jitted train step bills
+                    # its device-seconds to the workflow.train route,
+                    # tiered by stage
+                    with device_telemetry.attribution("workflow.train",
+                                                      tier="train"):
+                        models = engine.train(ctx, engine_params,
+                                              sanity_check=sanity_check)
                 with spans.span("workflow.serialize"):
-                    blob = engine.serialize_models(models, instance.id,
-                                                   engine_params)
+                    with device_telemetry.attribution("workflow.train",
+                                                      tier="serialize"):
+                        blob = engine.serialize_models(models, instance.id,
+                                                       engine_params)
                 with spans.span("workflow.persist"):
                     storage.model_data_models().insert(
                         Model(id=instance.id, models=blob))

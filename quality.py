@@ -33,9 +33,15 @@ def main():
                         "history sampling held under the 5% overhead "
                         "bar), if the always-on stack sampler is not "
                         "live with /debug/profile.json non-empty under "
-                        "load at ≤5% p95 overhead (profiler drill), or "
-                        "if the fleet-merged flamegraph's sample count "
-                        "differs from the exact per-worker sum / "
+                        "load at ≤5% p95 overhead (profiler drill), if "
+                        "the jit-cache inventory at /debug/jit.json is "
+                        "empty or inconsistent under load, misses the "
+                        "retrace blame for a shape outside the warmed "
+                        "bucket ladder, drops route attribution, or the "
+                        "device clock exceeds the 5% overhead bar "
+                        "(device drill), or if the fleet-merged "
+                        "flamegraph's sample count / device-microsecond "
+                        "total differs from the exact per-worker sum / "
                         "misattributes the seeded burn route")
     p.add_argument("--serving-gate", action="store_true",
                    help="run the serving CI gate (no jax, no data): fails "
