@@ -1678,6 +1678,7 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
         ONLINE_FOLDIN_SECONDS,
     )
     from predictionio_tpu.storage.base import AccessKey
+    from predictionio_tpu.telemetry.tenant import TENANT_FRESHNESS
 
     storage = _storage()
     app_id = _train(storage)
@@ -1746,6 +1747,9 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
             base_counts, base_count = list(e2s.counts), e2s.count
             base_sum = e2s.sum
             fold_base = (list(fold_h.counts), fold_h.count)
+            # per-app baseline of the tenant slice of the same histogram
+            ten_base = {lv[0]: (list(c), n) for lv, (c, _s, n)
+                        in TENANT_FRESHNESS.collect()}
 
             def writer(w):
                 i = 0
@@ -1840,6 +1844,27 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
         crosscheck = ext_p95 == p95
     else:
         crosscheck = (ext_p95 <= p95 * 1.10) and (p95 <= ext_p95 * 1.10)
+    # per-tenant p95 split over the same window: the window's delta of
+    # each app child of tenant_event_to_servable_seconds, read on the
+    # same bucket-upper-bound statistic as the untagged north star —
+    # shows which app's events paid the freshness latency
+    ten_buckets = TENANT_FRESHNESS.buckets
+    per_tenant = {}
+    for lv, (counts, _s, count) in TENANT_FRESHNESS.collect():
+        app = lv[0]
+        b_counts, b_count = ten_base.get(
+            app, ([0] * len(ten_buckets), 0))
+        d_counts = [c - b for c, b in zip(counts, b_counts)]
+        total = count - b_count
+        if total <= 0:
+            continue
+        acc, target, tp95 = 0, 0.95 * total, float("inf")
+        for bound, c in zip(ten_buckets, d_counts):
+            acc += c
+            if acc >= target:
+                tp95 = bound
+                break
+        per_tenant[app] = {"p95_s": tp95, "events": total}
     record = {
         # bucket upper bound: the honest (pessimistic) histogram read
         "metric": "online_event_to_servable_p95_s",
@@ -1863,6 +1888,10 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
             "server_p95_s": p95,
             "crosscheck_pass": crosscheck,
         },
+        # per-app slice of the same window (tenant_event_to_servable_
+        # seconds); the bench's single app should dominate, but the key
+        # exists so multi-app runs split their freshness bill by tenant
+        "per_tenant": per_tenant,
         "poll_interval_s": interval_s,
         "writers": writers,
         "query_clients": query_clients,

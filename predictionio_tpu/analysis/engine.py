@@ -226,6 +226,7 @@ def load_default_rules() -> None:
         coverage,
         eventloop,
         gates,
+        labels,
         lockgraph,
         shapes,
     )

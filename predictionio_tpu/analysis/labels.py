@@ -1,0 +1,106 @@
+"""Rule pack (g): the metric-label cardinality rule.
+
+A Prometheus-style label value becomes a forever-live child series: one
+series per distinct value, per family, held in the registry until
+process exit. A label fed from request or user data (an event name, an
+app id, an entity id) is therefore an unbounded-memory bug AND a scrape
+amplifier — one hostile client can mint millions of series.
+
+The repo's discipline: any label value derived from request/user input
+must flow through ``registry.capped_label`` (admit per-group up to a
+cap, then collapse to ``<other>``) or its tenant-scoped wrapper
+``tenant.tenant_label``. Infrastructure-derived values (route templates,
+worker slots, variant names from config) are bounded by construction
+and exempt.
+
+The rule flags ``<METRIC_CONST>.labels(...)`` call sites — the repo
+binds metric families to module-level ALL_CAPS constants — where a
+label value expression references a request-derived name (``event``,
+``app_id``, ``entity_id``, ``req``, ``body``, ... — the taint roots
+below) and the expression does not pass through a recognized capping
+helper.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from predictionio_tpu.analysis import astutil
+from predictionio_tpu.analysis.engine import Finding, Project, rule
+
+# Names whose value, by repo convention, came off the wire or out of a
+# client-controlled record. Deliberately NOT here: route (bounded by
+# route_template), server/worker/slot/variant/stage/reason (config- or
+# code-enumerated), status (the int space is tiny).
+_TAINT_ROOTS = frozenset({
+    "event", "events", "event_name", "req", "request", "body", "payload",
+    "headers", "params", "app_id", "appid", "channel", "channel_id",
+    "channel_name", "user", "uid", "user_id", "entity_id", "entity_type",
+    "target_entity_id", "target_entity_type", "key", "access_key",
+    "query",
+})
+
+# Calls that bound a value's cardinality before it becomes a label.
+_CAPPING_HELPERS = frozenset({"capped_label", "tenant_label"})
+
+
+def _is_metric_const(recv: ast.AST) -> bool:
+    t = astutil.terminal_name(recv)
+    return bool(t) and len(t) > 1 and t.isupper()
+
+
+def _is_capped(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            if astutil.terminal_name(n) in _CAPPING_HELPERS:
+                return True
+    return False
+
+
+def _tainted_name(expr: ast.AST) -> Optional[str]:
+    """The first request-derived name the expression references, or
+    None. Both bare names (``event_name``) and attribute tails
+    (``e.entity_id``, ``req.body``) count."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in _TAINT_ROOTS:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in _TAINT_ROOTS:
+            return n.attr
+    return None
+
+
+@rule("no-unbounded-metric-labels",
+      "request/user-derived metric label values must flow through "
+      "registry.capped_label (or tenant.tenant_label) so one hostile "
+      "client cannot mint unbounded series")
+def no_unbounded_metric_labels(project: Project) -> Iterable[Finding]:
+    for mod in project.modules():
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"
+                    and _is_metric_const(node.func.value)):
+                continue
+            metric = astutil.terminal_name(node.func.value)
+            for kw in node.keywords:
+                if kw.arg is None:     # **kwargs: opaque, skip
+                    continue
+                if _is_capped(kw.value):
+                    continue
+                taint = _tainted_name(kw.value)
+                if taint is None:
+                    continue
+                yield Finding(
+                    "no-unbounded-metric-labels", mod.rel, node.lineno,
+                    f"{metric}.labels({kw.arg}=...) feeds the "
+                    f"request-derived value {taint!r} into a label "
+                    f"without a cardinality cap — every distinct value "
+                    f"mints a forever-live series",
+                    symbol=f"{metric}.{kw.arg}",
+                    hint="wrap the value in registry.capped_label("
+                         "group, value) (or tenant.tenant_label for "
+                         "app ids) so the registry collapses the tail "
+                         "to <other>")

@@ -36,9 +36,9 @@ import time
 from typing import Optional
 from urllib.parse import parse_qs
 
-from predictionio_tpu.telemetry import lineage, spans, tracing
+from predictionio_tpu.telemetry import lineage, spans, tenant, tracing
 from predictionio_tpu.telemetry.middleware import DEBUG_HEADER
-from predictionio_tpu.telemetry.registry import REGISTRY
+from predictionio_tpu.telemetry.registry import REGISTRY, capped_label
 from predictionio_tpu.utils import fastjson
 from predictionio_tpu.utils.http import HttpService
 from predictionio_tpu.utils.routing import (
@@ -90,15 +90,22 @@ class Stats:
         return dict(EVENTS_TOTAL.collect())
 
     def update(self, app_id: int, event_name: str, status: int) -> None:
-        EVENTS_TOTAL.labels(app_id=str(app_id), event=event_name,
+        # both label values are request-derived (the app from the access
+        # key, the event name straight from the client payload) — capped
+        # so a junk-event flood can't grow /metrics forever. App ids share
+        # the "tenant" cap group so the eventserver stats and the tenant
+        # meter agree on which apps keep stable series identity.
+        EVENTS_TOTAL.labels(app_id=tenant.tenant_label(str(app_id)),
+                            event=capped_label("event_name", event_name),
                             status=str(status)).inc()
 
     def snapshot(self, app_id: int) -> dict:
         base = self._baseline
         items = []
+        target = tenant.tenant_label(str(app_id))
         for (aid, ev, status), n in sorted(self._totals().items()):
             n -= base.get((aid, ev, status), 0)
-            if aid == str(app_id) and n > 0:
+            if aid == target and n > 0:
                 items.append({"event": ev, "status": int(status),
                               "count": int(n)})
         return {"uptime_s": round(time.time() - self.start_time, 1), "counts": items}
@@ -118,6 +125,38 @@ class EventServerConfig:
 # on a long-lived server — deletions are rare admin actions, ingest auth
 # is per-request hot path.
 _AKEY_CACHE_TTL_S = 5.0
+
+
+def _authed(handler):
+    """Auth + tenant binding + per-tenant metering around one route
+    handler (decorator, so router registrations still point straight at
+    the handler defs for the static gates). The app id resolved from the
+    access key is activated on the tenant contextvar for the duration of
+    the handler, so every downstream plane (lineage mint, group commit,
+    device dispatch) attributes its work without re-resolving the key."""
+
+    def wrapped(self, req: Request) -> Response:
+        auth = self._auth(req)
+        if auth is None:
+            tenant.record_request("eventserver", "unauthorized",
+                                  status=401)
+            return self._UNAUTHORIZED
+        _, app_id, _ = auth
+        t0 = time.monotonic()
+        with tenant.bound(app_id, "access_key"):
+            resp = handler(self, req, auth)
+        status = resp.status
+        outcome = ("ok" if status < 400 else
+                   "shed" if status == 429 else
+                   "rejected" if status < 500 else "error")
+        tenant.record_request("eventserver", outcome, app=str(app_id),
+                              status=status,
+                              duration_s=time.monotonic() - t0)
+        return resp
+
+    wrapped.__name__ = getattr(handler, "__name__", "authed")
+    wrapped.__doc__ = handler.__doc__
+    return wrapped
 
 _ALIVE = Response(200, body=fastjson.dumps_bytes({"status": "alive"}))
 
@@ -152,7 +191,13 @@ class _EventRoutes:
 
     # -- helpers -----------------------------------------------------------
     def _auth(self, req: Request):
-        """Resolve access key → (AccessKey, app_id, channel_id) or None."""
+        """Resolve access key → (AccessKey, app_id, channel_id) or None.
+
+        The cache entry carries the resolved app id explicitly — it is
+        the tenant-attribution root, not just a pass/fail bit — and
+        `invalidate_access_key` drops entries eagerly so a revoked or
+        rotated key stops authenticating (and stops attributing work to
+        its app) immediately instead of after the TTL."""
         q = req.params
         key = q.get("accessKey")
         if key is None:
@@ -168,7 +213,7 @@ class _EventRoutes:
             return None
         now = time.monotonic()
         cached = self.akey_cache.get(key)
-        if cached is not None and cached[1] > now:
+        if cached is not None and cached[2] > now:
             access_key = cached[0]
         else:
             access_key = self.storage.meta_access_keys().get(key)
@@ -176,7 +221,8 @@ class _EventRoutes:
                 # plain dict mutation is atomic under the GIL; misses
                 # (bad keys) are NOT cached, so a flood of junk keys
                 # cannot grow this beyond the real key population
-                self.akey_cache[key] = (access_key, now + _AKEY_CACHE_TTL_S)
+                self.akey_cache[key] = (access_key, access_key.app_id,
+                                        now + _AKEY_CACHE_TTL_S)
         if access_key is None:
             return None
         channel_id = None
@@ -190,6 +236,16 @@ class _EventRoutes:
                 return None
             channel_id = channels[channel_name].id
         return access_key, access_key.app_id, channel_id
+
+    def invalidate_access_key(self, key: Optional[str] = None) -> None:
+        """Drop one key (or all of them) from the positive auth cache.
+        Admin paths that revoke or rotate keys call this so the old key
+        can't keep serving — or attributing usage to its app — for up to
+        _AKEY_CACHE_TTL_S after the row is gone."""
+        if key is None:
+            self.akey_cache.clear()
+        else:
+            self.akey_cache.pop(key, None)
 
     _UNAUTHORIZED = Response(
         401, body=fastjson.dumps_bytes({"message": "Invalid accessKey."}))
@@ -246,10 +302,8 @@ class _EventRoutes:
     def _handle_root(self, req: Request) -> Response:
         return _ALIVE
 
-    def _handle_find(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_find(self, req: Request, auth) -> Response:
         _, app_id, channel_id = auth
         q = req.params
         try:
@@ -270,10 +324,8 @@ class _EventRoutes:
             return Response.message(400, str(e))
         return Response.json(200, [e.to_dict() for e in events])
 
-    def _handle_get_event(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_get_event(self, req: Request, auth) -> Response:
         _, app_id, channel_id = auth
         eid = path_param(req.path, "/events/", ".json")
         event = self.storage.l_events().get(eid, app_id, channel_id)
@@ -281,20 +333,16 @@ class _EventRoutes:
             return Response.message(404, "Not Found")
         return Response.json(200, event.to_dict())
 
-    def _handle_stats(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_stats(self, req: Request, auth) -> Response:
         _, app_id, _ = auth
         if self.stats is None:
             return Response.message(
                 404, "To see stats, launch Event Server with --stats.")
         return Response.json(200, self.stats.snapshot(app_id))
 
-    def _handle_insert(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_insert(self, req: Request, auth) -> Response:
         access_key, app_id, channel_id = auth
         try:
             d = fastjson.loads(req.body or b"{}")
@@ -310,12 +358,11 @@ class _EventRoutes:
             if self.stats:
                 self.stats.update(app_id, "<invalid>", 400)
             return Response.message(400, str(e))
+        tenant.record_commit_bytes(app_id, len(req.body or b""))
         return Response(201, body=fastjson.event_id_response(eid))
 
-    def _handle_batch(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_batch(self, req: Request, auth) -> Response:
         access_key, app_id, channel_id = auth
         try:
             items = fastjson.loads(req.body or b"[]")
@@ -386,15 +433,20 @@ class _EventRoutes:
                 lineage.LINEAGE.record_stage(event.lineage_ctx, "commit")
                 if self.stats:
                     self.stats.update(app_id, event.event, 201)
+            committed = sum(1 for r in results
+                            if r and r.get("status") == 201)
+            if committed:
+                # insert_batch bypasses the group-commit writer, so this
+                # route meters its own rows; body bytes attributed once
+                tenant.record_storage_rows(app_id, committed,
+                                           nbytes=len(req.body or b""))
             self.ingest.notify_committed(
                 [e for (_, e), eid in zip(prepared, ids)
                  if eid is not None and not isinstance(eid, Exception)])
         return Response.json(200, results)
 
-    def _handle_webhook(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_webhook(self, req: Request, auth) -> Response:
         access_key, app_id, channel_id = auth
         form = req.headers.get("Content-Type", "").startswith(
             "application/x-www-form-urlencoded")
@@ -423,12 +475,11 @@ class _EventRoutes:
         except (EventValidationError, json.JSONDecodeError, ValueError,
                 KeyError) as e:
             return Response.message(400, str(e))
+        tenant.record_commit_bytes(app_id, len(req.body or b""))
         return Response(201, body=fastjson.event_id_response(eid))
 
-    def _handle_delete(self, req: Request) -> Response:
-        auth = self._auth(req)
-        if auth is None:
-            return self._UNAUTHORIZED
+    @_authed
+    def _handle_delete(self, req: Request, auth) -> Response:
         _, app_id, channel_id = auth
         eid = path_param(req.path, "/events/", ".json")
         ok = self.storage.l_events().delete(eid, app_id, channel_id)
@@ -479,6 +530,11 @@ class EventServer(HttpService):
         super().__init__(config.ip, config.port,
                          router=self.routes.router(),
                          server_name="eventserver")
+
+    def invalidate_access_key(self, key: Optional[str] = None) -> None:
+        """Admin hook: evict a revoked/rotated key (or all keys) from the
+        5s-TTL auth cache so it stops authenticating immediately."""
+        self.routes.invalidate_access_key(key)
 
     def shutdown(self) -> None:
         # stop accepting first, then drain the write plane: in-flight

@@ -47,7 +47,7 @@ from collections import deque
 from typing import Callable, List, Optional, Tuple
 
 from predictionio_tpu.ingest.invalidation import BUS
-from predictionio_tpu.telemetry import spans
+from predictionio_tpu.telemetry import spans, tenant
 from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
 from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -319,6 +319,7 @@ class GroupCommitWriter:
             _COMMIT_SECONDS.observe(commit_s)
         LINEAGE.record_stage(context_of(event), "commit",
                              duration_s=commit_s)
+        tenant.record_storage_rows(app_id, 1)
         self.notify_committed((event,))
         return eid
 
@@ -417,6 +418,7 @@ class GroupCommitWriter:
                     p.commit_s = time.perf_counter() - t_item
                     LINEAGE.record_stage(context_of(p.item[0]), "commit",
                                          duration_s=p.commit_s)
+                    tenant.record_storage_rows(p.item[1], 1)
                     # invalidate BEFORE acknowledging: the waiter's 201
                     # must imply the cache no longer serves stale answers
                     self.notify_committed((p.item[0],))
@@ -430,9 +432,13 @@ class GroupCommitWriter:
         commit_s = time.perf_counter() - t0
         _COMMIT_SECONDS.observe(commit_s)
         now = time.time()
+        rows_by_app: dict = {}
         for p in group:
             LINEAGE.record_stage(context_of(p.item[0]), "commit",
                                  duration_s=commit_s, now=now)
+            rows_by_app[p.item[1]] = rows_by_app.get(p.item[1], 0) + 1
+        for gapp, n in rows_by_app.items():
+            tenant.record_storage_rows(gapp, n)
         self.notify_committed([p.item[0] for p in group])
         for p, eid in zip(group, ids):
             p.commit_s = commit_s

@@ -49,7 +49,7 @@ from predictionio_tpu.online.metrics import (
 )
 from predictionio_tpu.online.swap import DeltaSwapper, StaleState
 from predictionio_tpu.ops.als import ALSConfig
-from predictionio_tpu.telemetry import slo, tracing
+from predictionio_tpu.telemetry import slo, tenant, tracing
 from predictionio_tpu.telemetry.lineage import LINEAGE, context_of
 from predictionio_tpu.utils import faults
 
@@ -425,9 +425,16 @@ class OnlinePlane:
                 else:
                     ONLINE_EVENT_TO_SERVABLE.observe(age)
                 samples.append((200, age))
+                # per-tenant freshness slice: the envelope's app (minted
+                # at the auth boundary) wins over the tailer's app_id so
+                # cross-app replays attribute to the event's true owner
+                tenant.observe_freshness(
+                    (lctx.app if lctx is not None and lctx.app
+                     else app_id), age)
                 LINEAGE.complete(lctx, freshness_s=age)
             slo.observe_many("online", "event_to_servable", samples)
             ONLINE_EVENTS_FOLDED.inc(len(model_events))
+            tenant.record_folded(app_id, len(model_events))
             self.events_folded += len(model_events)
         ONLINE_FOLDIN_SECONDS.observe(time.perf_counter() - t0)
         return len(model_events) if folded_any else 0

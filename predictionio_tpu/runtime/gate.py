@@ -110,9 +110,13 @@ class StubPredictionServer(HttpService):
                 "gate.stub_score", (len(queries),), out=None, t0=t0)
             return out
 
+        # same env override create_server honors — the telemetry gate's
+        # fleet drill binds every stub worker to one app so the merged
+        # tenant view has attributed (not just "-") work to check
         self.serving = ServingPlane(
             _dispatch, config=ServingConfig.from_env(),
-            name="predictionserver")
+            name="predictionserver",
+            app=os.environ.get("PIO_TENANT_APP", ""))
 
         class Handler(JsonRequestHandler):
             server_version = "pio-tpu-chaos-stub/0.1"

@@ -628,6 +628,9 @@ class Supervisor:
         # …and its /debug/jit.json serves the fleet-merged device view
         telemetry_middleware.set_device_renderer(
             "supervisor", self._render_fleet_device)
+        # …and its /debug/tenants.json serves the fleet-merged per-app view
+        telemetry_middleware.set_tenants_renderer(
+            "supervisor", self._render_fleet_tenants)
 
         if self.cfg.control_port is not None:
             try:
@@ -671,6 +674,7 @@ class Supervisor:
             telemetry_middleware.set_profile_renderer("supervisor", None)
             telemetry_middleware.set_lineage_renderer("supervisor", None)
             telemetry_middleware.set_device_renderer("supervisor", None)
+            telemetry_middleware.set_tenants_renderer("supervisor", None)
             if self._control is not None:
                 try:
                     self._control.shutdown()
@@ -1138,6 +1142,20 @@ class Supervisor:
             parts.append((str(snap.get("worker", "?")),
                           snap.get("device")))
         return 200, device.merge_device(parts)
+
+    def _render_fleet_tenants(self) -> tuple:
+        """The control endpoint's /debug/tenants.json: every worker's
+        tenant-meter export (riding the same snapshot fetch as the metric
+        merge) plus the supervisor's own, merged by tenant.merge_tenants —
+        which ASSERTS sum-exactness (sum over tenant labels, including the
+        unattributed "-" bucket, equals the untagged totals) before the
+        per-app top-K view is built."""
+        from predictionio_tpu.telemetry import tenant
+        parts = [("supervisor", tenant.export_state())]
+        for snap in self._worker_snapshots():
+            parts.append((str(snap.get("worker", "?")),
+                          snap.get("tenant")))
+        return 200, tenant.payload(merged=tenant.merge_tenants(parts))
 
     def _render_fleet_lineage(self, trace_id=None, limit: int = 100) -> tuple:
         """The control endpoint's /debug/lineage routes: every worker's

@@ -23,24 +23,31 @@ consistent serving posture without plumbing flags through exec.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import os
+import time
 from typing import Callable, List, Optional, Tuple
 
 from predictionio_tpu.serving.admission import (
     AdmissionConfig,
     AdmissionController,
+    DeadlineExceeded,
     ShedLoad,
     deadline_from_headers,
 )
 from predictionio_tpu.serving.batcher import BatcherConfig, MicroBatcher
 from predictionio_tpu.serving.result_cache import MISS, ResultCache, cache_from_env
-from predictionio_tpu.telemetry import spans
+from predictionio_tpu.telemetry import spans, tenant
 from predictionio_tpu.telemetry.registry import REGISTRY
 from predictionio_tpu.utils import faults
 
 log = logging.getLogger(__name__)
+
+# planes with no app binding still meter (under "-") but skip the
+# contextvar set/reset on the hot path
+_NO_TENANT = contextlib.nullcontext()
 
 DEGRADED = REGISTRY.counter(
     "serving_degraded_total",
@@ -103,7 +110,12 @@ class ServingPlane:
     admission sheds; raise/return None to decline.
     `variant` — the engine variant this plane serves; scopes the result
     cache's keys so answers never leak across variants when several
-    planes live behind one route (experiment/router.py)."""
+    planes live behind one route (experiment/router.py).
+    `app` — the app id this engine/variant is bound to (the serving-side
+    tenant root, resolved once at server construction); every query is
+    handled under this tenant binding so downstream device dispatches
+    attribute to it, and metered as tenant_requests_total by outcome
+    (cache_hit vs ok gives the per-tenant result-cache slice hit rate)."""
 
     def __init__(self,
                  dispatch_fn: Callable[[List], List],
@@ -111,9 +123,11 @@ class ServingPlane:
                  config: Optional[ServingConfig] = None,
                  name: str = "predictionserver",
                  result_cache: Optional[ResultCache] = None,
-                 variant: str = ""):
+                 variant: str = "",
+                 app: str = ""):
         self.config = config or ServingConfig()
         self.variant = variant
+        self.app = str(app) if app else ""
 
         # Optional per-user result cache (OFF unless PIO_HTTP_RESULT_CACHE
         # opts in, or one is passed explicitly). Kept read-your-writes by
@@ -141,8 +155,14 @@ class ServingPlane:
         # model runs — the chaos gate arms delay:/error modes here to turn
         # a live worker slow or erroring without killing it. One site in
         # the plane covers every serving surface (batched and direct).
+        # The tenant re-bind matters on the batched path: the batcher's
+        # worker thread never saw the request thread's contextvar, and a
+        # plane's batcher only ever carries this plane's (single) app.
         def _faultable_dispatch(queries: List) -> List:
             faults.inject("serving.pre_dispatch")
+            if self.app:
+                with tenant.bound(self.app, "variant"):
+                    return dispatch_fn(queries)
             return dispatch_fn(queries)
 
         self.dispatch_fn = _faultable_dispatch
@@ -162,13 +182,39 @@ class ServingPlane:
 
         Raises ShedLoad (→ 429) when saturated and no degraded answer
         exists; DeadlineExceeded (→ 503) when the request's deadline
-        expired before a result was produced."""
+        expired before a result was produced.
+
+        Runs under the plane's tenant binding: the queue/dispatch spans,
+        the device clock's dispatch accounting, and the per-request
+        metering below all attribute to `self.app`."""
+        t0 = time.monotonic()
+        with tenant.bound(self.app, "variant") if self.app else _NO_TENANT:
+            try:
+                result, degraded, outcome = self._handle_query(query, headers)
+            except ShedLoad:
+                self._meter("shed", 429, t0)
+                raise
+            except DeadlineExceeded:
+                self._meter("deadline", 503, t0)
+                raise
+            except Exception:
+                self._meter("error", 500, t0)
+                raise
+        self._meter(outcome, 200, t0)
+        return result, degraded
+
+    def _meter(self, outcome: str, status: int, t0: float) -> None:
+        tenant.record_request("predictionserver", outcome,
+                              app=self.app or None, status=status,
+                              duration_s=time.monotonic() - t0)
+
+    def _handle_query(self, query, headers) -> Tuple[object, bool, str]:
         cache = self.result_cache
         if cache is not None:
             with spans.span("serving.result_cache"):
                 hit = cache.get(query, self.variant)
             if hit is not MISS:
-                return hit, False
+                return hit, False, "cache_hit"
         deadline = deadline_from_headers(headers, self.config.admission)
         try:
             with spans.span("serving.admission"):
@@ -176,7 +222,7 @@ class ServingPlane:
         except ShedLoad:
             degraded = self._try_degraded(query)
             if degraded is not None:
-                return degraded, True
+                return degraded, True, "degraded"
             raise
         try:
             if self.batcher is not None:
@@ -190,7 +236,7 @@ class ServingPlane:
             # full-quality results only: a degraded answer must never
             # outlive the saturation that produced it
             cache.put(query, result, self.variant)
-        return result, False
+        return result, False, "ok"
 
     def _try_degraded(self, query):
         if self.degraded_fn is None:

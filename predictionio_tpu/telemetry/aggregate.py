@@ -113,9 +113,16 @@ def snapshot_registry(registry: MetricsRegistry = REGISTRY,
         device = _device.export_state()
     except Exception:  # noqa: BLE001 — snapshots must not break on this
         device = None
+    # The tenant meter's integer cells ride along so the fleet per-app
+    # view merges sum-exact (sum over tenant labels == untagged totals).
+    try:
+        from predictionio_tpu.telemetry import tenant as _tenant
+        tenant = _tenant.export_state()
+    except Exception:  # noqa: BLE001 — snapshots must not break on this
+        tenant = None
     return {"worker": worker or worker_label(), "pid": os.getpid(),
             "ts": time.time(), "families": families, "profile": profile,
-            "lineage": lineage, "device": device}
+            "lineage": lineage, "device": device, "tenant": tenant}
 
 
 class SnapshotServer:

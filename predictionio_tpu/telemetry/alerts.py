@@ -229,6 +229,16 @@ def default_rules() -> List[AlertRule]:
                   metric="device_mem_headroom_ratio",
                   stat="min", op="<", value=0.10, window_s=300.0,
                   severity="page"),
+        # Per-tenant burn: the tenant meter registers one SLO objective
+        # per app under server="tenant" (telemetry/tenant.py), so max
+        # across routes pages on the WORST app without a rule per app.
+        # /debug/tenants.json then names which app is burning. Silent
+        # (measure() → None) until the first attributed request.
+        AlertRule(name="tenant-burn-5m", kind="burn_rate",
+                  metric="slo_error_budget_burn_rate",
+                  labels={"window": "5m", "server": "tenant"},
+                  stat="max", value=14.4, window_s=60.0,
+                  severity="ticket"),
     ]
 
 

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from predictionio_tpu.telemetry import tenant
 from predictionio_tpu.telemetry.registry import REGISTRY, capped_label
 
 log = logging.getLogger(__name__)
@@ -321,7 +322,8 @@ def _record_inventory(fn: str, sig: Tuple[str, ...], compiled: bool,
                  "; ".join(blame["changed"]) or "no cached signature diff")
 
 
-def _account(route: str, fn: str, tier: str, device: str, us: int) -> None:
+def _account(route: str, fn: str, tier: str, device: str, us: int,
+             app: Optional[str] = None) -> None:
     us = max(0, int(us))
     key = (route, fn, tier, device)
     with _attr_lock:
@@ -333,6 +335,9 @@ def _account(route: str, fn: str, tier: str, device: str, us: int) -> None:
     labels = dict(route=route, fn=fn, tier=tier, device=device)
     DEVICE_SECONDS.labels(**labels).inc(us / 1e6)
     DEVICE_DISPATCHES.labels(**labels).inc()
+    # tenant dimension: the same integer microseconds land in the tenant
+    # meter, so sum over tenant labels (incl. "-") == device total exactly
+    tenant.record_device_us(us, app=app)
 
 
 # -- the device clock ----------------------------------------------------------
@@ -394,13 +399,18 @@ class DeviceClock:
         DEVICE_CLOCK_QUEUE.set(0)
 
     def submit(self, out: Any, t0: float, t1: float, fn: str, route: str,
-               tier: str, compiled: bool) -> bool:
+               tier: str, compiled: bool,
+               app: Optional[str] = None) -> bool:
         """Enqueue a dispatch for ready-delta measurement; False when the
-        queue is full (caller falls back to wall time)."""
+        queue is full (caller falls back to wall time).
+
+        `app` is the tenant captured on the DISPATCH thread — the drain
+        thread has no contextvar binding, so it must travel in the item."""
         if not self._running:
             self.start()
         try:
-            self._queue.put_nowait((out, t0, t1, fn, route, tier, compiled))
+            self._queue.put_nowait(
+                (out, t0, t1, fn, route, tier, compiled, app))
         except queue.Full:
             DEVICE_CLOCK_DROPPED.inc()
             return False
@@ -435,7 +445,8 @@ class DeviceClock:
                 DEVICE_CLOCK_QUEUE.set(self._queue.qsize())
 
     def _measure(self, out: Any, t0: float, t1: float, fn: str, route: str,
-                 tier: str, compiled: bool) -> None:
+                 tier: str, compiled: bool,
+                 app: Optional[str] = None) -> None:
         device = _backend()
         try:
             import jax
@@ -449,7 +460,7 @@ class DeviceClock:
         # enqueued, so the whole t0→ready delta is device time.
         start = t1 if compiled else t0
         us = int(max(0.0, t_ready - start) * 1e6)
-        _account(route, fn, tier, device, us)
+        _account(route, fn, tier, device, us, app=app)
         self._tick_utilization(device, t_ready, us)
 
     def _tick_utilization(self, device: str, now: float, us: int) -> None:
@@ -509,12 +520,15 @@ def record_dispatch(fn: str, args: Sequence[Any] = (),
         route, tier = UNTRACKED_ROUTE, ""
     if not _clock_enabled:
         return
+    # capture the tenant HERE, on the dispatch thread, where the serving
+    # plane's contextvar binding is live; the clock's drain thread isn't
+    app = tenant.current_app()
     if out is not None and "jax" in sys.modules and _backend() != "cpu":
-        if CLOCK.submit(out, t0, t1, fn, route, tier, compiled):
+        if CLOCK.submit(out, t0, t1, fn, route, tier, compiled, app=app):
             return
     # Wall-time fallback: jax-less processes, the CPU backend (execution
     # completes inside the call), or a saturated drain queue.
-    _account(route, fn, tier, "cpu", int(max(0.0, t1 - t0) * 1e6))
+    _account(route, fn, tier, "cpu", int(max(0.0, t1 - t0) * 1e6), app=app)
 
 
 # -- /debug/jit.json -----------------------------------------------------------

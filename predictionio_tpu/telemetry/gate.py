@@ -1,6 +1,6 @@
 """Telemetry gate — CI check that no HTTP surface escapes the middleware.
 
-Run via `python quality.py --telemetry-gate`. Seven layers:
+Run via `python quality.py --telemetry-gate`. Eight layers:
 
 1. Static scan (AST, no imports, no jax): inside `predictionio_tpu/`,
    every HTTP server must go through `utils/http.py`'s HttpService —
@@ -57,7 +57,18 @@ Run via `python quality.py --telemetry-gate`. Seven layers:
    the same payload. And the fleet device view: the control endpoint's
    `/debug/jit.json` merged device-microsecond total must equal the sum
    of its own per-worker map (one-payload exactness) AND the per-worker
-   exports read over the snapshot sockets.
+   exports read over the snapshot sockets. And the fleet tenant view:
+   `/debug/tenants.json` must be the merged, sum-exact per-app ledger,
+   with its request cells equal to the sum of the per-worker tenant
+   exports and the stub workers' app binding attributed.
+
+8. Tenant drill: two apps on memory storage driven through the real
+   ingest and serving planes — every `tenant_*` family sum-exact
+   against its untagged twin, rows/bytes/requests/device-µs/folds
+   attributed to the app that caused them (device-µs cross-checked
+   against the device plane's own ledger growth), the unauthorized
+   bucket preserved under `-`, and the hot app ranked first in
+   `/debug/tenants.json` with a live `burn_5m`.
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
@@ -655,6 +666,194 @@ def _device_drill() -> list[str]:
     return problems
 
 
+def _tenant_drill() -> list[str]:
+    """Two apps under load: every tenant_* family must be sum-exact
+    against its untagged twin, each plane must attribute to the app that
+    caused the work, and /debug/tenants.json must name the hot app.
+
+    The overhead half of the tenant acceptance rides the existing A/B
+    drills: the profiler and device A/Bs above run with the tenant
+    meter ON (its default), so their ≤5% bars already include the
+    meter's per-request cost."""
+    import http.client
+    import json
+    import time
+
+    from predictionio_tpu.data.api import EventServer, EventServerConfig
+    from predictionio_tpu.serving import ServingPlane
+    from predictionio_tpu.storage.base import AccessKey, App
+    from predictionio_tpu.storage.registry import (
+        SourceConfig, Storage, StorageConfig,
+    )
+    from predictionio_tpu.telemetry import device, lineage, tenant
+
+    problems = []
+    tenant.reset_state()
+    dev_before = int(device.export_state().get("total_us", 0))
+
+    src = SourceConfig(name="TENANTGATE", type="memory")
+    storage = Storage(StorageConfig(metadata=src, modeldata=src,
+                                    eventdata=src))
+    hot_id = storage.meta_apps().insert(App(id=0, name="TenantGateHot"))
+    cold_id = storage.meta_apps().insert(App(id=0, name="TenantGateCold"))
+    hot, cold = str(hot_id), str(cold_id)
+    storage.meta_access_keys().insert(
+        AccessKey(key="tenant-gate-hot", app_id=hot_id, events=[]))
+    storage.meta_access_keys().insert(
+        AccessKey(key="tenant-gate-cold", app_id=cold_id, events=[]))
+
+    def post_events(port: int, key: str, n: int) -> int:
+        ok = 0
+        for i in range(n):
+            payload = json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{i}", "targetEntityType": "item",
+                "targetEntityId": f"i{i}"}).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            conn.request("POST", f"/events.json?accessKey={key}", payload,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            conn.close()
+            if r.status == 201:
+                ok += 1
+        return ok
+
+    server = EventServer(EventServerConfig(ip="127.0.0.1", port=0),
+                         storage=storage)
+    server.start()
+    try:
+        # -- ingest plane: rows + commit bytes land under the key's app
+        hot_ok = post_events(server.port, "tenant-gate-hot", 12)
+        cold_ok = post_events(server.port, "tenant-gate-cold", 4)
+        if hot_ok != 12 or cold_ok != 4:
+            problems.append(
+                f"tenant: ingest drill committed {hot_ok}/12 hot + "
+                f"{cold_ok}/4 cold events")
+        # one junk key: unauthorized work must land under "-", not vanish
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("POST", "/events.json?accessKey=no-such-key", b"{}",
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        conn.close()
+        if r.status != 401:
+            problems.append(
+                f"tenant: junk key answered {r.status}, not 401")
+
+        # -- serving + device planes: the hot plane burns more device
+        # time, so it must rank first in the top-K view
+        def mk_dispatch(burn_s: float):
+            def dispatch(queries):
+                device.record_dispatch(
+                    "tenantgate.score", (len(queries),), out=None,
+                    t0=time.perf_counter() - burn_s)
+                return [{"scored": True} for _ in queries]
+            return dispatch
+
+        plane_hot = ServingPlane(mk_dispatch(0.005), name="tenantgate",
+                                 app=hot)
+        plane_cold = ServingPlane(mk_dispatch(0.001), name="tenantgate",
+                                  app=cold)
+        try:
+            for _ in range(6):
+                plane_hot.handle_query({"q": 1}, {})
+            for _ in range(2):
+                plane_cold.handle_query({"q": 1}, {})
+        finally:
+            plane_hot.close()
+            plane_cold.close()
+
+        # -- online plane's metering entry points, through the lineage
+        # envelope (the app rides the envelope's "a" key to the tailer)
+        lctx = lineage.mint(app=hot)
+        if lctx.app != hot:
+            problems.append(
+                f"tenant: lineage envelope lost the app "
+                f"({lctx.app!r} != {hot!r})")
+        tenant.record_folded(lctx.app, 5)
+        tenant.observe_freshness(lctx.app, 0.2)
+
+        time.sleep(0.3)   # let the writer's commit-thread bookkeeping land
+
+        # -- sum-exactness per family, plus independent cross-checks
+        body = tenant.payload()
+        if not body.get("sum_exact"):
+            problems.append("tenant: local payload is not sum-exact")
+        st = tenant.export_state()
+        for family, cells in st["by_app"].items():
+            total = sum(cells.values())
+            if total != st["untagged"][family]:
+                problems.append(
+                    f"tenant: {family} by-app sum {total} != untagged "
+                    f"{st['untagged'][family]}")
+        rows_by_app = st["by_app"]["storage_rows"]
+        if rows_by_app.get(hot, 0) != 12 or rows_by_app.get(cold, 0) != 4:
+            problems.append(
+                f"tenant: storage rows misattributed: {rows_by_app} "
+                f"(want {{{hot!r}: 12, {cold!r}: 4}})")
+        if st["by_app"]["commit_bytes"].get(hot, 0) <= 0:
+            problems.append("tenant: no commit bytes attributed to the "
+                            "hot app")
+        # 12 + 4 + 1 unauthorized + 6 + 2 served queries
+        if st["untagged"]["requests"] != 25:
+            problems.append(
+                f"tenant: untagged requests {st['untagged']['requests']} "
+                f"!= the 25 handled calls")
+        if st["by_app"]["requests"].get(tenant.UNATTRIBUTED, 0) != 1:
+            problems.append(
+                f"tenant: the unauthorized request did not land under "
+                f"'-' ({st['by_app']['requests']})")
+        if st["by_app"]["folded_events"].get(hot, 0) != 5:
+            problems.append(
+                f"tenant: folded events misattributed "
+                f"({st['by_app']['folded_events']})")
+        # device: the meter's untagged cell and the device plane's own
+        # integer-µs ledger grew by the SAME amount — one stream, two views
+        dev_delta = int(device.export_state().get("total_us", 0)) \
+            - dev_before
+        if st["untagged"]["device_us"] != dev_delta:
+            problems.append(
+                f"tenant: untagged device_us "
+                f"{st['untagged']['device_us']} != device-plane growth "
+                f"{dev_delta}")
+        dev_cells = st["by_app"]["device_us"]
+        if not dev_cells.get(hot, 0) > dev_cells.get(cold, 0) > 0:
+            problems.append(
+                f"tenant: device time not attributed hot > cold > 0 "
+                f"({dev_cells})")
+
+        # -- /debug/tenants.json on a live transport names the hot app
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("GET", "/debug/tenants.json")
+        r = conn.getresponse()
+        payload = json.loads(r.read())
+        conn.close()
+        if r.status != 200:
+            problems.append(
+                f"tenant: /debug/tenants.json answered {r.status}")
+        else:
+            rows = payload.get("tenants") or []
+            if not rows or rows[0].get("app") != hot:
+                problems.append(
+                    f"tenant: hot app {hot!r} is not the top row of "
+                    f"/debug/tenants.json ({[r0.get('app') for r0 in rows]})")
+            elif rows[0].get("burn_5m") is None:
+                problems.append(
+                    "tenant: top row carries no burn_5m (per-app SLO "
+                    "tracker not fed)")
+            if not payload.get("sum_exact"):
+                problems.append(
+                    "tenant: /debug/tenants.json is not sum-exact")
+    finally:
+        server.shutdown()
+        storage.close()
+    return problems
+
+
 def _fleet_drill() -> list[str]:
     """4-worker pool under load: the supervisor's merged scrape must be
     sum-exact against the per-worker registries, with history running
@@ -683,6 +882,9 @@ def _fleet_drill() -> list[str]:
         # ~100 sweeps per process of statistics
         "PIO_GATE_BURN_MS": "10",
         "PIO_PROFILE_HZ": "43",
+        # every stub worker's serving plane binds to one app, so the
+        # merged tenant view has attributed work to be sum-exact about
+        "PIO_TENANT_APP": "7",
     }
     pool = _Pool(4, env)
     load = None
@@ -885,6 +1087,36 @@ def _fleet_drill() -> list[str]:
                 problems.append(
                     f"fleet: merged device view lost the stub's "
                     f"gate.stub_score dispatches (fns: {sorted(fns)})")
+
+        # -- fleet tenant view on the control endpoint: merge_tenants
+        # asserts sum-exactness internally, so a 200 with sum_exact is
+        # already a fleet-wide receipt; cross-check the per-app request
+        # cells against the per-worker ledgers read over the snapshot
+        # sockets, and the app-7 binding every stub worker carries.
+        ten = _get_json(ctl_port, "/debug/tenants.json", timeout_s=5.0)
+        if not ten.get("fleet"):
+            problems.append("fleet: /debug/tenants.json on the control "
+                            "endpoint is not the merged fleet view")
+        else:
+            if not ten.get("sum_exact"):
+                problems.append("fleet: merged tenant view is not "
+                                "sum-exact")
+            snap_requests: dict = {}
+            for s in snaps:
+                part = s.get("tenant") or {}
+                for app, n in part.get("by_app", {}).get(
+                        "requests", {}).items():
+                    snap_requests[app] = snap_requests.get(app, 0) + int(n)
+            merged_rows = {r0["app"]: int(r0["requests"])
+                           for r0 in ten.get("tenants", ())}
+            if merged_rows != snap_requests:
+                problems.append(
+                    f"fleet: merged tenant requests {merged_rows} != sum "
+                    f"of per-worker ledgers {snap_requests}")
+            if snap_requests.get("7", 0) <= 0:
+                problems.append(
+                    f"fleet: no requests attributed to the stub app "
+                    f"binding ({snap_requests})")
     finally:
         if load is not None:
             load.stop_evt.set()
@@ -914,6 +1146,10 @@ def run_gate() -> int:
         problems += _device_drill()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
         problems.append(f"device drill crashed: {e!r}")
+    try:
+        problems += _tenant_drill()
+    except Exception as e:  # noqa: BLE001 — a crash IS a gate failure
+        problems.append(f"tenant drill crashed: {e!r}")
     try:
         problems += _fleet_drill()
     except Exception as e:  # noqa: BLE001 — a crash IS a gate failure

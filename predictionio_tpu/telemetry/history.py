@@ -17,7 +17,7 @@ Knobs (read once at first start):
 - ``PIO_METRICS_HISTORY_WINDOW_S``   retention (default 600)
 - ``PIO_METRICS_HISTORY_FAMILIES``   comma list of name prefixes
   (default ``http_,serving_,slo_,supervisor_,alert_,ingest_,engine_,
-  experiment_,lineage_,online_``)
+  experiment_,lineage_,online_,device_,tenant_``)
 
 Served at ``GET /debug/history.json`` on every instrumented HttpService;
 queried by `telemetry/alerts.py` rules and `runtime/supervisor.py`'s
@@ -43,7 +43,7 @@ from predictionio_tpu.telemetry.registry import (
 
 DEFAULT_PREFIXES: Tuple[str, ...] = (
     "http_", "serving_", "slo_", "supervisor_", "alert_", "ingest_",
-    "engine_", "experiment_", "lineage_", "online_", "device_",
+    "engine_", "experiment_", "lineage_", "online_", "device_", "tenant_",
 )
 
 SAMPLE_SECONDS = REGISTRY.gauge(

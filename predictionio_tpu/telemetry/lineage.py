@@ -123,45 +123,60 @@ class CausalContext:
     assembled timeline can show how far an event travelled even when the
     stage records themselves were sampled away on another worker."""
 
-    __slots__ = ("trace_id", "origin_wall", "origin_mono", "hop", "debug")
+    __slots__ = ("trace_id", "origin_wall", "origin_mono", "hop", "debug",
+                 "app")
 
     def __init__(self, trace_id: str, origin_wall: float,
                  origin_mono: Optional[float] = None, hop: int = 0,
-                 debug: bool = False):
+                 debug: bool = False, app: str = ""):
         self.trace_id = trace_id
         self.origin_wall = origin_wall
         self.origin_mono = origin_mono
         self.hop = hop
         self.debug = debug
+        # tenant app id resolved at mint time (auth path); rides the
+        # envelope so downstream planes (tailer, fold) can attribute work
+        # to the app without re-resolving the access key
+        self.app = app
 
     def to_dict(self) -> dict:
         # short keys: this rides inside every stored event's properties
         d = {"t": self.trace_id, "w": self.origin_wall, "h": self.hop}
         if self.debug:
             d["d"] = 1
+        if self.app:
+            d["a"] = self.app
         return d
 
     @classmethod
     def from_dict(cls, d) -> Optional["CausalContext"]:
         """Parse a stored envelope; None on junk (a hand-edited row must
-        not wedge the tailer)."""
+        not wedge the tailer). Pre-tenant envelopes lack "a" — tolerated
+        (app stays "")."""
         try:
             return cls(trace_id=str(d["t"]), origin_wall=float(d["w"]),
-                       hop=int(d.get("h", 0)), debug=bool(d.get("d")))
+                       hop=int(d.get("h", 0)), debug=bool(d.get("d")),
+                       app=str(d.get("a", "")))
         except (TypeError, KeyError, ValueError):
             return None
 
 
 def mint(trace_id: Optional[str] = None, debug: bool = False,
-         now: Optional[float] = None) -> CausalContext:
+         now: Optional[float] = None,
+         app: Optional[str] = None) -> CausalContext:
     """A fresh context at origin time `now` (wall). Joins the active
-    request trace when `trace_id` is None and one is open."""
+    request trace when `trace_id` is None and one is open, and the active
+    tenant binding when `app` is None and one is active."""
     if trace_id is None:
         from predictionio_tpu.telemetry import tracing
         trace_id = tracing.current_trace_id() or tracing._new_id()
+    if app is None:
+        from predictionio_tpu.telemetry import tenant
+        app = tenant.current_app() or ""
     return CausalContext(trace_id=trace_id,
                          origin_wall=now if now is not None else time.time(),
-                         origin_mono=time.monotonic(), debug=debug)
+                         origin_mono=time.monotonic(), debug=debug,
+                         app=str(app))
 
 
 def context_of(event) -> Optional[CausalContext]:

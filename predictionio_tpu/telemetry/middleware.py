@@ -59,6 +59,7 @@ _JIT_ROUTE = "/debug/jit.json"
 _LINEAGE_LIST_ROUTE = "/debug/lineage.json"
 _LINEAGE_ONE_ROUTE = "/debug/lineage/<trace_id>.json"
 _LOCKS_ROUTE = "/debug/locks.json"
+_TENANTS_ROUTE = "/debug/tenants.json"
 
 HTTP_REQUESTS = REGISTRY.counter(
     "http_requests_total", "HTTP requests served",
@@ -81,7 +82,7 @@ HTTP_ERRORS = REGISTRY.counter(
 _EXACT_ROUTES = frozenset({
     "/", "/index.html", "/metrics", _DEBUG_LIST_ROUTE, _HISTORY_ROUTE,
     _PROFILE_ROUTE, _PROFILE_DEVICE_ROUTE, _JIT_ROUTE,
-    _LINEAGE_LIST_ROUTE, _LOCKS_ROUTE,
+    _LINEAGE_LIST_ROUTE, _LOCKS_ROUTE, _TENANTS_ROUTE,
     "/events.json", "/batch/events.json", "/stats.json",   # event server
     "/queries.json", "/reload", "/stop",                   # prediction server
     "/cmd/app",                                            # admin server
@@ -467,6 +468,41 @@ def serve_debug_jit(handler) -> None:
     _serve_json(handler, obj, status=status)
 
 
+# Per-server /debug/tenants.json overrides — the /metrics renderer
+# pattern a fifth time: the supervisor swaps in the fleet-merged
+# (sum-exact) per-app view while workers keep the process-local meter.
+_TENANTS_RENDERERS: dict = {}
+
+
+def set_tenants_renderer(server_name: str, renderer) -> None:
+    """Install (renderer() -> (status, obj)) for one server's
+    /debug/tenants.json; None clears."""
+    if renderer is None:
+        _TENANTS_RENDERERS.pop(server_name, None)
+    else:
+        _TENANTS_RENDERERS[server_name] = renderer
+
+
+def _tenants_payload(server: str) -> tuple:
+    """GET /debug/tenants.json — top-K per-app usage + SLO burn."""
+    renderer = _TENANTS_RENDERERS.get(server)
+    if renderer is not None:
+        try:
+            return renderer()
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "tenants renderer for %s failed; serving process-local "
+                "view", server, exc_info=True)
+    from predictionio_tpu.telemetry import tenant
+
+    return tenant.payload_response()
+
+
+def serve_debug_tenants(handler) -> None:
+    status, obj = _tenants_payload(getattr(handler, "pio_server_name", ""))
+    _serve_json(handler, obj, status=status)
+
+
 def _locks_payload() -> tuple:
     """GET /debug/locks.json — the lock sanitizer's dynamic order graph."""
     from predictionio_tpu.utils import locksan
@@ -520,6 +556,8 @@ def _run_instrumented(self, http_method: str, orig) -> None:
             serve_debug_lineage(self, self.path)
         elif http_method == "GET" and path == _LOCKS_ROUTE:
             serve_debug_locks(self)
+        elif http_method == "GET" and path == _TENANTS_ROUTE:
+            serve_debug_tenants(self)
         elif http_method == "GET" and route == _DEBUG_ONE_ROUTE:
             serve_debug_request_by_id(self, path)
         elif http_method == "GET" and route == _LINEAGE_ONE_ROUTE:
@@ -824,6 +862,14 @@ def _profile_device_route(req):
     return routing.Response.json(status, obj)
 
 
+def _tenants_route(req):
+    from predictionio_tpu.utils import routing
+
+    status, obj = _tenants_payload(
+        req.server_name if hasattr(req, "server_name") else "")
+    return routing.Response.json(status, obj)
+
+
 def _jit_route(req):
     from predictionio_tpu.utils import routing
 
@@ -856,6 +902,7 @@ def register_builtin_routes(router) -> None:
     router.get(_JIT_ROUTE, _jit_route)
     router.get(_LINEAGE_LIST_ROUTE, _lineage_list_route)
     router.get(_LOCKS_ROUTE, _locks_route)
+    router.get(_TENANTS_ROUTE, _tenants_route)
     router.add_prefix("GET", "/debug/requests/", ".json", _debug_one_route,
                       template=_DEBUG_ONE_ROUTE)
     router.add_prefix("GET", "/debug/lineage/", ".json", _lineage_one_route,

@@ -1,0 +1,519 @@
+"""Tenant attribution: per-app usage metering across every plane.
+
+PredictionIO is multi-app by construction (``pio app new``, per-app
+access keys), yet PRs 2–16 built every observability layer tenant-blind
+— a noisy neighbor burning the fleet is invisible until *work* can be
+attributed to an *app id*. This module is that measurement plane:
+
+- a **tenant context** on the same contextvar discipline as
+  ``telemetry.tracing``: the app id is resolved once at the trust
+  boundary (access-key auth at ingest, the engine/variant binding at
+  serving), activated around the request, and joined into the
+  ``pio_lineage`` envelope so attribution survives every async hop the
+  event takes (request thread → group commit → tailer → fold → swap);
+
+- a **TenantMeter** that lands every unit of work under a capped tenant
+  label (``registry.capped_label`` group ``"tenant"`` — cardinality is
+  bounded, apps admitted before the cap keep stable series identity,
+  the rest collapse to ``<other>``). Families:
+
+  ===============================  ===========================================
+  ``tenant_requests_total``        requests handled, by app × server × outcome
+  ``tenant_device_seconds_total``  attributed device time (rides the device
+                                   clock's dispatch accounting)
+  ``tenant_storage_rows``          event rows committed to the event store
+  ``tenant_commit_bytes_total``    approximate payload bytes group-committed
+  ``tenant_folded_events_total``   events folded into a served model
+  ``tenant_event_to_servable_seconds``  per-app freshness histogram
+  ===============================  ===========================================
+
+**Sum-exactness is the contract.** The meter keeps a plain-int mirror
+(like the device plane's microsecond ledger): every ``add`` bumps the
+per-app cell *and* the family's untagged total under one lock, so
+``sum(by_app.values()) == untagged`` holds per family by construction.
+``export_state()`` ships both through the PR 9 snapshot channel and
+``merge_tenants`` re-asserts the invariant on the fleet-merged view —
+a tenant breakdown that doesn't add up to the untagged total is a bug,
+not a rounding artifact. Work with no resolvable app lands under the
+``"-"`` label rather than being dropped, which is what keeps the sums
+exact instead of merely close.
+
+Per-tenant SLOs: the first unit of work for an app registers an SLO
+objective under server ``"tenant"`` route ``<app>`` (``slo.py``), so
+``slo_error_budget_burn_rate{server="tenant",route="<app>"}`` answers
+"which app is burning its budget" and the ``tenant-burn-5m`` alert rule
+pages on it.
+
+Operability: ``GET /debug/tenants.json`` (both transports) serves the
+top-K usage/burn view; the supervisor overrides it with the fleet merge;
+``history.py`` samples ``tenant_*`` families; the dashboard grows a
+Tenants panel. Runbook: docs/observability.md §Tenants.
+
+Knobs (docs/operations.md):
+
+- ``PIO_TENANT_METER=0``      disable metering (context still propagates)
+- ``PIO_TENANT_LABEL_CAP``    distinct app labels before ``<other>`` (64)
+- ``PIO_TENANT_TOPK``         rows in /debug/tenants.json (10)
+- ``PIO_TENANT_SLO_TARGET``   per-tenant availability target (0.999)
+- ``PIO_TENANT_SLO_LATENCY_MS``  per-tenant latency threshold (250)
+
+Fork hygiene mirrors ``aggregate.reset_inherited_counters``: a forked
+worker clears the inherited meter (and reinits its lock) in an at-fork
+hook, so fleet sums never double-count the parent's pre-fork work.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from predictionio_tpu.telemetry import slo
+from predictionio_tpu.telemetry.registry import (
+    DEFAULT_LABEL_CAP,
+    REGISTRY,
+    capped_label,
+)
+
+# app id for work no tenant context could be resolved for — metered, not
+# dropped, so per-family sums stay exact against the untagged totals
+UNATTRIBUTED = "-"
+
+_LABEL_GROUP = "tenant"
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+_ENABLED = _env_flag("PIO_TENANT_METER", True)
+LABEL_CAP = _env_int("PIO_TENANT_LABEL_CAP", DEFAULT_LABEL_CAP)
+TOP_K = _env_int("PIO_TENANT_TOPK", 10)
+SLO_TARGET = _env_float("PIO_TENANT_SLO_TARGET", 0.999)
+SLO_LATENCY_S = _env_float("PIO_TENANT_SLO_LATENCY_MS", 250.0) / 1000.0
+
+# SLO server name the per-tenant objectives register under
+SLO_SERVER = "tenant"
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# -- tenant context ------------------------------------------------------------
+#
+# Same discipline as tracing._current: a contextvar carrying a tiny
+# slotted object, activate() returning the reset token, deactivate()
+# restoring the outer binding. contextvars (not a threading.local) so the
+# binding survives executor hops that copy context.
+
+
+class TenantContext:
+    """The resolved tenant for the work currently executing."""
+
+    __slots__ = ("app", "source")
+
+    def __init__(self, app: str, source: str = ""):
+        self.app = str(app)
+        # where the binding came from: "access_key" | "variant" | "lineage"
+        self.source = source
+
+    def __repr__(self) -> str:  # debugging only
+        return f"TenantContext(app={self.app!r}, source={self.source!r})"
+
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "pio_tenant_context", default=None)
+
+
+def activate(app, source: str = "") -> "contextvars.Token":
+    """Bind the tenant for this execution context; returns the token for
+    deactivate(). `app` is coerced to str (app ids are ints in storage)."""
+    return _current.set(TenantContext(app, source))
+
+
+def deactivate(token: "contextvars.Token") -> None:
+    _current.reset(token)
+
+
+def current() -> Optional[TenantContext]:
+    return _current.get()
+
+
+def current_app() -> Optional[str]:
+    ctx = _current.get()
+    return ctx.app if ctx is not None else None
+
+
+class bound:
+    """``with tenant.bound(app_id, "access_key"): ...`` — cheap class-based
+    context manager (no @contextmanager generator overhead), mirroring
+    tracing.span."""
+
+    __slots__ = ("app", "source", "_token")
+
+    def __init__(self, app, source: str = ""):
+        self.app = app
+        self.source = source
+
+    def __enter__(self):
+        self._token = activate(self.app, self.source)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        deactivate(self._token)
+        return False
+
+
+def tenant_label(app: Optional[str]) -> str:
+    """The bounded label for an app id: admitted per capped_label group
+    "tenant" up to PIO_TENANT_LABEL_CAP, then `<other>`."""
+    if app is None:
+        return UNATTRIBUTED
+    return capped_label(_LABEL_GROUP, str(app), LABEL_CAP)
+
+
+# -- registry mirrors ----------------------------------------------------------
+
+# same shape as online_event_to_servable_seconds so per-tenant p95s are
+# comparable against the untagged north star
+_E2S_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0, 30.0)
+
+TENANT_REQUESTS = REGISTRY.counter(
+    "tenant_requests_total",
+    "Requests handled under a tenant binding, by app, server and outcome",
+    labelnames=("app", "server", "outcome"))
+TENANT_DEVICE_SECONDS = REGISTRY.counter(
+    "tenant_device_seconds_total",
+    "Device time attributed to each app by the device clock's dispatch "
+    "accounting",
+    labelnames=("app",))
+TENANT_STORAGE_ROWS = REGISTRY.counter(
+    "tenant_storage_rows",
+    "Event rows committed to the event store, by app",
+    labelnames=("app",))
+TENANT_COMMIT_BYTES = REGISTRY.counter(
+    "tenant_commit_bytes_total",
+    "Approximate event payload bytes group-committed, by app",
+    labelnames=("app",))
+TENANT_FOLDED = REGISTRY.counter(
+    "tenant_folded_events_total",
+    "Events folded into a served model by the online plane, by app",
+    labelnames=("app",))
+TENANT_FRESHNESS = REGISTRY.histogram(
+    "tenant_event_to_servable_seconds",
+    "Per-app event_time → served-model swap latency (per-tenant slice of "
+    "the online_event_to_servable_seconds north star)",
+    labelnames=("app",), buckets=_E2S_BUCKETS)
+
+
+# -- the meter -----------------------------------------------------------------
+
+# plain-int families the sum-exact contract is asserted over; device time
+# is metered in integer microseconds (like device._ATTR_TOTALS) so fleet
+# merges add exactly
+FAMILIES = ("requests", "device_us", "storage_rows", "commit_bytes",
+            "folded_events")
+
+
+class TenantMeter:
+    """Per-app usage ledger with an untagged mirror updated in the same
+    critical section — sum-exactness by construction, not by sampling."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_app: Dict[str, Dict[str, int]] = {f: {} for f in FAMILIES}
+        self._untagged: Dict[str, int] = {f: 0 for f in FAMILIES}
+        # apps that already have a ("tenant", app) SLO objective
+        self._slo_registered: set = set()
+
+    def add(self, family: str, app: str, n: int = 1) -> None:
+        with self._lock:
+            cells = self._by_app[family]
+            cells[app] = cells.get(app, 0) + n
+            self._untagged[family] += n
+
+    def ensure_slo(self, app: str) -> None:
+        """Register the per-tenant SLO objective once per admitted app
+        label (burn gauges then come free from slo.refresh())."""
+        if app == UNATTRIBUTED:
+            return
+        with self._lock:
+            if app in self._slo_registered:
+                return
+            self._slo_registered.add(app)
+        slo.set_objective(SLO_SERVER, app,
+                          availability_target=SLO_TARGET,
+                          latency_target=SLO_TARGET,
+                          latency_threshold_s=SLO_LATENCY_S)
+
+    def export_state(self) -> Dict:
+        """Snapshot for the PR 9 aggregate channel: per-app cells plus the
+        untagged totals they must sum to."""
+        with self._lock:
+            return {
+                "by_app": {f: dict(cells)
+                           for f, cells in self._by_app.items()},
+                "untagged": dict(self._untagged),
+            }
+
+    def totals(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {f: dict(cells) for f, cells in self._by_app.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_app = {f: {} for f in FAMILIES}
+            self._untagged = {f: 0 for f in FAMILIES}
+            self._slo_registered = set()
+
+
+METER = TenantMeter()
+
+# Hot-path child caches: Family.labels() pays a labelname set-compare +
+# family lock per call, which is real money on per-request/per-dispatch
+# paths (the serving batcher's ≤5% machinery bar). Keys are resolved
+# (capped) labels, so both dicts are bounded. Fork-safe without a hook:
+# reset_inherited_counters zeroes and _reinit_locks_after_fork re-points
+# locks on these same child objects in place.
+_REQ_CHILDREN: Dict[Tuple[str, str, str], object] = {}
+_DEV_CHILDREN: Dict[str, object] = {}
+
+
+def _resolve(app: Optional[str]) -> str:
+    if app is None:
+        app = current_app()
+    return tenant_label(app)
+
+
+# -- metering entry points (one per unit of work) ------------------------------
+
+
+def record_request(server: str, outcome: str, app: Optional[str] = None,
+                   status: int = 200, duration_s: float = 0.0) -> None:
+    """One handled request. Feeds the per-tenant SLO tracker too, so the
+    app's availability/latency burn is computed from the same stream."""
+    if not _ENABLED:
+        return
+    label = _resolve(app)
+    METER.add("requests", label)
+    key = (label, server, outcome)
+    child = _REQ_CHILDREN.get(key)
+    if child is None:
+        child = _REQ_CHILDREN[key] = TENANT_REQUESTS.labels(
+            app=label, server=server, outcome=outcome)
+    child.inc()
+    if label != UNATTRIBUTED:
+        METER.ensure_slo(label)
+        slo.observe(SLO_SERVER, label, status, duration_s)
+
+
+def record_device_us(us: int, app: Optional[str] = None) -> None:
+    """Device time for one dispatch, integer microseconds (called from
+    device._account with the same value it lands in _ATTR_TOTALS)."""
+    if not _ENABLED or us < 0:
+        return
+    label = _resolve(app)
+    METER.add("device_us", label, int(us))
+    child = _DEV_CHILDREN.get(label)
+    if child is None:
+        child = _DEV_CHILDREN[label] = TENANT_DEVICE_SECONDS.labels(app=label)
+    child.inc(us / 1e6)
+
+
+def record_storage_rows(app, rows: int, nbytes: int = 0) -> None:
+    """Rows (and approximate payload bytes) group-committed for one app."""
+    if not _ENABLED or rows <= 0:
+        return
+    label = _resolve(app if app is None else str(app))
+    METER.add("storage_rows", label, int(rows))
+    TENANT_STORAGE_ROWS.labels(app=label).inc(rows)
+    if nbytes > 0:
+        METER.add("commit_bytes", label, int(nbytes))
+        TENANT_COMMIT_BYTES.labels(app=label).inc(nbytes)
+
+
+def record_commit_bytes(app, nbytes: int) -> None:
+    """Approximate payload bytes committed for one app (the request body
+    length at the API layer — free to measure, close enough to rank
+    tenants by write volume)."""
+    if not _ENABLED or nbytes <= 0:
+        return
+    label = _resolve(app if app is None else str(app))
+    METER.add("commit_bytes", label, int(nbytes))
+    TENANT_COMMIT_BYTES.labels(app=label).inc(nbytes)
+
+
+def record_folded(app, n: int) -> None:
+    """Events folded into a served model for one app."""
+    if not _ENABLED or n <= 0:
+        return
+    label = _resolve(app if app is None else str(app))
+    METER.add("folded_events", label, int(n))
+    TENANT_FOLDED.labels(app=label).inc(n)
+
+
+def observe_freshness(app, seconds: float) -> None:
+    """One per-event event→servable latency under the app's label."""
+    if not _ENABLED:
+        return
+    label = _resolve(app if app is None else str(app))
+    TENANT_FRESHNESS.labels(app=label).observe(seconds)
+
+
+# -- export / fleet merge ------------------------------------------------------
+
+
+def export_state() -> Dict:
+    """This process's tenant ledger for aggregate.snapshot_registry."""
+    return METER.export_state()
+
+
+def merge_tenants(parts: Iterable[Tuple[str, Optional[Dict]]]) -> Dict:
+    """Merge (worker_label, export_state()) pairs into one fleet tenant
+    view. Integer cells sum exactly, the per-worker request totals ship
+    in the same payload, and the sum-exact invariant — per family,
+    ``sum(by_app.values()) == untagged`` — is re-asserted on the merged
+    result (a worker whose breakdown doesn't add up poisons the fleet
+    view loudly, not silently)."""
+    by_app: Dict[str, Dict[str, int]] = {f: {} for f in FAMILIES}
+    untagged: Dict[str, int] = {f: 0 for f in FAMILIES}
+    workers: Dict[str, int] = {}
+    for wlabel, state in parts:
+        if state is None:
+            # dead/old worker: present in the roster, contributes zero
+            workers.setdefault(str(wlabel), 0)
+            continue
+        part_requests = 0
+        for family in FAMILIES:
+            cells = state.get("by_app", {}).get(family, {})
+            dst = by_app[family]
+            for app, n in cells.items():
+                dst[app] = dst.get(app, 0) + int(n)
+                if family == "requests":
+                    part_requests += int(n)
+            untagged[family] += int(state.get("untagged", {}).get(family, 0))
+        workers[str(wlabel)] = workers.get(str(wlabel), 0) + part_requests
+    for family in FAMILIES:
+        total = sum(by_app[family].values())
+        if total != untagged[family]:
+            raise AssertionError(
+                f"tenant merge not sum-exact for {family!r}: "
+                f"sum(by_app)={total} != untagged={untagged[family]}")
+    return {
+        "fleet": True,
+        "workers": workers,
+        "by_app": by_app,
+        "untagged": untagged,
+    }
+
+
+def payload(top_k: Optional[int] = None,
+            merged: Optional[Dict] = None) -> Dict:
+    """The /debug/tenants.json body: top-K apps by usage with per-family
+    counts, the untagged totals they sum to, and (single-process view)
+    each app's worst 5m SLO burn. Pass a merge_tenants() result as
+    `merged` for the supervisor's fleet view (burn is per-process tracker
+    state, so the fleet payload reports usage only)."""
+    if top_k is None:
+        top_k = TOP_K
+    fleet = merged is not None
+    state = merged if fleet else export_state()
+    by_app = state["by_app"]
+    untagged = state["untagged"]
+    apps = set()
+    for cells in by_app.values():
+        apps.update(cells)
+    rows: List[Dict] = []
+    for app in apps:
+        device_us = by_app["device_us"].get(app, 0)
+        row = {
+            "app": app,
+            "requests": by_app["requests"].get(app, 0),
+            "device_seconds": round(device_us / 1e6, 6),
+            "storage_rows": by_app["storage_rows"].get(app, 0),
+            "commit_bytes": by_app["commit_bytes"].get(app, 0),
+            "folded_events": by_app["folded_events"].get(app, 0),
+        }
+        if not fleet and app != UNATTRIBUTED:
+            burn, window_requests = slo.current_burn(SLO_SERVER, app)
+            row["burn_5m"] = round(burn, 3)
+            row["slo_window_requests"] = window_requests
+        rows.append(row)
+    rows.sort(key=lambda r: (-r["device_seconds"], -r["requests"],
+                             -r["storage_rows"], r["app"]))
+    out = {
+        "enabled": _ENABLED,
+        "label_cap": LABEL_CAP,
+        "apps_total": len(apps),
+        "top_k": top_k,
+        "tenants": rows[:top_k],
+        "untagged": {
+            "requests": untagged["requests"],
+            "device_seconds": round(untagged["device_us"] / 1e6, 6),
+            "device_us": untagged["device_us"],
+            "storage_rows": untagged["storage_rows"],
+            "commit_bytes": untagged["commit_bytes"],
+            "folded_events": untagged["folded_events"],
+        },
+        # asserted at merge time; restated here so one fetch carries the
+        # receipt ("the breakdown adds up") next to the breakdown itself
+        "sum_exact": all(
+            sum(by_app[f].values()) == untagged[f] for f in FAMILIES),
+    }
+    if fleet:
+        out["fleet"] = True
+        out["workers"] = state.get("workers", {})
+    return out
+
+
+def payload_response(top_k: Optional[int] = None) -> Tuple[int, Dict]:
+    """(status, body) for the middleware route handlers."""
+    return 200, payload(top_k=top_k)
+
+
+# -- lifecycle -----------------------------------------------------------------
+
+
+def reset_inherited() -> None:
+    """Forked-worker hygiene, mirroring aggregate.reset_inherited_counters:
+    the child's ledger starts from zero so the fleet merge never counts
+    the parent's pre-fork work twice (the registry-side tenant_* counters
+    are zeroed by reset_inherited_counters itself)."""
+    METER.reset()
+
+
+def reset_state() -> None:
+    """Tests: drop all tenant state (ledger only; registry families are
+    reset by the callers that own them)."""
+    METER.reset()
+
+
+def _reinit_after_fork() -> None:
+    # fresh lock (parent threads may hold it mid-fork) AND a fresh ledger:
+    # inherited per-tenant cells in a respawned worker would double-count
+    # in the fleet merge, same reasoning as lineage._reset_after_fork
+    METER._lock = threading.Lock()
+    METER.reset()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)

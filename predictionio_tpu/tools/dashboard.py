@@ -92,6 +92,16 @@ device-memory headroom. Full inventory at
 <a href="/metrics">/metrics</a>; runbook in
 <code>docs/observability.md</code>.</p>
 {device}
+<h2>Tenants</h2>
+<p>Per-app attribution across every plane: serving requests by outcome,
+device seconds, storage rows, folded events, and each app's SLO burn.
+Sums over tenant labels (including the unattributed <code>-</code>
+bucket) equal the untagged totals exactly; the fleet-merged top-K view
+is <a href="/debug/tenants.json">/debug/tenants.json</a> on the
+supervisor control endpoint. Raw families: <code>tenant_*</code> on
+<a href="/metrics">/metrics</a>; &quot;which app ate the fleet&quot;
+runbook in <code>docs/observability.md</code>.</p>
+{tenants}
 <h2>Experiments</h2>
 <p>Experimentation plane: per-variant routed traffic by outcome, the
 sliding-window traffic share, and each arm's Beta reward posterior
@@ -564,6 +574,47 @@ def _device_table() -> str:
     return "".join(out)
 
 
+def _tenants_table() -> str:
+    """Tenants panel: per-app usage rows from the tenant meter's local
+    payload (requests, device seconds, storage rows, folded events, 5m
+    burn) plus the sum-exactness verdict."""
+    from predictionio_tpu.telemetry import tenant
+
+    if not tenant.enabled():
+        return ("<p>Tenant meter disabled "
+                "(<code>PIO_TENANT_METER=0</code>).</p>")
+    body = tenant.payload()
+    rows = body.get("tenants") or []
+    out = []
+    if rows:
+        out.append("<table><tr><th>App</th><th>Requests</th>"
+                   "<th>Device time</th><th>Storage rows</th>"
+                   "<th>Folded</th><th>Burn (5m)</th></tr>")
+        for r in rows:
+            burn = r.get("burn_5m")
+            out.append(
+                f"<tr><td><code>{html.escape(str(r['app']))}</code></td>"
+                f"<td>{r.get('requests', 0)}</td>"
+                f"<td>{r.get('device_seconds', 0.0):.3f}s</td>"
+                f"<td>{r.get('storage_rows', 0)}</td>"
+                f"<td>{r.get('folded_events', 0)}</td>"
+                f"<td>{'—' if burn is None else f'{burn:.2f}'}</td></tr>")
+        out.append("</table>")
+    else:
+        out.append("<p>No attributed work yet.</p>")
+    untagged = body.get("untagged") or {}
+    out.append(
+        "<p>Untagged totals: %d requests, %.3fs device, %d rows, %d "
+        "folded — per-app sums %s.</p>" % (
+            untagged.get("requests", 0),
+            untagged.get("device_seconds", 0.0),
+            untagged.get("storage_rows", 0),
+            untagged.get("folded_events", 0),
+            "match exactly" if body.get("sum_exact") else
+            "DO NOT MATCH (meter bug)"))
+    return "".join(out)
+
+
 def _telemetry_table(registry=REGISTRY) -> str:
     """Summary panel: one row per labelled series. Histograms collapse to
     count + mean (the full distribution lives at /metrics)."""
@@ -619,6 +670,7 @@ class Dashboard(HttpService):
                     lineage=_lineage_table(),
                     profile=_profile_table(),
                     device=_device_table(),
+                    tenants=_tenants_table(),
                     experiment=_experiment_table(),
                     hotpath=_hotpath_table(),
                     telemetry=_telemetry_table(),

@@ -211,7 +211,8 @@ class PredictionServer(HttpService):
         self._planes = {
             v: ServingPlane(
                 _make_dispatch(v), degraded_fn=_make_degraded(v),
-                config=serving_cfg, name="predictionserver", variant=v)
+                config=serving_cfg, name="predictionserver", variant=v,
+                app=self._resolve_tenant_app(v))
             for v in self._variants
         }
         self._tailer: Optional[RewardTailer] = None
@@ -277,6 +278,26 @@ class PredictionServer(HttpService):
                              router=router,
                              reuse_port=reuse_port,
                              server_name="predictionserver")
+
+    def _resolve_tenant_app(self, variant: str) -> str:
+        """The app id this variant's engine is bound to — the serving-side
+        tenant root. PIO_TENANT_APP overrides; otherwise resolved from the
+        served state's DataSource appName exactly like the online plane's
+        context resolution. Empty string (unattributed) when neither
+        resolves — serving must not fail over a missing tenant binding."""
+        override = os.environ.get("PIO_TENANT_APP", "").strip()
+        if override:
+            return override
+        try:
+            state = self._states[variant]
+            dsp = state.engine_params.data_source_params
+            app_name = getattr(dsp, "appName", None)
+            if not app_name:
+                return ""
+            app = self.storage.meta_apps().get_by_name(app_name)
+            return str(app.id) if app is not None else ""
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            return ""
 
     def _config_for(self, variant: str) -> ServerConfig:
         return ServerConfig(

@@ -1,0 +1,23 @@
+"""Fixture for the no-unbounded-metric-labels rule: one unbounded
+label site (flagged), one capped site and one constant site (clean)."""
+
+from predictionio_tpu.telemetry.registry import REGISTRY, capped_label
+
+EVENTS = REGISTRY.counter("fixture_events_total", "events",
+                          labelnames=("app_id", "event", "status"))
+
+
+def bad_site(app_id, event_name, status):
+    # unbounded: event_name came straight off the wire
+    EVENTS.labels(app_id=str(app_id), event=event_name,
+                  status=str(status)).inc()
+
+
+def good_site(app_id, event_name, status):
+    EVENTS.labels(app_id=capped_label("app", str(app_id)),
+                  event=capped_label("event", event_name),
+                  status=str(status)).inc()
+
+
+def constant_site():
+    EVENTS.labels(app_id="0", event="$set", status="201").inc()

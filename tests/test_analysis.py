@@ -221,6 +221,26 @@ class TestShapeRule:
         assert {f.symbol for f in hits} == {"bad_call->solve"}, hits
 
 
+class TestLabelRule:
+    def test_unbounded_label_flagged_capped_and_constant_not(self):
+        findings = run_on_fixtures(["no-unbounded-metric-labels"])
+        hits = [f for f in findings if f.file == "label_taint.py"]
+        # only bad_site's event= kwarg: str(app_id) is tainted too but
+        # good_site caps it, bad_site's app_id IS tainted and uncapped
+        assert {f.symbol for f in hits} == {"EVENTS.app_id",
+                                            "EVENTS.event"}, hits
+        msgs = " ".join(f.message for f in hits)
+        assert "event_name" in msgs and "app_id" in msgs
+
+    def test_live_tree_has_no_unbounded_labels(self):
+        # the one historically-unbounded site (data/api.py EVENTS_TOTAL)
+        # now flows through tenant_label/capped_label; keep it that way
+        proj = Project(REPO_ROOT, subdirs=engine.DEFAULT_SUBDIRS)
+        findings = engine.run_rules(proj, ["no-unbounded-metric-labels"])
+        assert findings == [], [(f.file, f.line, f.message)
+                                for f in findings]
+
+
 class TestGateRules:
     def test_alias_registration_resolved_to_handler(self):
         # satellite 6: `h = self._handle_query; r.post(..., h)` must

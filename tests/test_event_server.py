@@ -245,3 +245,39 @@ class TestReviewRegressions:
         rc = main(["eventserver", "--ip", "127.0.0.1", "--port", str(srv.port)])
         assert rc == 1
         assert "Cannot bind" in capsys.readouterr().err
+
+
+class TestAuthCache:
+    """The 5s-TTL positive auth cache: entries carry the resolved app id
+    (the tenant-attribution root) and invalidate_access_key drops them
+    eagerly, so a revoked/rotated key stops authenticating — and stops
+    attributing work to its app — immediately, not after the TTL."""
+
+    def test_cache_entry_carries_app_id(self, server, memory_storage):
+        srv, key = server
+        assert call(srv, "POST", f"/events.json?accessKey={key}", RATE)[0] == 201
+        cached = srv.routes.akey_cache[key]
+        access_key, app_id, expiry = cached
+        assert app_id == access_key.app_id
+        assert app_id == memory_storage.meta_access_keys().get(key).app_id
+
+    def test_revoked_key_401s_immediately_after_invalidation(
+            self, server, memory_storage):
+        srv, key = server
+        # prime the cache with a successful request
+        assert call(srv, "POST", f"/events.json?accessKey={key}", RATE)[0] == 201
+        # revoke the key in storage: within the TTL the stale cache entry
+        # still authenticates — this is the window invalidation closes
+        assert memory_storage.meta_access_keys().delete(key)
+        assert call(srv, "POST", f"/events.json?accessKey={key}", RATE)[0] == 201
+        srv.invalidate_access_key(key)
+        assert call(srv, "POST", f"/events.json?accessKey={key}", RATE)[0] == 401
+        # and the miss is not re-cached: still 401 on the next try
+        assert call(srv, "POST", f"/events.json?accessKey={key}", RATE)[0] == 401
+
+    def test_invalidate_all_clears_every_entry(self, server):
+        srv, key = server
+        assert call(srv, "GET", f"/events.json?accessKey={key}")[0] == 200
+        assert key in srv.routes.akey_cache
+        srv.invalidate_access_key()  # no arg: drop the whole cache
+        assert srv.routes.akey_cache == {}

@@ -1,0 +1,192 @@
+"""Tenant attribution plane (telemetry/tenant.py): context discipline,
+meter sum-exactness, fleet merge (including dead workers and tampered
+state), fork hygiene, lineage-envelope attribution, payload shape, and
+the auth-cache invalidation path that feeds attribution at ingest."""
+
+import threading
+
+import pytest
+
+from predictionio_tpu.telemetry import lineage, slo, tenant
+from predictionio_tpu.telemetry.registry import reset_label_caps
+
+
+@pytest.fixture()
+def clean_meter():
+    tenant.reset_state()
+    slo.reset()
+    yield
+    tenant.reset_state()
+    slo.reset()
+
+
+class TestTenantContext:
+    def test_bound_sets_and_restores(self):
+        assert tenant.current_app() is None
+        with tenant.bound(7, "access_key"):
+            assert tenant.current_app() == "7"
+            assert tenant.current().source == "access_key"
+        assert tenant.current_app() is None
+
+    def test_nesting_restores_outer_binding(self):
+        with tenant.bound("outer", "access_key"):
+            with tenant.bound("inner", "variant"):
+                assert tenant.current_app() == "inner"
+            assert tenant.current_app() == "outer"
+        assert tenant.current_app() is None
+
+    def test_binding_does_not_leak_to_new_threads(self):
+        # a plain Thread starts with a fresh context — this is exactly why
+        # ServingPlane re-binds inside _faultable_dispatch for the batcher
+        seen = []
+        with tenant.bound("9"):
+            t = threading.Thread(target=lambda: seen.append(tenant.current_app()))
+            t.start()
+            t.join()
+        assert seen == [None]
+
+
+class TestMeterSumExactness:
+    def test_every_family_sums_to_untagged(self, clean_meter):
+        with tenant.bound("1"):
+            tenant.record_request("eventserver", "ok", status=201)
+            tenant.record_device_us(1500)
+        tenant.record_request("predictionserver", "ok", app="2", status=200)
+        tenant.record_storage_rows("1", 12, nbytes=340)
+        tenant.record_commit_bytes("2", 77)
+        tenant.record_folded("2", 5)
+        tenant.record_request("eventserver", "unauthorized", status=401)  # → "-"
+
+        state = tenant.export_state()
+        for family in tenant.FAMILIES:
+            assert (sum(state["by_app"][family].values())
+                    == state["untagged"][family]), family
+        assert state["by_app"]["requests"] == {"1": 1, "2": 1, "-": 1}
+        assert state["by_app"]["device_us"] == {"1": 1500}
+        assert state["by_app"]["storage_rows"] == {"1": 12}
+        assert state["by_app"]["commit_bytes"] == {"1": 340, "2": 77}
+        assert state["by_app"]["folded_events"] == {"2": 5}
+
+    def test_unattributed_is_metered_not_dropped(self, clean_meter):
+        tenant.record_device_us(10)  # no binding active
+        state = tenant.export_state()
+        assert state["by_app"]["device_us"] == {tenant.UNATTRIBUTED: 10}
+        assert state["untagged"]["device_us"] == 10
+
+    def test_label_cap_collapses_to_other(self, clean_meter, monkeypatch):
+        reset_label_caps("tenant")
+        monkeypatch.setattr(tenant, "LABEL_CAP", 2)
+        try:
+            for app in ("a1", "a2", "a3", "a4"):
+                tenant.record_storage_rows(app, 1)
+            state = tenant.export_state()
+            assert state["by_app"]["storage_rows"] == {
+                "a1": 1, "a2": 1, "<other>": 2}
+            # overflow still counts toward the untagged total (sum-exact)
+            assert state["untagged"]["storage_rows"] == 4
+        finally:
+            reset_label_caps("tenant")
+
+
+class TestFleetMerge:
+    def _state(self, requests):
+        s = {"by_app": {f: {} for f in tenant.FAMILIES},
+             "untagged": {f: 0 for f in tenant.FAMILIES}}
+        s["by_app"]["requests"] = dict(requests)
+        s["untagged"]["requests"] = sum(requests.values())
+        return s
+
+    def test_merge_sums_cells_exactly(self, clean_meter):
+        merged = tenant.merge_tenants([
+            ("0", self._state({"1": 3, "2": 1})),
+            ("1", self._state({"1": 2})),
+        ])
+        assert merged["fleet"] is True
+        assert merged["by_app"]["requests"] == {"1": 5, "2": 1}
+        assert merged["untagged"]["requests"] == 6
+        assert merged["workers"] == {"0": 4, "1": 2}
+
+    def test_dead_worker_contributes_zero_but_stays_in_roster(self):
+        merged = tenant.merge_tenants([
+            ("0", self._state({"1": 3})),
+            ("1", None),  # snapshot channel had no fresh file for it
+        ])
+        assert merged["workers"] == {"0": 3, "1": 0}
+        assert merged["untagged"]["requests"] == 3
+
+    def test_tampered_state_raises(self):
+        bad = self._state({"1": 3})
+        bad["untagged"]["requests"] = 99  # breakdown no longer adds up
+        with pytest.raises(AssertionError, match="sum-exact"):
+            tenant.merge_tenants([("0", bad)])
+
+    def test_merged_payload_reports_fleet_and_sum_exact(self, clean_meter):
+        merged = tenant.merge_tenants([("0", self._state({"1": 2}))])
+        body = tenant.payload(merged=merged)
+        assert body["fleet"] is True and body["sum_exact"] is True
+        assert body["workers"] == {"0": 2}
+        # burn is per-process tracker state: absent from the fleet view
+        assert all("burn_5m" not in row for row in body["tenants"])
+
+
+class TestForkHygiene:
+    def test_reinit_after_fork_zeroes_ledger_and_lock(self, clean_meter):
+        tenant.record_request("eventserver", "ok", app="5")
+        old_lock = tenant.METER._lock
+        old_lock.acquire()  # simulate a parent thread holding it mid-fork
+        try:
+            tenant._reinit_after_fork()
+        finally:
+            old_lock.release()
+        assert tenant.METER._lock is not old_lock
+        state = tenant.export_state()  # must not deadlock on the old lock
+        assert state["untagged"]["requests"] == 0
+        assert state["by_app"]["requests"] == {}
+
+
+class TestLineageEnvelope:
+    def test_mint_joins_active_binding_and_roundtrips(self):
+        with tenant.bound(42, "access_key"):
+            ctx = lineage.mint()
+        assert ctx.app == "42"
+        d = ctx.to_dict()
+        assert d["a"] == "42"
+        back = lineage.CausalContext.from_dict(d)
+        assert back is not None and back.app == "42"
+
+    def test_pre_tenant_envelope_tolerated(self):
+        back = lineage.CausalContext.from_dict({"t": "abc", "w": 1.0})
+        assert back is not None and back.app == ""
+
+    def test_unbound_mint_leaves_app_empty(self):
+        ctx = lineage.mint()
+        assert ctx.app == ""
+        assert "a" not in ctx.to_dict()
+
+
+class TestPayload:
+    def test_shape_ranking_and_topk(self, clean_meter):
+        tenant.record_device_us(3_000_000, app="big")
+        tenant.record_device_us(1_000_000, app="small")
+        tenant.record_request("predictionserver", "ok", app="small",
+                              status=200, duration_s=0.01)
+        body = tenant.payload(top_k=1)
+        assert body["enabled"] is True
+        assert body["apps_total"] == 2
+        assert len(body["tenants"]) == 1  # top-K honored
+        top = body["tenants"][0]
+        assert top["app"] == "big"  # ranked by device time first
+        assert top["device_seconds"] == 3.0
+        assert body["untagged"]["device_us"] == 4_000_000
+        assert body["sum_exact"] is True
+
+    def test_local_view_carries_burn(self, clean_meter):
+        tenant.record_request("predictionserver", "ok", app="b1",
+                              status=200, duration_s=0.001)
+        body = tenant.payload()
+        row = next(r for r in body["tenants"] if r["app"] == "b1")
+        assert "burn_5m" in row and row["slo_window_requests"] >= 1
+
+    def test_payload_response_status(self, clean_meter):
+        status, body = tenant.payload_response()
+        assert status == 200 and "tenants" in body
