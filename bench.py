@@ -1665,7 +1665,12 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     id (variant-scoped message) IS the moment that probe became
     servable. Both p95s are read on the same bucket ladder and must
     agree within 10% (`external.crosscheck_pass`), so a bug in the
-    plane's own observe path can't go unnoticed."""
+    plane's own observe path can't go unnoticed.
+
+    A pre-window burst additionally runs BOTH model families — the ALS
+    plane plus a sessionrec variant tailing the same stream — and the
+    record's `per_family` key splits that burst's p95 per family from
+    `online_family_event_to_servable_seconds` (als vs sessionrec)."""
     import threading
     import urllib.request
     from datetime import datetime, timezone
@@ -1675,6 +1680,7 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
     from predictionio_tpu.online.gate import _reset, _server, _storage, _train
     from predictionio_tpu.online.metrics import (
         ONLINE_EVENT_TO_SERVABLE,
+        ONLINE_FAMILY_FRESHNESS,
         ONLINE_FOLDIN_SECONDS,
     )
     from predictionio_tpu.storage.base import AccessKey
@@ -1743,6 +1749,86 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
                 while (server.online.events_folded < n_warm
                        and time.monotonic() < deadline):
                     time.sleep(0.05)
+            # -- second-model-family leg: train a sessionrec variant on
+            # the SAME app and let it tail the SAME stream through its
+            # own plane for one burst; the per-family children of
+            # online_family_event_to_servable_seconds split the p95
+            # (docs/online.md, "Second model family"). The session
+            # server shuts down before the headline window opens, so
+            # the north-star histogram and the probe crosscheck stay
+            # ALS-pure.
+            def train_session_variant():
+                from predictionio_tpu.controller import WorkflowContext
+                from predictionio_tpu.workflow.core_workflow import (
+                    CoreWorkflow,
+                )
+                from predictionio_tpu.workflow.workflow_utils import (
+                    EngineVariant, extract_engine_params, get_engine,
+                )
+                variant = EngineVariant.from_dict({
+                    "id": "session-bench",
+                    "engineFactory": ("predictionio_tpu.templates."
+                                      "sessionrec.SessionRecEngine"),
+                    "datasource": {"params": {"appName": "OnlineGateApp",
+                                              "eventNames": ["rate"]}},
+                    "algorithms": [{"name": "attention", "params": {
+                        "embedDim": 8, "numBlocks": 1, "numHeads": 2,
+                        "maxSeqLen": 16, "epochs": 5, "stepSize": 0.05,
+                        "seed": 1}}],
+                })
+                engine = get_engine(variant.engine_factory)
+                ep = extract_engine_params(engine, variant)
+                CoreWorkflow.run_train(
+                    engine, ep, variant,
+                    WorkflowContext(storage=storage, seed=1))
+
+            train_session_variant()
+            fam_children = {
+                f: ONLINE_FAMILY_FRESHNESS.labels(family=f)
+                for f in ("als", "sessionrec")}
+            with _server(storage, engine="session-bench",
+                         interval_s=interval_s) as server2:
+                # the session plane first replays the overlap window
+                # behind its train start (at-least-once catch-up);
+                # let that backlog drain so the family split measures
+                # live folds, not replayed history with stale ages
+                prev = -1
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    cur = server2.online.events_folded
+                    if cur == prev:
+                        break
+                    prev = cur
+                    time.sleep(3 * interval_s + 0.05)
+                fam_base = {f: (list(c.counts), c.count)
+                            for f, c in fam_children.items()}
+                fam_folded0 = server.online.events_folded
+                fam2_folded0 = server2.online.events_folded
+                n_fam = 0
+                for j in range(48):
+                    post(f"fam{j % 16}", f"i{j % 8}", float(j % 5 + 1))
+                    n_fam += 1
+                deadline = time.monotonic() + 60
+                while ((server.online.events_folded - fam_folded0 < n_fam
+                        or server2.online.events_folded - fam2_folded0
+                        < n_fam)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+            per_family = {}
+            for f, ch in fam_children.items():
+                b_counts, b_count = fam_base[f]
+                total = ch.count - b_count
+                if total <= 0:
+                    continue
+                d_counts = [c - b for c, b in zip(ch.counts, b_counts)]
+                acc, target, fp95 = 0, 0.95 * total, float("inf")
+                for bound, c in zip(ch.buckets, d_counts):
+                    acc += c
+                    if acc >= target:
+                        fp95 = bound
+                        break
+                per_family[f] = {"p95_s": fp95, "events": total}
+
             warm_folded = server.online.events_folded
             base_counts, base_count = list(e2s.counts), e2s.count
             base_sum = e2s.sum
@@ -1892,6 +1978,10 @@ def bench_freshness(emit: bool = True, duration_s: float = 10.0,
         # seconds); the bench's single app should dominate, but the key
         # exists so multi-app runs split their freshness bill by tenant
         "per_tenant": per_tenant,
+        # per-model-family p95 split (online_family_event_to_servable_
+        # seconds) over the two-plane burst: als fold-in vs sessionrec
+        # window rebuilds riding the same event stream
+        "per_family": per_family,
         "poll_interval_s": interval_s,
         "writers": writers,
         "query_clients": query_clients,

@@ -307,3 +307,56 @@ def fold_model(model: ALSModel, cfg: ALSConfig,
         model, user_factors=user_factors, item_factors=item_factors,
         user_ids=user_ids, item_ids=item_ids, seen=seen)
     return folded, stats
+
+
+# -- FoldModel protocol -------------------------------------------------------
+# The online plane folds MODEL FAMILIES, not ALS specifically: a fold
+# handle owns everything family-specific (what a "fold" recomputes, from
+# which slice of the histories) while the plane keeps everything
+# family-agnostic (tailing, watermarks, history gathering, delta-swap,
+# lineage, freshness). A handle implements:
+#
+#     family: str                      # metric label ("als", "sessionrec")
+#     fold(model, user_hist, item_hist) -> (new_model, stats)
+#
+# where `user_hist[user]` / `item_hist[item]` are the entity's FULL
+# keep-last history as [(opposing_id, value, event_time)] triples — full,
+# not delta, so any handle's fold is idempotent under the tailer's
+# at-least-once replay. Handles must never mutate the input model
+# (serving reads the old immutable state until the swap).
+
+
+class FoldModel:
+    """Protocol base for online fold handles (duck-typed; subclassing is
+    optional and exists for isinstance-based documentation/tests)."""
+
+    family: str = ""
+
+    def fold(self, model, user_hist, item_hist):  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+def _strip_times(hist: Optional[Dict[str, list]]) -> Dict[str, list]:
+    """[(id, value, t)] → [(id, value)], order preserved — exactly the
+    pairs `fold_model` always consumed, so the adapter changes no bit of
+    the ALS fold inputs."""
+    if not hist:
+        return {}
+    return {k: [(o, v) for o, v, _ in triples]
+            for k, triples in hist.items()}
+
+
+class ALSFold(FoldModel):
+    """The ALS family as a fold handle: a thin adapter over `fold_model`
+    (which stays the public, signature-stable entry point) — it only
+    drops the event times the generalized history form carries, because
+    an ALS re-solve is a pure function of (opposing id, value) pairs."""
+
+    family = "als"
+
+    def __init__(self, cfg: ALSConfig):
+        self.cfg = cfg
+
+    def fold(self, model: ALSModel, user_hist, item_hist):
+        return fold_model(model, self.cfg, _strip_times(user_hist),
+                          _strip_times(item_hist))

@@ -1,5 +1,5 @@
 """Online-learning gate — CI drill that the event→servable loop earns
-its keep. Run via `python quality.py --online-gate`. Four drills:
+its keep. Run via `python quality.py --online-gate`. Five drills:
 
 1. **Freshness**: a trained rec-test engine behind a live OnlinePlane
    (50 ms poll interval), fed a burst of rating events for existing AND
@@ -24,7 +24,16 @@ its keep. Run via `python quality.py --online-gate`. Four drills:
    must bound relative drift: a converged model plus folds stays within
    5% of what a fresh half-epoch would serve.
 
-4. **Telemetry**: the online_* families must render on /metrics.
+4. **Session family**: the same loop for the SECOND model family — a
+   trained sessionrec engine behind a live OnlinePlane, fed fresh view
+   events. A never-seen user must become servable within the same 5 s
+   bar (read from `online_family_event_to_servable_seconds` with
+   family="sessionrec"), and a crash at `online.pre_watermark` must
+   replay to a bit-identical session window, session embedding, and
+   served scores (session folds rebuild from full keep-last history,
+   so replay is idempotent by construction — docs/online.md).
+
+5. **Telemetry**: the online_* families must render on /metrics.
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 """
@@ -97,21 +106,74 @@ def _train(storage, n_users=12, n_items=8, iters=15):
 
 
 @contextlib.contextmanager
-def _server(storage, **online_kw):
+def _server(storage, engine="online-gate", **online_kw):
     from predictionio_tpu.online import OnlineConfig
     from predictionio_tpu.workflow.create_server import (
         PredictionServer,
         ServerConfig,
     )
 
-    config = ServerConfig(ip="127.0.0.1", port=0, engine_id="online-gate",
-                          engine_variant="online-gate")
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id=engine,
+                          engine_variant=engine)
     server = PredictionServer(config, storage, plugins=None,
                               online=OnlineConfig(**online_kw))
     try:
         yield server
     finally:
         server.shutdown()
+
+
+def _train_session(storage, n_users=8, n_items=10, per_user=5):
+    """Seed the sessionrec engine: each user views a rotating run of
+    items in timestamp order through the normal CoreWorkflow path."""
+    from datetime import datetime, timedelta, timezone
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+    from predictionio_tpu.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    from predictionio_tpu.workflow.workflow_utils import (
+        EngineVariant,
+        extract_engine_params,
+        get_engine,
+    )
+
+    app_id = storage.meta_apps().insert(App(id=0, name="SessionGateApp"))
+    le = storage.l_events()
+    t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+    for u in range(n_users):
+        for k in range(per_user):
+            le.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{(u + k) % n_items}",
+                properties=DataMap({}),
+                event_time=t0 + timedelta(minutes=k)), app_id)
+    variant = EngineVariant.from_dict({
+        "id": "session-gate",
+        "engineFactory": ("predictionio_tpu.templates.sessionrec."
+                          "SessionRecEngine"),
+        "datasource": {"params": {"appName": "SessionGateApp"}},
+        "algorithms": [{"name": "attention", "params": {
+            "embedDim": 8, "numBlocks": 1, "numHeads": 2, "maxSeqLen": 16,
+            "epochs": 8, "stepSize": 0.05, "seed": 1}}],
+    })
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    CoreWorkflow.run_train(engine, ep, variant,
+                           WorkflowContext(storage=storage, seed=1))
+    return app_id
+
+
+def _view(storage, app_id, user, item):
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.events import Event
+
+    storage.l_events().insert(Event(
+        event="view", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({})), app_id)
 
 
 def _rate(storage, app_id, user, item, rating=5.0):
@@ -298,6 +360,99 @@ def _parity_problems() -> list:
     return problems
 
 
+def _session_problems() -> list:
+    import numpy as np
+
+    from predictionio_tpu.online.metrics import ONLINE_FAMILY_FRESHNESS
+    from predictionio_tpu.utils.faults import FaultInjected
+
+    problems = []
+    storage = _storage()
+    prev_faults = os.environ.get("PIO_FAULTS")
+    try:
+        app_id = _train_session(storage)
+        ch = ONLINE_FAMILY_FRESHNESS.labels(family="sessionrec")
+        base = (list(ch.counts), ch.count)
+        with _server(storage, engine="session-gate",
+                     interval_s=0.05) as server:
+            # -- freshness leg: live tailer, never-seen user -------------
+            n_sent = 0
+            for i in (1, 3, 5):
+                _view(storage, app_id, "sess-new", f"i{i}")
+                n_sent += 1
+            _view(storage, app_id, "u0", "i7")  # existing user too
+            n_sent += 1
+            deadline = time.monotonic() + 60
+            while (server.online.events_folded < n_sent
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if server.online.events_folded < n_sent:
+                problems.append(
+                    f"session: only {server.online.events_folded}/{n_sent} "
+                    f"events folded within 60s")
+            result, _ = server.serving.handle_query(
+                {"user": "sess-new", "num": 3}, {})
+            if not result.get("itemScores"):
+                problems.append(
+                    "session: never-seen user 'sess-new' still has no "
+                    "recommendations after fold")
+            p95 = _hist_p95(ch, *base)
+            if p95 > FRESHNESS_P95_BAR_S:
+                problems.append(
+                    f"session: p95 event→servable {p95:.2f}s exceeds the "
+                    f"{FRESHNESS_P95_BAR_S:.0f}s bar (family=sessionrec)")
+            # -- crash leg: fold lands, watermark doesn't, replay is
+            # bit-identical (window rebuild from full keep-last history)
+            server.online.stop()  # drive polls by hand
+            for i in (2, 4, 6):
+                _view(storage, app_id, "sess-crash", f"i{i}")
+            os.environ["PIO_FAULTS"] = "online.pre_watermark=error"
+            try:
+                server.online.poll_once()
+                problems.append("session: armed fault site did not fire")
+            except FaultInjected:
+                pass
+            model = server._states["session-gate"].models[0]
+            window = model.user_windows.get("sess-crash")
+            if not window:
+                problems.append(
+                    "session: fold did not land before the crash window "
+                    "(sess-crash has no session window)")
+            vec = np.array(model.session_vecs.get(
+                "sess-crash", np.zeros(1)), copy=True)
+            scores0, _ = server.serving.handle_query(
+                {"user": "sess-crash", "num": 3}, {})
+            os.environ.pop("PIO_FAULTS", None)
+            if server.online.poll_once() <= 0:
+                problems.append(
+                    "session: restart did not replay the unacked batch")
+            model2 = server._states["session-gate"].models[0]
+            if model2.user_windows.get("sess-crash") != window:
+                problems.append(
+                    "session: replayed fold is not idempotent (window "
+                    "changed across the replay)")
+            if not np.array_equal(
+                    np.asarray(model2.session_vecs.get("sess-crash")), vec):
+                problems.append(
+                    "session: replayed session embedding is not "
+                    "bit-identical")
+            scores1, _ = server.serving.handle_query(
+                {"user": "sess-crash", "num": 3}, {})
+            if scores0 != scores1:
+                problems.append(
+                    "session: served scores changed across the replay")
+            if server.online.poll_once() != 0:
+                problems.append(
+                    "session: a clean third poll still delivered events")
+    finally:
+        if prev_faults is None:
+            os.environ.pop("PIO_FAULTS", None)
+        else:
+            os.environ["PIO_FAULTS"] = prev_faults
+        _reset(storage)
+    return problems
+
+
 def _telemetry_problems() -> list:
     from predictionio_tpu.telemetry.registry import REGISTRY
 
@@ -320,8 +475,8 @@ def _reset(storage) -> None:
 
 def run_gate() -> int:
     problems = []
-    for drill in (_freshness_problems, _crash_problems,
-                  _parity_problems, _telemetry_problems):
+    for drill in (_freshness_problems, _crash_problems, _parity_problems,
+                  _session_problems, _telemetry_problems):
         try:
             problems += drill()
         except Exception as e:  # noqa: BLE001 — a crash IS a gate failure

@@ -45,6 +45,21 @@ ONLINE_EVENT_TO_SERVABLE = REGISTRY.histogram(
     "North star: event_time → served-model swap latency, one observation "
     "per folded event",
     buckets=_E2S_BUCKETS, exemplars=True)
+ONLINE_FAMILY_FRESHNESS = REGISTRY.histogram(
+    "online_family_event_to_servable_seconds",
+    "Per-model-family slice of event→servable latency (family=als|"
+    "sessionrec|…), one observation per folded event per family that "
+    "folded it; bench.py --freshness reports the per-family p95 split",
+    ("family",), buckets=_E2S_BUCKETS)
+SESSION_WINDOWS_FOLDED = REGISTRY.counter(
+    "session_windows_folded_total",
+    "Per-user session windows rebuilt (and session embeddings "
+    "recomputed) by the online session fold")
+SESSION_COLD_ITEMS = REGISTRY.counter(
+    "session_cold_items_total",
+    "Distinct item ids dropped from session windows because the last "
+    "retrain never embedded them (cold items fold in at the next "
+    "retrain, mirroring ALS cold opposing rows)")
 ONLINE_LAG = REGISTRY.gauge(
     "online_lag_seconds",
     "Age of the fold watermark at the end of the latest poll")

@@ -2,11 +2,15 @@
 
 The loop: an `ingest.tailer.StoreTailer` (batch mode) polls rating
 events out of the durable store; each fresh batch names the dirty
-users/items; `foldin.fold_model` re-solves exactly those factor rows
-against the fixed opposite side (appending rows for never-seen ids);
-`swap.DeltaSwapper` publishes the folded models into the server's
-served-state table per variant — bandit arms keep learning mid-
-experiment — and invalidates only the touched users' cache entries.
+users/items; each variant's fold handles (`foldin.FoldModel` — `ALSFold`
+re-solving exactly the dirty factor rows against the fixed opposite
+side, `session.SessionFold` rebuilding the dirty users' session windows
+and embeddings) produce updated models; `swap.DeltaSwapper` publishes
+them into the server's served-state table per variant — bandit arms
+keep learning mid-experiment — and invalidates only the touched users'
+cache entries. Freshness is observed per event on the north-star
+histogram and sliced per model family on
+`online_family_event_to_servable_seconds`.
 
 Crash safety is the tailer's at-least-once contract: the watermark
 advances only after fold+swap complete, and a fold re-solves each dirty
@@ -37,16 +41,20 @@ import numpy as np
 
 from predictionio_tpu.ingest.tailer import OVERLAP, StoreTailer
 from predictionio_tpu.models.als_model import ALSModel
+from predictionio_tpu.models.session_model import SessionRecModel
 from predictionio_tpu.online import foldin
+from predictionio_tpu.online.foldin import ALSFold, FoldModel
 from predictionio_tpu.online.metrics import (
     ONLINE_EVENTS_FOLDED,
     ONLINE_EVENT_TO_SERVABLE,
+    ONLINE_FAMILY_FRESHNESS,
     ONLINE_FOLD_ERRORS,
     ONLINE_FOLDIN_SECONDS,
     ONLINE_LAG,
     ONLINE_PARITY_CHECKS,
     ONLINE_PARITY_DRIFT,
 )
+from predictionio_tpu.online.session import SessionFold
 from predictionio_tpu.online.swap import DeltaSwapper, StaleState
 from predictionio_tpu.ops.als import ALSConfig
 from predictionio_tpu.telemetry import slo, tenant, tracing
@@ -102,8 +110,17 @@ class _VariantCtx:
     app_id: int
     event_names: List[str]
     buy_rating: float
-    # (position in state.models, fold config) per ALS model
-    als: List[Tuple[int, ALSConfig]]
+    # (position in state.models, fold handle) per foldable model — one
+    # handle per model FAMILY the variant serves (foldin.FoldModel)
+    folds: List[Tuple[int, FoldModel]]
+
+    @property
+    def als(self) -> List[Tuple[int, ALSConfig]]:
+        """The ALS slice of the fold handles as (idx, config) pairs —
+        the parity check and gate drills re-solve against the config
+        directly, and predate the FoldModel generalization."""
+        return [(idx, h.cfg) for idx, h in self.folds
+                if isinstance(h, ALSFold)]
 
 
 class _FoldTailer(StoreTailer):
@@ -167,28 +184,32 @@ class OnlinePlane:
                                 "skipped", app_name, variant)
                     continue
                 app_id = app.id
-            als = []
+            folds: List[Tuple[int, FoldModel]] = []
             for idx, (_, params) in enumerate(
                     state.engine_params.algorithm_params_list):
-                if not isinstance(state.models[idx], ALSModel):
-                    continue
-                als.append((idx, ALSConfig(
-                    rank=getattr(params, "rank", 10),
-                    reg=getattr(params, "lambda_", 0.01),
-                    implicit=getattr(params, "implicitPrefs", False),
-                    alpha=getattr(params, "alpha", 1.0),
-                    seed=getattr(params, "seed", None) or 0,
-                    split_cap=getattr(params, "splitCap", 32768),
-                )))
-            if not als:
-                log.info("online: variant %r serves no ALSModel; skipped",
-                         variant)
+                model = state.models[idx]
+                if isinstance(model, ALSModel):
+                    folds.append((idx, ALSFold(ALSConfig(
+                        rank=getattr(params, "rank", 10),
+                        reg=getattr(params, "lambda_", 0.01),
+                        implicit=getattr(params, "implicitPrefs", False),
+                        alpha=getattr(params, "alpha", 1.0),
+                        seed=getattr(params, "seed", None) or 0,
+                        split_cap=getattr(params, "splitCap", 32768),
+                    ))))
+                elif isinstance(model, SessionRecModel):
+                    folds.append((idx, SessionFold(
+                        max_seq_len=getattr(params, "maxSeqLen",
+                                            model.max_seq_len))))
+            if not folds:
+                log.info("online: variant %r serves no foldable model; "
+                         "skipped", variant)
                 continue
             out.append(_VariantCtx(
                 variant=variant, app_id=app_id,
                 event_names=list(getattr(dsp, "eventNames", ["rate", "buy"])),
                 buy_rating=float(getattr(dsp, "buyRating", 4.0)),
-                als=als))
+                folds=folds))
         return out
 
     def rebase(self) -> None:
@@ -342,9 +363,12 @@ class OnlinePlane:
                 old = pairs.get(other)
                 if old is None or t >= old[0]:
                     pairs[other] = (t, v)
-        return ({u: [(o, v) for o, (_, v) in u_tracked[u].items()]
+        # histories carry (opposing_id, value, event_time) triples: ALS
+        # folds consume the (id, value) pairs, the session fold needs
+        # (id, time) to rebuild windows — one gather serves every family
+        return ({u: [(o, v, t) for o, (t, v) in u_tracked[u].items()]
                  for u in users if u_tracked[u]},
-                {i: [(o, v) for o, (_, v) in i_tracked[i].items()]
+                {i: [(o, v, t) for o, (t, v) in i_tracked[i].items()]
                  for i in items if i_tracked[i]})
 
     def _fold_batch(self, app_id: int, events: list) -> int:
@@ -362,6 +386,7 @@ class OnlinePlane:
                                    for e in model_events})
                            if self.config.fold_items else [])
             folded_any = False
+            folded_families: set = set()
             for ctx in self._contexts:
                 if ctx.app_id != app_id or not dirty_users:
                     continue
@@ -375,9 +400,10 @@ class OnlinePlane:
                 try:
                     models = list(state.models)
                     t_fold = time.perf_counter()
-                    for idx, cfg in ctx.als:
-                        models[idx], _ = foldin.fold_model(
-                            models[idx], cfg, user_hist, item_hist)
+                    for idx, handle in ctx.folds:
+                        models[idx], _ = handle.fold(
+                            models[idx], user_hist, item_hist)
+                        folded_families.add(handle.family)
                     fold_s = time.perf_counter() - t_fold
                     t_swap = time.perf_counter()
                     self._swapper.swap(ctx.variant, state, models,
@@ -424,6 +450,10 @@ class OnlinePlane:
                         ONLINE_EVENT_TO_SERVABLE.observe(age)
                 else:
                     ONLINE_EVENT_TO_SERVABLE.observe(age)
+                # per-family slice: one observation per family that
+                # actually folded this batch (als, sessionrec, ...)
+                for fam in sorted(folded_families):
+                    ONLINE_FAMILY_FRESHNESS.labels(family=fam).observe(age)
                 samples.append((200, age))
                 # per-tenant freshness slice: the envelope's app (minted
                 # at the auth boundary) wins over the tailer's app_id so

@@ -39,10 +39,14 @@ tests/test_serving_batcher.py).
 Failure isolation: when a batched dispatch raises and the batch held more
 than one query, the batcher retries each query alone — one malformed
 query answers its own 400 instead of failing innocent co-batched
-requests. This per-item fallback is also what carries engines whose
-algorithms have no vectorized `batch_predict` override: the base
-Algorithm.batch_predict loops `predict`, so every engine batches
-correctly, just without the vectorized win.
+requests. Each retry keeps the ORIGINAL bucket size (the query is
+repeated to fill it, mirror of the padding idiom above) so survivors
+re-dispatch against executables the grouped attempt already warmed —
+never minting a new batch tier mid-incident. This per-item fallback is
+also what carries engines whose algorithms have no vectorized
+`batch_predict` override: the base Algorithm.batch_predict loops
+`predict`, so every engine batches correctly, just without the
+vectorized win.
 
 A request whose deadline expires while queued is answered 503 by the
 dispatcher WITHOUT being dispatched — expired work never reaches the
@@ -53,6 +57,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -110,6 +115,61 @@ def bucket_ladder(max_batch: int) -> tuple:
         b <<= 1
     out.append(max_batch)
     return tuple(out)
+
+
+# -- sequence-length ladder ---------------------------------------------------
+# The batch ladder above bounds the BATCH dimension of a jitted scorer's
+# executable space; sequence engines (templates/sessionrec) have a second
+# ragged axis — the per-user history length — and without its own ladder
+# every distinct length would mint a fresh XLA executable. Histories pad
+# up to these fixed tiers with masked pad positions (causal masking +
+# last-real-position readout make the pads exact no-ops, so a history
+# scores bitwise-identically at every tier that fits it), keeping
+# `jit_compiles_total` bounded by tier count instead of data shape.
+
+_SEQ_TIER_BASE = 8
+
+
+def seq_tier_ladder(max_len: int, base: int = _SEQ_TIER_BASE) -> tuple:
+    """Power-of-two sequence tiers from `base` up to (and including) the
+    smallest power of two ≥ max_len."""
+    out = []
+    t = max(1, base)
+    while t < max_len:
+        out.append(t)
+        t <<= 1
+    out.append(t)
+    return tuple(out)
+
+
+def seq_tiers_from_env(max_len: int) -> tuple:
+    """Resolve the sequence-tier ladder: PIO_SERVING_SEQ_TIERS (comma-
+    separated lengths, e.g. "8,32") when set, else the power-of-two
+    ladder. Tiers are sorted, deduped, and always cover max_len — a
+    ladder whose top tier undercuts the model's window length would
+    silently truncate histories, so one is appended if needed."""
+    raw = os.environ.get("PIO_SERVING_SEQ_TIERS", "").strip()
+    if raw:
+        try:
+            tiers = sorted({int(p) for p in raw.split(",") if p.strip()})
+            tiers = [t for t in tiers if t > 0]
+        except ValueError:
+            log.warning("ignoring unparseable PIO_SERVING_SEQ_TIERS=%r", raw)
+            tiers = []
+        if tiers:
+            if tiers[-1] < max_len:
+                tiers.append(max_len)
+            return tuple(tiers)
+    return seq_tier_ladder(max_len)
+
+
+def pad_to_seq_tier(n: int, tiers: Sequence[int]) -> int:
+    """Smallest tier ≥ n (the top tier for longer histories — callers
+    truncate to it, keeping the newest items)."""
+    for t in tiers:
+        if n <= t:
+            return int(t)
+    return int(tiers[-1])
 
 
 @dataclasses.dataclass
@@ -383,12 +443,21 @@ class MicroBatcher:
                 live[0].finish(error=e)
                 return
             # per-item fallback: one poisoned query must not fail the
-            # batch it happened to share
+            # batch it happened to share. Each retry re-pads the lone
+            # query back up to the ORIGINAL bucket size (the _pad idiom:
+            # duplicate rows, surplus results dropped) instead of
+            # dispatching a bare batch of one — a ragged-sequence engine
+            # whose only warmed executables are the grouped batch's
+            # tiers would otherwise compile a fresh tier-1 shape per
+            # surviving item, turning one malformed sequence into a
+            # retrace storm (tests/test_serving_batcher.py).
             log.debug("batched dispatch failed (%s); retrying per item", e)
             for p in live:
                 t_item = time.monotonic()
                 try:
-                    r = self.dispatch_fn([p.query])[0]
+                    with device_telemetry.attribution(
+                            _DISPATCH_ROUTE, tier=str(len(padded))):
+                        r = self.dispatch_fn([p.query] * len(padded))[0]
                     p.dispatch_s = time.monotonic() - t_item
                     p.finish(result=r)
                 except BaseException as item_e:  # noqa: BLE001

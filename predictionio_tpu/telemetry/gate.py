@@ -37,7 +37,9 @@ Run via `python quality.py --telemetry-gate`. Eight layers:
 6. Device drill: the device plane's contracts, jax-free (the wall-time
    fallback path): `/debug/jit.json` serves a non-empty inventory under
    load with internally consistent per-signature counts, an induced
-   retrace carries blame naming the changed dimension,
+   retrace carries blame naming the changed dimension (including a
+   seq-ladder miss on the sessionrec scorer signature, which must
+   blame the sequence dim "arg1 dim1: 32→64"),
    `device_seconds_total` is attributed to the drilled route, and an
    interleaved clock-on/off A/B holds the ≤5% overhead bar.
 
@@ -623,6 +625,41 @@ def _device_drill() -> list[str]:
             problems.append(
                 "device: no device_seconds_total attributed to "
                 "/queries.json after the drill")
+
+        # -- sequence-ladder miss: the sessionrec scorer's signature is
+        # (params, seq[B,L], lengths[B]); a history that outgrows the
+        # warmed seq tiers (serving.batcher pad_to_seq_tier) retraces on
+        # the SEQUENCE dimension, and the blame must name it — arg1 dim1
+        # — so an operator can tell a seq-ladder miss from a batch-tier
+        # miss (arg1 dim0) at a glance
+        p_stub = np.zeros((4, 4), np.float32)  # params stand-in, constant
+        lengths = np.ones((4,), np.int32)
+        t0 = time.perf_counter()
+        device.record_dispatch(
+            "sessionrec.score",
+            (p_stub, np.zeros((4, 32), np.int32), lengths),
+            out=None, t0=t0, t1=t0 + 5e-4, compiled=True, compile_s=5e-4)
+        with device.attribution("/queries.json", tier="4x64"):
+            t0 = time.perf_counter()
+            device.record_dispatch(
+                "sessionrec.score",
+                (p_stub, np.zeros((4, 64), np.int32), lengths),
+                out=None, t0=t0, t1=t0 + 5e-4, compiled=True,
+                compile_s=5e-4)
+        _st, body = device.jit_payload()
+        seq_fn = body["fns"].get("sessionrec.score", {})
+        if seq_fn.get("retraces_total") != 1:
+            problems.append(
+                f"device: seq-ladder miss shows "
+                f"{seq_fn.get('retraces_total')} retraces (want exactly 1)")
+        seq_blames = seq_fn.get("retrace_blame") or []
+        seq_changed = ("; ".join(seq_blames[-1].get("changed", ()))
+                       if seq_blames else "")
+        if "arg1 dim1: 32→64" not in seq_changed:
+            problems.append(
+                f"device: seq-ladder retrace blame {seq_changed!r} does "
+                f"not name the sequence dimension (want 'arg1 dim1: "
+                f"32→64')")
 
         # -- clock on/off A/B, same pooled-median design and retry
         # policy as the profiler drill (see that comment for why).

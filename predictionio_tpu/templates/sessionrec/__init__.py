@@ -1,0 +1,32 @@
+"""SessionRec template — causal self-attention next-item model.
+
+Users `view`/`buy` items; the model learns next-item transitions over
+each user's canonical recent-item window and serves
+{"user": ..., "num": ...} or {"items": [...], "num": ...} queries with
+{"itemScores": [...]}. The online plane folds fresh events into served
+session windows without retraining (online/session.py).
+"""
+
+from predictionio_tpu.templates.sessionrec.engine import (
+    DataSource,
+    DataSourceParams,
+    PreparedData,
+    Preparator,
+    Query,
+    SessionRecAlgorithm,
+    SessionRecEngine,
+    SessionRecParams,
+    TrainingData,
+)
+
+__all__ = [
+    "SessionRecEngine",
+    "DataSource",
+    "DataSourceParams",
+    "Preparator",
+    "PreparedData",
+    "TrainingData",
+    "SessionRecAlgorithm",
+    "SessionRecParams",
+    "Query",
+]

@@ -1,0 +1,440 @@
+"""Session-based next-item engine template (DASE components).
+
+The scenario-diversity frontier (ROADMAP item 4): every other served
+template is factor- or frequency-based; this one is a small causal
+self-attention next-item model — item embeddings plus 1–2
+`ops.attention.dense_attention` blocks — trained through the normal
+DataSource → Preparator → Algorithm path over per-user event sequences
+from `data/view.py`'s ordered aggregation, and served through the
+existing MicroBatcher.
+
+Serving pads over TWO ragged axes on fixed ladders: the batcher's
+power-of-two bucket ladder bounds the batch dimension, and the
+sequence-tier ladder (`serving.batcher.seq_tiers_from_env`, knob
+PIO_SERVING_SEQ_TIERS) bounds the history-length dimension — so the
+jitted scorer's executable space is (batch tiers × sequence tiers),
+each compiled once, instead of one compile per ragged length.
+
+Pad positions are exact no-ops, which is what makes batched-vs-single
+parity bitwise at every tier: histories right-pad, the causal mask
+keeps every real position from attending past itself (a masked score is
+`_NEG_INF`, whose softmax term underflows to exactly 0.0 in f32), the
+readout gathers the LAST REAL position's state, and all other ops are
+per-position or per-row. A history therefore scores identically at any
+tier that fits it and in any batch that carries it.
+
+Wire shapes:
+    query:  {"user": "u1", "num": 4}            — served session window
+            {"items": ["i1", "i2"], "num": 4}   — explicit session
+    result: {"itemScores": [{"item": "i5", "score": 0.93}, ...]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from datetime import timezone
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource as BaseDataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator as BasePreparator,
+    SanityCheck,
+    WorkflowContext,
+)
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import EventStore
+from predictionio_tpu.data.view import LBatchView
+from predictionio_tpu.models.session_model import (
+    SessionRecModel,
+    recent_window,
+)
+from predictionio_tpu.serving.batcher import (
+    pad_to_seq_tier,
+    seq_tier_ladder,
+    seq_tiers_from_env,
+)
+
+log = logging.getLogger(__name__)
+
+Query = dict
+PredictedResult = dict
+
+
+@dataclasses.dataclass
+class DataSourceParams(Params):
+    appName: str = ""
+    eventNames: list = dataclasses.field(
+        default_factory=lambda: ["view", "buy"])
+    evalK: int = 0  # >0 enables read_eval with k leave-last-item folds
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    """Per-user canonical item sequences (the `recent_window` rule over
+    the ordered event fold — keep-last dedup, (time, item) order)."""
+
+    sequences: Dict[str, List[str]]  # user id → ordered item ids
+
+    def sanity_check(self):
+        if not any(len(s) >= 2 for s in self.sequences.values()):
+            raise ValueError(
+                "TrainingData has no user with a 2+ item sequence; ingest "
+                "view/buy events first (next-item training needs at least "
+                "one transition).")
+
+
+class DataSource(BaseDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: WorkflowContext) -> TrainingData:
+        """Per-user ordered sequences via `LBatchView.
+        aggregate_by_entity_ordered` — the time-ordered per-entity fold
+        (events sorted by event_time, creation_time) the reference's
+        `aggregateByEntityOrdered` provided. The fold accumulates
+        (item, event_time) pairs; `recent_window` then applies the one
+        canonical window rule the online fold shares."""
+        view = LBatchView(self.params.appName,
+                          store=EventStore(ctx.storage))
+        names = set(self.params.eventNames)
+
+        def pred(e) -> bool:
+            return (e.event in names
+                    and e.entity_type == "user"
+                    and (e.target_entity_type or "item") == "item"
+                    and bool(e.target_entity_id))
+
+        def op(acc, e):
+            t = e.event_time
+            if t is not None and t.tzinfo is None:
+                t = t.replace(tzinfo=timezone.utc)
+            return acc + ((str(e.target_entity_id), t),)
+
+        folded = view.aggregate_by_entity_ordered(pred, (), op)
+        sequences = {str(u): recent_window(pairs, 0)  # 0 = uncapped here;
+                     for u, pairs in folded.items() if pairs}
+        # the Algorithm caps to maxSeqLen so window length stays an
+        # algorithm knob, not a data-shape property
+        log.info("DataSource: %d users with sequences, app %r",
+                 len(sequences), self.params.appName)
+        return TrainingData(sequences=sequences)
+
+    def read_eval(self, ctx: WorkflowContext):
+        """k-fold leave-last-item-out: each fold holds out 1/k of the
+        2+-item users; their training sequence drops its last item and
+        the query replays the prefix asking the model to rank the
+        held-out next item."""
+        k = self.params.evalK
+        if k <= 1:
+            raise ValueError("DataSourceParams.evalK must be >= 2 for "
+                             "evaluation")
+        td = self.read_training(ctx)
+        users = sorted(u for u, s in td.sequences.items() if len(s) >= 2)
+        folds = []
+        for fold in range(k):
+            held = set(users[fold::k])
+            seqs = {u: (list(s[:-1]) if u in held else list(s))
+                    for u, s in td.sequences.items()}
+            seqs = {u: s for u, s in seqs.items() if s}
+            qa = [({"items": list(seqs[u]), "num": 10},
+                   {"items": [td.sequences[u][-1]]})
+                  for u in sorted(held) if seqs.get(u)]
+            folds.append((TrainingData(sequences=seqs), qa))
+        return folds
+
+
+@dataclasses.dataclass
+class PreparedData:
+    item_ids: BiMap
+    user_seqs: Dict[str, np.ndarray]  # user id → int32 embedding rows
+
+
+class Preparator(BasePreparator):
+    """Code items densely (sorted ids → deterministic rows) and encode
+    each user's canonical sequence."""
+
+    def prepare(self, ctx: WorkflowContext, td: TrainingData) -> PreparedData:
+        items = sorted({i for s in td.sequences.values() for i in s})
+        item_ids = BiMap.string_int(items)
+        user_seqs = {
+            u: item_ids.to_index(s).astype(np.int32)
+            for u, s in sorted(td.sequences.items())
+        }
+        return PreparedData(item_ids=item_ids, user_seqs=user_seqs)
+
+
+# -- jitted forward ----------------------------------------------------------
+
+def _encode(params, seq, n_heads: int):
+    """[B, L] padded item rows → [B, L, D] contextual states.
+
+    Right-padded rows index the pad embedding (row V); causal
+    dense_attention keeps every real position's state a function of
+    real positions only, so the encoding of a history is invariant to
+    the tier it was padded to (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from predictionio_tpu.ops.attention import dense_attention
+
+    emb = params["emb"]
+    x = emb[seq] + params["pos"][: seq.shape[1]][None, :, :]
+    b, l, d = x.shape
+    for blk in params["blocks"]:
+        q = (x @ blk["wq"]).reshape(b, l, n_heads, -1).transpose(0, 2, 1, 3)
+        k = (x @ blk["wk"]).reshape(b, l, n_heads, -1).transpose(0, 2, 1, 3)
+        v = (x @ blk["wv"]).reshape(b, l, n_heads, -1).transpose(0, 2, 1, 3)
+        a = dense_attention(q, k, v, causal=True)
+        x = x + a.transpose(0, 2, 1, 3).reshape(b, l, d) @ blk["wo"]
+        x = x + (jax.nn.relu(x @ blk["w1"] + blk["b1"]) @ blk["w2"]
+                 + blk["b2"])
+    return x
+
+
+@functools.lru_cache(maxsize=8)
+def _scorer(n_heads: int):
+    """The served next-item scorer, metered so every dispatch lands in
+    the jit-cache inventory / device attribution and a ladder miss
+    names its changed dimension in /debug/jit.json. Executable space:
+    one compile per (batch tier, sequence tier) after warmup — args are
+    (params pytree, seq [B, L], lengths [B]), so a sequence-ladder miss
+    blames "arg1 dim1: <old>→<new>"."""
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    def score(params, seq, lengths):
+        import jax.numpy as jnp
+
+        x = _encode(params, seq, n_heads)
+        b, l, _ = x.shape
+        idx = jnp.clip(lengths - 1, 0, l - 1)
+        h = x[jnp.arange(b), idx]  # last REAL position per row
+        n_items = params["emb"].shape[0] - 1
+        return h @ params["emb"][:n_items].T  # tied output embedding
+
+    return metered_jit(score, label="sessionrec.score")
+
+
+@functools.lru_cache(maxsize=8)
+def _train_step(n_heads: int, lr: float):
+    """One full-batch Adam step on masked next-item cross-entropy."""
+    from predictionio_tpu.utils.profiling import metered_jit
+
+    def step(params, m, v, t, seq, lengths):
+        import jax
+        import jax.numpy as jnp
+
+        def loss_fn(p):
+            x = _encode(p, seq, n_heads)
+            n_items = p["emb"].shape[0] - 1
+            logits = x[:, :-1] @ p["emb"][:n_items].T  # [B, L-1, V]
+            targets = jnp.minimum(seq[:, 1:], n_items - 1)
+            mask = (jnp.arange(seq.shape[1] - 1)[None, :]
+                    < (lengths - 1)[:, None]).astype(logits.dtype)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        t = t + 1.0
+        tree_map = jax.tree_util.tree_map
+        m = tree_map(lambda mm, g: 0.9 * mm + 0.1 * g, m, grads)
+        v = tree_map(lambda vv, g: 0.999 * vv + 0.001 * g * g, v, grads)
+        params = tree_map(
+            lambda p, mm, vv: p - lr * (mm / (1.0 - 0.9 ** t))
+            / (jnp.sqrt(vv / (1.0 - 0.999 ** t)) + 1e-8),
+            params, m, v)
+        return params, m, v, t, loss
+
+    return metered_jit(step, label="sessionrec.train_step")
+
+
+def _pad_batch_tier(n: int) -> int:
+    """Power-of-two batch tier ≥ n (the scorer-side half of the bucket
+    ladder: batch groups re-fragment after sequence-tier grouping, so
+    the batch dim re-pads onto its own fixed ladder)."""
+    t = 1
+    while t < n:
+        t <<= 1
+    return t
+
+
+def _serve_tiers(model: SessionRecModel) -> tuple:
+    """Sequence tiers this model can serve: the env ladder clamped to
+    the trained positional table (a tier the table can't cover would
+    index past it)."""
+    l_pos = int(np.asarray(model.params["pos"]).shape[0])
+    tiers = tuple(t for t in seq_tiers_from_env(model.max_seq_len)
+                  if t <= l_pos)
+    return tiers or seq_tier_ladder(model.max_seq_len)
+
+
+@dataclasses.dataclass
+class SessionRecParams(Params):
+    embedDim: int = 16
+    numBlocks: int = 1
+    numHeads: int = 2
+    maxSeqLen: int = 32
+    epochs: int = 30
+    stepSize: float = 0.05
+    seed: Optional[int] = None
+
+
+class SessionRecAlgorithm(Algorithm):
+    """Causal self-attention next-item model over session windows."""
+
+    params_class = SessionRecParams
+    checkpoint_tags = ("sessionrec",)
+
+    def __init__(self, params: SessionRecParams):
+        self.params = params
+
+    def train(self, ctx: WorkflowContext,
+              pd: PreparedData) -> SessionRecModel:
+        import jax
+
+        p = self.params
+        seed = ctx.seed if p.seed is None else p.seed
+        rng = np.random.default_rng(int(seed) if seed is not None else 0)
+        n_items = len(pd.item_ids)
+        d = int(p.embedDim)
+        cap = int(p.maxSeqLen)
+        # positional table spans the default ladder's top tier for this
+        # window length — independent of the serve-time env so a model
+        # never deploys with fewer positions than its own ladder needs
+        l_pos = seq_tier_ladder(cap)[-1]
+
+        def init_w(*shape):
+            return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+        blocks = []
+        for _ in range(int(p.numBlocks)):
+            blocks.append({
+                "wq": init_w(d, d), "wk": init_w(d, d),
+                "wv": init_w(d, d), "wo": init_w(d, d),
+                "w1": init_w(d, 2 * d),
+                "b1": np.zeros(2 * d, np.float32),
+                "w2": init_w(2 * d, d),
+                "b2": np.zeros(d, np.float32),
+            })
+        params = {
+            # row n_items is the sequence pad row (kept zero at init;
+            # pads never reach the loss or the readout)
+            "emb": np.concatenate(
+                [init_w(n_items, d), np.zeros((1, d), np.float32)]),
+            "pos": init_w(l_pos, d),
+            "blocks": blocks,
+        }
+
+        seqs = [s[-cap:] for _, s in sorted(pd.user_seqs.items())
+                if len(s) >= 2]
+        n = len(seqs)
+        if n:
+            bt = _pad_batch_tier(n)
+            seq = np.full((bt, l_pos), n_items, np.int32)
+            lengths = np.zeros(bt, np.int32)
+            for r, s in enumerate(seqs):
+                seq[r, :len(s)] = s
+                lengths[r] = len(s)
+            step = _train_step(int(p.numHeads), float(p.stepSize))
+            m = jax.tree_util.tree_map(np.zeros_like, params)
+            v = jax.tree_util.tree_map(np.zeros_like, params)
+            t = np.float32(0.0)
+            loss = None
+            for _ in range(int(p.epochs)):
+                params, m, v, t, loss = step(params, m, v, t, seq, lengths)
+            params = jax.tree_util.tree_map(np.asarray, params)
+            log.info("SessionRec: trained %d sequences, %d items, final "
+                     "loss %.4f", n, n_items,
+                     float(loss) if loss is not None else float("nan"))
+
+        windows = {
+            u: tuple(pd.item_ids.from_index(s[-cap:]))
+            for u, s in sorted(pd.user_seqs.items())
+        }
+        model = SessionRecModel(
+            params=params, item_ids=pd.item_ids, user_windows=windows,
+            session_vecs={}, max_seq_len=cap, n_heads=int(p.numHeads))
+        model.session_vecs.update(
+            {u: model.session_vec_of(w) for u, w in windows.items()})
+        return model
+
+    def predict(self, model: SessionRecModel,
+                query: Query) -> PredictedResult:
+        # the single path IS the batched path at batch 1: parity between
+        # them is a code identity plus the tier-invariance the jitted
+        # forward guarantees (asserted in tests/test_sessionrec_template)
+        return self.batch_predict(model, [query])[0]
+
+    def batch_predict(self, model: SessionRecModel,
+                      queries) -> list:
+        out: list = [None] * len(queries)
+        tiers = _serve_tiers(model)
+        cap = min(model.max_seq_len, int(tiers[-1]))
+        groups: Dict[int, list] = {}
+        for pos, q in enumerate(queries):
+            hist = q.get("items")
+            if hist is None:
+                u = q.get("user")
+                hist = (model.user_windows.get(str(u), ())
+                        if u is not None else ())
+            rows = model.window_rows(hist)[-cap:]
+            num = int(q.get("num", 10))
+            if not rows or num <= 0:
+                out[pos] = {"itemScores": []}
+                continue
+            tier = pad_to_seq_tier(len(rows), tiers)
+            groups.setdefault(tier, []).append((pos, rows, num))
+        if not groups:
+            return out
+        score = _scorer(model.n_heads)
+        pad_row = model.n_items
+        for tier, entries in groups.items():
+            b = len(entries)
+            bt = _pad_batch_tier(b)
+            seq = np.full((bt, tier), pad_row, np.int32)
+            lengths = np.zeros(bt, np.int32)
+            for r, (_, rows, _) in enumerate(entries):
+                seq[r, :len(rows)] = rows
+                lengths[r] = len(rows)
+            if bt > b:
+                # batch padding duplicates the last real row; its
+                # results are never read (the batcher's _pad idiom)
+                seq[b:] = seq[b - 1]
+                lengths[b:] = lengths[b - 1]
+            logits = np.asarray(score(model.params, seq, lengths))
+            for r, (pos, rows, num) in enumerate(entries):
+                s = logits[r].copy()
+                seen = np.unique(np.asarray(rows, np.int32))
+                s[seen] = -np.inf  # never re-recommend the window
+                k = min(num, s.shape[0] - len(seen))
+                if k <= 0:
+                    out[pos] = {"itemScores": []}
+                    continue
+                top = np.argpartition(-s, k - 1)[:k]
+                top = top[np.argsort(-s[top])]
+                items = model.item_ids.from_index(top)
+                out[pos] = {"itemScores": [
+                    {"item": i, "score": float(s[j])}
+                    for i, j in zip(items, top)]}
+        return out
+
+
+class SessionRecEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class_map=DataSource,
+            preparator_class_map=Preparator,
+            algorithm_class_map={"attention": SessionRecAlgorithm},
+            serving_class_map=FirstServing,
+        )

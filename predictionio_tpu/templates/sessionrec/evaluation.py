@@ -1,0 +1,54 @@
+"""SessionRec template evaluation: MAP@k over a params grid.
+
+Leave-last-item-out folds (DataSource.read_eval): the held-out user's
+prefix replays as the session and the model must rank the true next
+item. Run with:
+
+    pio-tpu eval predictionio_tpu.templates.sessionrec.evaluation.SessionRecEvaluation
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.controller import MAPatK
+from predictionio_tpu.controller.engine import EngineParams
+from predictionio_tpu.controller.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+)
+from predictionio_tpu.templates.sessionrec.engine import (
+    DataSourceParams,
+    SessionRecEngine,
+    SessionRecParams,
+)
+
+
+def _engine_params(embed_dim: int, n_blocks: int, app_name: str,
+                   eval_k: int) -> EngineParams:
+    return EngineParams(
+        data_source_name="",
+        data_source_params=DataSourceParams(appName=app_name, evalK=eval_k),
+        algorithm_params_list=[
+            ("attention", SessionRecParams(embedDim=embed_dim,
+                                           numBlocks=n_blocks, seed=3))
+        ],
+    )
+
+
+class SessionRecEvaluation(Evaluation, EngineParamsGenerator):
+    """Grid over embedding dim × block count, primary metric MAP@10.
+    App name comes from PIO_EVAL_APP_NAME (default "MyApp1"), fold count
+    from PIO_EVAL_K — same CLI contract as the other template
+    evaluations."""
+
+    def __init__(self):
+        import os
+
+        app_name = os.environ.get("PIO_EVAL_APP_NAME", "MyApp1")
+        eval_k = int(os.environ.get("PIO_EVAL_K", "3"))
+        self.engine = SessionRecEngine().apply()
+        self.metric = MAPatK(10)
+        self.engine_params_list = [
+            _engine_params(dim, blocks, app_name, eval_k)
+            for dim in (8, 16)
+            for blocks in (1, 2)
+        ]

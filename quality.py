@@ -105,7 +105,11 @@ def main():
                         "watermark advance must replay to bit-identical "
                         "factors with zero events lost), full-retrain "
                         "parity (folded rows bitwise-match their own "
-                        "half-epoch; plane-wide drift bounded), and the "
+                        "half-epoch; plane-wide drift bounded), the "
+                        "session model family (a sessionrec engine's "
+                        "fresh view events servable within the same 5 s "
+                        "bar; crash replay rebuilds bit-identical "
+                        "session windows/embeddings/scores), and the "
                         "online_* telemetry render")
     p.add_argument("--mode", choices=["explicit", "implicit"],
                    default="explicit")

@@ -1,0 +1,29 @@
+"""Fixture: the sessionrec scorer shape — a session history's
+len() passed straight into a jit-wrapped scorer (flagged: every new
+history length is a fresh trace) next to the disciplined spelling that
+rounds the length through the serving plane's seq-tier helper first
+(legal: the executable space stays bounded by the ladder)."""
+
+
+def metered_jit(fn, label=""):
+    return fn
+
+
+def _score(params, seq, length):
+    return seq
+
+
+score = metered_jit(_score, label="fixture.sessionrec.score")
+
+
+def bad_session_call(params, history):
+    return score(params, history, len(history))
+
+
+def good_session_call(params, history):
+    length = _pad_seq_tier(len(history))
+    return score(params, history, length)
+
+
+def _pad_seq_tier(n):
+    return max(8, 1 << (n - 1).bit_length())

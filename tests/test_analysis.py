@@ -220,6 +220,14 @@ class TestShapeRule:
         hits = [f for f in findings if f.file == "retrace_bait.py"]
         assert {f.symbol for f in hits} == {"bad_call->solve"}, hits
 
+    def test_unbounded_history_len_into_jitted_scorer_flagged(self):
+        # sequence-ladder discipline: len(history) straight into the
+        # jitted sessionrec scorer retraces per history length; routing
+        # it through a seq-tier pad helper is the legal spelling
+        findings = run_on_fixtures(["jit-shape-discipline"])
+        hits = [f for f in findings if f.file == "session_bait.py"]
+        assert {f.symbol for f in hits} == {"bad_session_call->score"}, hits
+
 
 class TestLabelRule:
     def test_unbounded_label_flagged_capped_and_constant_not(self):

@@ -323,6 +323,69 @@ class TestFoldInMath:
         assert same is grown and none_added == []
 
 
+class TestFoldModelProtocol:
+    """PR receipt: generalizing the plane beyond ALS (foldin.FoldModel)
+    left ALS fold-in byte-for-byte intact — ALSFold is a thin adapter
+    that strips event times off the protocol's history triples and
+    calls the original fold_model, mirroring the StoreTailer extraction
+    receipt above."""
+
+    def test_alsfold_is_a_thin_adapter(self):
+        from predictionio_tpu.online import ALSFold, FoldModel
+
+        assert issubclass(ALSFold, FoldModel)
+        assert ALSFold.family == "als"
+        # the adapter adds no solve logic of its own: fold_model is
+        # still the one entry point (parity/gate callers keep using it)
+        import inspect
+        src = inspect.getsource(ALSFold.fold)
+        assert "fold_model" in src
+
+    def test_alsfold_fold_is_bit_identical_to_fold_model(self):
+        # the same histories, once as the protocol's timed triples and
+        # once as fold_model's untimed pairs: byte-equal factors, same
+        # appended codes, same stats — the extraction changed nothing
+        from predictionio_tpu.online import ALSFold
+
+        rng = np.random.default_rng(17)
+        model = TestFoldInMath._model(rng)
+        cfg = TestFoldInMath.CFG
+        user_pairs = {"u1": [("i0", 4.0), ("i3", 2.0)],
+                      "newu": [("i5", 5.0), ("newi", 3.0)]}
+        item_pairs = {"i0": [("u1", 4.0), ("u2", 1.0)]}
+
+        def timed(hists):
+            return {k: [(o, v, T0 + timedelta(seconds=j))
+                        for j, (o, v) in enumerate(pairs)]
+                    for k, pairs in hists.items()}
+
+        via_handle, st1 = ALSFold(cfg).fold(
+            model, timed(user_pairs), timed(item_pairs))
+        direct, st2 = fold_model(model, cfg, user_pairs, item_pairs)
+        assert np.array_equal(np.asarray(via_handle.user_factors),
+                              np.asarray(direct.user_factors))
+        assert np.array_equal(np.asarray(via_handle.item_factors),
+                              np.asarray(direct.item_factors))
+        assert via_handle.user_ids.to_dict() == direct.user_ids.to_dict()
+        assert via_handle.item_ids.to_dict() == direct.item_ids.to_dict()
+        assert (st1.folded_users, st1.folded_items, st1.new_users,
+                st1.new_items) == (st2.folded_users, st2.folded_items,
+                                   st2.new_users, st2.new_items)
+
+    def test_plane_context_keeps_the_als_compat_view(self, memory_storage):
+        # parity_check and the gate drills read ctx.als as (idx, config)
+        # pairs; the property must recover them from the fold handles
+        ingest_ratings(memory_storage)
+        train_variant(memory_storage, iters=2)
+        with online_server(memory_storage, interval_s=0.05) as server:
+            ctx = server.online._contexts[0]
+            assert ctx.folds, "variant resolved no fold handles"
+            assert [f for _, f in ctx.als] and all(
+                isinstance(cfg, ALSConfig) for _, cfg in ctx.als)
+            assert [i for i, _ in ctx.als] == \
+                [i for i, h in ctx.folds if h.family == "als"]
+
+
 class TestDeltaSwapper:
     class _Bus:
         def __init__(self):

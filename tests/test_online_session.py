@@ -1,0 +1,277 @@
+"""Second model family on the online plane: session folds.
+
+The FoldModel extraction (`online/foldin.py`) exists so the tailer →
+fold → delta-swap → invalidate loop serves more than ALS. This module
+is the receipt for the sessionrec side:
+
+- SessionFold math — a fold rebuilds the dirty users' windows from
+  their FULL keep-last history under the canonical `recent_window`
+  rule, recomputes the pooled session embedding bitwise, never mutates
+  the input model, drops (and counts) cold items, and is idempotent
+  under replay — the property that makes the tailer's at-least-once
+  batch mode safe without any session-specific machinery.
+- End to end — a trained sessionrec engine behind a live OnlinePlane
+  resolves a SessionFold handle (and an empty ALS compat view); fresh
+  view events reach the served windows in one poll and the user's
+  `/queries.json` answer reflects them; the per-family freshness
+  histogram gains sessionrec observations; a crash between fold and
+  watermark replays to a bit-identical window, embedding, and scores.
+"""
+
+import contextlib
+import os
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.events import Event
+from predictionio_tpu.models.session_model import (
+    SessionRecModel,
+    recent_window,
+)
+from predictionio_tpu.online import ALSFold, FoldModel, OnlineConfig, \
+    SessionFold
+from predictionio_tpu.online.metrics import (
+    ONLINE_FAMILY_FRESHNESS,
+    SESSION_COLD_ITEMS,
+    SESSION_WINDOWS_FOLDED,
+)
+from predictionio_tpu.storage.base import App
+from predictionio_tpu.utils.faults import FaultInjected
+from predictionio_tpu.workflow.create_server import (
+    PredictionServer,
+    ServerConfig,
+)
+
+T0 = datetime(2026, 3, 1, tzinfo=timezone.utc)
+
+
+def _view(user, item, t):
+    return Event(event="view", entity_type="user", entity_id=user,
+                 target_entity_type="item", target_entity_id=item,
+                 properties=DataMap({}), event_time=t)
+
+
+def ingest_views(storage, n_users=6, n_items=8, per_user=4):
+    """Rotating runs of views per user, strictly time-ordered."""
+    app_id = storage.meta_apps().insert(App(id=0, name="SessApp"))
+    le = storage.l_events()
+    for u in range(n_users):
+        for k in range(per_user):
+            le.insert(_view(f"u{u}", f"i{(u + k) % n_items}",
+                            T0 + timedelta(minutes=k)), app_id)
+    return app_id
+
+
+def train_session_variant(storage, epochs=4):
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.workflow.core_workflow import CoreWorkflow
+    from predictionio_tpu.workflow.workflow_utils import (
+        EngineVariant,
+        extract_engine_params,
+        get_engine,
+    )
+
+    variant = EngineVariant.from_dict({
+        "id": "sess-test",
+        "engineFactory": ("predictionio_tpu.templates.sessionrec."
+                          "SessionRecEngine"),
+        "datasource": {"params": {"appName": "SessApp"}},
+        "algorithms": [{"name": "attention", "params": {
+            "embedDim": 8, "numBlocks": 1, "numHeads": 2, "maxSeqLen": 16,
+            "epochs": epochs, "stepSize": 0.05, "seed": 1}}],
+    })
+    engine = get_engine(variant.engine_factory)
+    ep = extract_engine_params(engine, variant)
+    CoreWorkflow.run_train(engine, ep, variant,
+                           WorkflowContext(storage=storage, seed=1))
+    return variant
+
+
+@contextlib.contextmanager
+def session_server(storage, **online_kw):
+    config = ServerConfig(ip="127.0.0.1", port=0, engine_id="sess-test",
+                          engine_variant="sess-test")
+    server = PredictionServer(config, storage, plugins=None,
+                              online=OnlineConfig(**online_kw))
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def _tiny_model():
+    # 4 trained items + the pad row, 3-dim embeddings, no jax needed
+    emb = np.arange(15, dtype=np.float32).reshape(5, 3)
+    return SessionRecModel(
+        params={"emb": emb},
+        item_ids=BiMap.string_int([f"i{k}" for k in range(4)]),
+        user_windows={}, session_vecs={}, max_seq_len=3, n_heads=1)
+
+
+class TestRecentWindow:
+    """The ONE rule training and the online fold must share."""
+
+    def test_keep_last_and_time_order(self):
+        pairs = [("a", T0), ("b", T0 + timedelta(seconds=1)),
+                 ("a", T0 + timedelta(seconds=2))]
+        # a's position is its LATEST event: it moves behind b
+        assert recent_window(pairs, 10) == ["b", "a"]
+
+    def test_caps_to_most_recent(self):
+        pairs = [(f"x{k}", T0 + timedelta(seconds=k)) for k in range(5)]
+        assert recent_window(pairs, 3) == ["x2", "x3", "x4"]
+
+    def test_arrival_order_is_irrelevant(self):
+        pairs = [("a", T0), ("b", T0 + timedelta(seconds=1)),
+                 ("c", T0 + timedelta(seconds=2))]
+        shuffled = [pairs[2], pairs[0], pairs[1]]
+        assert recent_window(pairs, 10) == recent_window(shuffled, 10)
+
+    def test_time_ties_break_by_item_id(self):
+        assert recent_window([("b", T0), ("a", T0)], 10) == ["a", "b"]
+
+
+class TestSessionFold:
+    def test_is_a_fold_model(self):
+        assert issubclass(SessionFold, FoldModel)
+        assert SessionFold.family == "sessionrec"
+        assert ALSFold.family == "als"
+
+    def test_fold_rebuilds_window_and_embedding(self):
+        m = _tiny_model()
+        hist = {"u1": [("i0", 1.0, T0),
+                       ("i2", 1.0, T0 + timedelta(seconds=2)),
+                       ("i1", 1.0, T0 + timedelta(seconds=1))]}
+        folded, stats = SessionFold(max_seq_len=3).fold(m, hist)
+        assert folded is not m and m.user_windows == {}  # input untouched
+        assert folded.user_windows["u1"] == ("i0", "i1", "i2")
+        assert np.array_equal(folded.session_vecs["u1"],
+                              m.session_vec_of(("i0", "i1", "i2")))
+        assert stats.folded_users == 1 and stats.new_items == 0
+
+    def test_rewatched_item_moves_to_the_end(self):
+        m = _tiny_model()
+        hist = {"u1": [("i0", 1.0, T0),
+                       ("i1", 1.0, T0 + timedelta(seconds=1)),
+                       ("i2", 1.0, T0 + timedelta(seconds=2)),
+                       ("i0", 1.0, T0 + timedelta(seconds=3))]}
+        folded, _ = SessionFold(max_seq_len=3).fold(m, hist)
+        assert folded.user_windows["u1"] == ("i1", "i2", "i0")
+
+    def test_cold_items_dropped_and_counted(self):
+        m = _tiny_model()
+        base = SESSION_COLD_ITEMS.value
+        hist = {"u1": [("i1", 1.0, T0),
+                       ("never-trained", 1.0, T0 + timedelta(seconds=1))]}
+        folded, stats = SessionFold(max_seq_len=3).fold(m, hist)
+        assert folded.user_windows["u1"] == ("i1",)
+        assert stats.new_items == 1
+        assert SESSION_COLD_ITEMS.value == base + 1
+
+    def test_replay_is_bit_identical(self):
+        # at-least-once safety: re-applying the same history is a no-op
+        # because the fold recomputes from keep-last state, not appends
+        m = _tiny_model()
+        hist = {"u1": [("i3", 1.0, T0), ("i0", 1.0, T0)]}
+        fold = SessionFold(max_seq_len=3)
+        once, _ = fold.fold(m, hist)
+        twice, _ = fold.fold(once, hist)
+        assert twice.user_windows["u1"] == once.user_windows["u1"]
+        assert np.array_equal(twice.session_vecs["u1"],
+                              once.session_vecs["u1"])
+
+    def test_untouched_users_keep_their_state(self):
+        m = _tiny_model()
+        first, _ = SessionFold(3).fold(m, {"u1": [("i0", 1.0, T0)]})
+        second, _ = SessionFold(3).fold(first, {"u2": [("i1", 1.0, T0)]})
+        assert second.user_windows["u1"] == first.user_windows["u1"]
+        assert second.session_vecs["u1"] is first.session_vecs["u1"]
+
+
+class TestSessionPlaneEndToEnd:
+    def test_view_events_fold_to_servable(self, memory_storage):
+        app_id = ingest_views(memory_storage)
+        train_session_variant(memory_storage)
+        folded_base = SESSION_WINDOWS_FOLDED.value
+        ch = ONLINE_FAMILY_FRESHNESS.labels(family="sessionrec")
+        obs_base = ch.count
+        with session_server(memory_storage, interval_s=0.05) as server:
+            server.online.stop()  # drive polls by hand
+            ctx = server.online._contexts[0]
+            handles = [h for _, h in ctx.folds]
+            assert any(isinstance(h, SessionFold) for h in handles)
+            assert ctx.als == []  # compat view: no ALS arms here
+            le = memory_storage.l_events()
+            # event times must be live (ahead of the tailer's since-
+            # training watermark), strictly ordered to pin the window
+            now = datetime.now(timezone.utc)
+            for j, item in enumerate(("i1", "i3", "i5")):
+                le.insert(_view("fresh-u", item,
+                                now + timedelta(milliseconds=j)), app_id)
+            assert server.online.poll_once() > 0
+            model = server._states["sess-test"].models[0]
+            assert model.user_windows["fresh-u"] == ("i1", "i3", "i5")
+            assert np.array_equal(
+                model.session_vecs["fresh-u"],
+                model.session_vec_of(("i1", "i3", "i5")))
+            result, _ = server.serving.handle_query(
+                {"user": "fresh-u", "num": 3}, {})
+            scores = result.get("itemScores")
+            assert scores, "fresh session user should be servable"
+            # seen-exclusion reflects the freshly folded window
+            assert all(s["item"] not in ("i1", "i3", "i5") for s in scores)
+        assert SESSION_WINDOWS_FOLDED.value > folded_base
+        assert ch.count > obs_base  # per-family slice observed
+
+    def test_crash_replay_is_bit_identical(self, memory_storage):
+        app_id = ingest_views(memory_storage)
+        train_session_variant(memory_storage)
+        prev = os.environ.get("PIO_FAULTS")
+        try:
+            with session_server(memory_storage, interval_s=0.05) as server:
+                server.online.stop()
+                le = memory_storage.l_events()
+                server.online.poll_once()  # drain any startup backlog
+                now = datetime.now(timezone.utc)
+                for j, item in enumerate(("i2", "i4", "i6")):
+                    le.insert(_view("crash-u", item,
+                                    now + timedelta(milliseconds=j)),
+                              app_id)
+                os.environ["PIO_FAULTS"] = "online.pre_watermark=error"
+                with pytest.raises(FaultInjected):
+                    server.online.poll_once()
+                model = server._states["sess-test"].models[0]
+                window = model.user_windows.get("crash-u")
+                assert window == ("i2", "i4", "i6")  # fold landed pre-crash
+                vec = np.array(model.session_vecs["crash-u"], copy=True)
+                scores0, _ = server.serving.handle_query(
+                    {"user": "crash-u", "num": 3}, {})
+                os.environ.pop("PIO_FAULTS", None)
+                assert server.online.poll_once() > 0  # unacked replays
+                model2 = server._states["sess-test"].models[0]
+                assert model2.user_windows["crash-u"] == window
+                assert np.array_equal(model2.session_vecs["crash-u"], vec)
+                scores1, _ = server.serving.handle_query(
+                    {"user": "crash-u", "num": 3}, {})
+                assert scores0 == scores1
+                assert server.online.poll_once() == 0  # nothing left
+        finally:
+            if prev is None:
+                os.environ.pop("PIO_FAULTS", None)
+            else:
+                os.environ["PIO_FAULTS"] = prev
+
+
+class TestSessionTelemetry:
+    def test_session_families_render(self):
+        from predictionio_tpu.telemetry.registry import REGISTRY
+
+        text = REGISTRY.render()
+        for family in ("online_family_event_to_servable_seconds",
+                       "session_windows_folded_total",
+                       "session_cold_items_total"):
+            assert f"# TYPE {family} " in text

@@ -303,6 +303,71 @@ class TestIsolation:
         assert results["a"] == "A" and results["b"] == "B"
         assert isinstance(results["poison"], ValueError)
 
+    def test_poisoned_full_bucket_retries_at_original_tier(self):
+        """Regression: the per-item fallback used to dispatch each
+        survivor as a bare batch of 1 — a shape the grouped attempt
+        never warmed, so one poisoned sequence in a full bucket minted
+        a fresh compile per innocent co-batched query. Every retry must
+        arrive at the ORIGINAL padded size (query repeated to fill it),
+        and survivors must still get correct answers."""
+        calls = []
+        release = threading.Event()
+
+        def dispatch(qs):
+            calls.append(list(qs))
+            if qs[0] == "blocker":
+                release.wait(10)
+                return list(qs)
+            if any(q == "poison" for q in qs):
+                raise ValueError("bad sequence")
+            return [q.upper() for q in qs]
+
+        b = MicroBatcher(dispatch, BatcherConfig(max_batch=4))
+        results = {}
+
+        def run(q):
+            try:
+                results[q] = b.submit(q)
+            except ValueError as e:
+                results[q] = e
+
+        try:
+            blocker = threading.Thread(target=run, args=("blocker",))
+            blocker.start()
+            deadline = time.monotonic() + 5
+            while not calls and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert calls, "blocker never dispatched"
+            ts = [threading.Thread(target=run, args=(q,))
+                  for q in ("a", "poison", "b", "c")]
+            for t in ts:
+                t.start()
+            # hold the blocker until the full bucket is queued, so the
+            # poison is deterministically co-batched with 3 survivors
+            deadline = time.monotonic() + 5
+            while len(b._queue) < 4 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert len(b._queue) == 4, "bucket never filled"
+            release.set()
+            for t in ts:
+                t.join(timeout=10)
+            blocker.join(timeout=10)
+        finally:
+            release.set()
+            b.close()
+        assert results["a"] == "A" and results["b"] == "B" \
+            and results["c"] == "C"
+        assert isinstance(results["poison"], ValueError)
+        grouped = calls[1]  # [0] is the blocker
+        assert sorted(grouped) == ["a", "b", "c", "poison"]
+        retries = calls[2:]
+        assert len(retries) == 4  # one per member, in batch order
+        for retry in retries:
+            # repeated to the original bucket size — never re-padded
+            # down onto a fresh (smaller) tier mid-incident
+            assert len(retry) == len(grouped)
+            assert set(retry) == {retry[0]}
+
     def test_dispatch_result_count_mismatch_is_an_error(self):
         b = MicroBatcher(lambda qs: [])
         try:

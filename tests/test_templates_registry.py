@@ -22,11 +22,11 @@ from predictionio_tpu.workflow.workflow_utils import (
 class TestRegistry:
     def test_reference_templates_present(self):
         # the five SURVEY §2.4 templates plus the gallery templates
-        # added in round 2
+        # added in round 2 and the sessionrec engine (ROADMAP item 4)
         assert set(BUILTIN_TEMPLATES) == {
             "recommendation", "similarproduct", "classification",
             "ecommerce", "textclassification", "complementarypurchase",
-            "productranking", "leadscoring",
+            "productranking", "leadscoring", "sessionrec",
         }
 
     def test_unknown_template_raises(self):
